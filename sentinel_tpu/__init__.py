@@ -1,0 +1,41 @@
+"""sentinel_tpu — a TPU-native flow-control / traffic-shaping / circuit-breaking framework.
+
+A ground-up re-design of the capabilities of Alibaba Sentinel (reference:
+longkaimao/Sentinel, a fork of Sentinel 1.8.4) for JAX/XLA on TPU:
+
+- **Local engine** (``sentinel_tpu.local``): in-process resource guarding —
+  ``entry()/exit()`` API, context + invocation tree, slot chain, sliding-window
+  statistics, flow rules (4 traffic-shaping behaviors), circuit breakers,
+  system-adaptive (BBR) protection, authority rules, hot-param limiting.
+  Analog of ``sentinel-core`` (reference ``sentinel-core/src/main/java``).
+
+- **Batched engine** (``sentinel_tpu.engine``): the TPU data plane — all
+  counters live in device-resident ``[resources, buckets, events]`` tensors,
+  rules are padded tensor tables, and admission is one jitted pure function
+  ``decide(state, rules, requests, now_ms) -> (state, verdicts)`` with
+  in-batch prefix-sum admission (strictly stronger than the reference's
+  per-thread TOCTOU).
+
+- **Cluster** (``sentinel_tpu.cluster``): the token client/server (analog of
+  ``sentinel-cluster``) — binary wire protocol, micro-batched front door, and
+  a ``TokenService`` whose decision path runs on TPU, sharded over a
+  ``jax.sharding.Mesh`` along the resource axis with ``psum`` for global
+  limits.
+
+The behavioral contract (rule semantics, verdict statuses, fallback modes)
+matches the reference; the architecture does not — see SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+from sentinel_tpu.core.clock import Clock, ManualClock, SystemClock, now_ms
+from sentinel_tpu.core.config import SentinelConfig
+
+__all__ = [
+    "Clock",
+    "ManualClock",
+    "SystemClock",
+    "now_ms",
+    "SentinelConfig",
+    "__version__",
+]

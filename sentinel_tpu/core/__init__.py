@@ -1,0 +1,4 @@
+"""Core substrate: config, clock, registry (SPI analog), dynamic properties.
+
+Analog of reference L0 (``sentinel-core/.../{util,spi,config,log,property}``).
+"""

@@ -1,0 +1,77 @@
+"""Millisecond clock with explicit injection for deterministic tests.
+
+The reference uses an adaptive cached clock (``sentinel-core/.../util/TimeUtil.java:42``:
+a dedicated thread writes a volatile millis when read rates exceed ~1200/s) and tests
+mock the static method via PowerMock (``AbstractTimeBasedTest.java:28-55``).
+
+The TPU build makes time an *explicit input* instead: every kernel takes ``now_ms``
+as an argument, and the host obtains it from a swappable ``Clock``. This removes the
+whole mock-the-static-clock test fixture class — tests pass a ``ManualClock``.
+
+Python's ``time.time_ns`` is a vDSO call (~20ns); no caching thread is needed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Clock:
+    """Source of wall-clock milliseconds. Subclass to virtualize time."""
+
+    def now_ms(self) -> int:
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    __slots__ = ()
+
+    def now_ms(self) -> int:
+        return time.time_ns() // 1_000_000
+
+
+class ManualClock(Clock):
+    """Deterministic clock for tests (analog of the reference's fake-clock fixture,
+    ``sentinel-cluster-server-default/src/test/.../AbstractTimeBasedTest.java``)."""
+
+    __slots__ = ("_ms",)
+
+    def __init__(self, start_ms: int = 1_700_000_000_000):
+        self._ms = int(start_ms)
+
+    def now_ms(self) -> int:
+        return self._ms
+
+    def set_ms(self, ms: int) -> None:
+        self._ms = int(ms)
+
+    def advance(self, delta_ms: int) -> None:
+        self._ms += int(delta_ms)
+
+    # Convenience names mirroring the reference fixture's sleep()/sleepSecond().
+    def sleep(self, delta_ms: int) -> None:
+        self.advance(delta_ms)
+
+    def sleep_second(self, seconds: int = 1) -> None:
+        self.advance(seconds * 1000)
+
+
+_lock = threading.Lock()
+_clock: Clock = SystemClock()
+
+
+def get_clock() -> Clock:
+    return _clock
+
+
+def set_clock(clock: Clock) -> Clock:
+    """Install a process-global clock; returns the previous one."""
+    global _clock
+    with _lock:
+        prev, _clock = _clock, clock
+        return prev
+
+
+def now_ms() -> int:
+    return _clock.now_ms()

@@ -1,0 +1,31 @@
+"""Statistics engine: sliding-window counters as device tensors.
+
+Analog of reference L1 (``sentinel-core/.../slots/statistic/{base,data,metric}``),
+re-designed for XLA: no CAS, no LongAdder — one ``[resources, buckets, events]``
+tensor per window resolution, lazily reset by masking against a shared
+window-start vector, updated by batched scatter-adds.
+"""
+
+from sentinel_tpu.stats.window import (
+    WindowSpec,
+    WindowState,
+    make_window,
+    roll,
+    add_events,
+    window_sum,
+    window_sum_all,
+    bucket_index,
+)
+from sentinel_tpu.stats.events import Event
+
+__all__ = [
+    "WindowSpec",
+    "WindowState",
+    "make_window",
+    "roll",
+    "add_events",
+    "window_sum",
+    "window_sum_all",
+    "bucket_index",
+    "Event",
+]

@@ -1,0 +1,258 @@
+"""Sliding-window counters as ring-indexed device tensors.
+
+This is the TPU-native re-design of the reference's ``LeapArray<T>``
+(``sentinel-core/.../slots/statistic/base/LeapArray.java:41``): a circular array
+of time buckets where ``idx = (now // bucket_ms) % n_buckets`` and a bucket is
+*deprecated* (excluded from reads) once its recorded window start falls outside
+``(now - interval, now]``.
+
+Key differences from the JVM design, driven by XLA semantics:
+
+- **One global clock per step.** The reference resets buckets lazily per
+  resource with a CAS loop (``LeapArray.java:116-160``) because each thread
+  carries its own ``now``. A batched kernel applies a single ``now_ms`` to the
+  whole step, so bucket occupancy is *uniform across resources*: the window
+  start of ring slot ``b`` is one shared ``starts[b]`` vector, not per-resource
+  state. Reset becomes "zero the counts column whose slot is being re-occupied"
+  — a masked elementwise op, no CAS.
+
+- **Mask-on-read instead of reset-on-read.** Buckets that went stale during an
+  idle gap keep old counts but are excluded by the validity mask
+  (``starts[b] in (now - interval, now]``); they are zeroed when their slot is
+  next written. Matches ``LeapArray.isWindowDeprecated`` + ``values()`` read
+  semantics (``LeapArray.java:257-266``).
+
+- **Engine-relative int32 time.** Timestamps are milliseconds since an
+  engine-chosen epoch so they fit int32 without enabling jax x64 (which would
+  change dtype defaults for embedding applications). int32 ms wraps after
+  ~24.8 days; hosts re-base the epoch with :func:`rebase` well before that
+  (a single subtraction over ``starts``).
+
+All functions are pure, jit-compatible, and take ``now`` explicitly (the test
+lesson from the reference's PowerMock clock fixture, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel value for "slot never occupied": far in the past relative to any
+# engine-relative timestamp (engine time starts near 0).
+NEVER = jnp.int32(-(2**30))
+
+
+class WindowSpec(NamedTuple):
+    """Static geometry of a sliding window.
+
+    reference: ``LeapArray(sampleCount, intervalInMs)`` with
+    ``windowLengthInMs = intervalInMs / sampleCount`` (``LeapArray.java:61-72``).
+    """
+
+    bucket_ms: int
+    n_buckets: int
+
+    @property
+    def interval_ms(self) -> int:
+        return self.bucket_ms * self.n_buckets
+
+
+class WindowState(NamedTuple):
+    """Dynamic window state (a pytree of device arrays).
+
+    ``starts``: ``[n_buckets] int32`` — engine-ms window start currently
+    occupying each ring slot (shared across resources; see module docstring).
+    ``counts``: ``[n_resources, n_buckets, n_channels]`` int32 (or float32 for
+    RT-style accumulators) — per-resource, per-bucket event counters.
+    """
+
+    starts: jax.Array
+    counts: jax.Array
+
+
+def make_window(
+    spec: WindowSpec, n_resources: int, n_channels: int, dtype=jnp.int32
+) -> WindowState:
+    return WindowState(
+        starts=jnp.full((spec.n_buckets,), NEVER, dtype=jnp.int32),
+        counts=jnp.zeros((n_resources, spec.n_buckets, n_channels), dtype=dtype),
+    )
+
+
+def bucket_index(spec: WindowSpec, now: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``(ring slot, window start)`` for time ``now``.
+
+    reference: ``LeapArray.calculateTimeIdx`` / ``calculateWindowStart``
+    (``LeapArray.java:100-108``).
+    """
+    now = jnp.asarray(now, jnp.int32)
+    idx = (now // spec.bucket_ms) % spec.n_buckets
+    start = now - now % spec.bucket_ms
+    return idx, start
+
+
+def roll(spec: WindowSpec, ws: WindowState, now: jax.Array) -> WindowState:
+    """Ensure the ring slot for ``now`` holds the current window (zero if stale).
+
+    Analog of the reset arm of ``LeapArray.currentWindow`` (``LeapArray.java:
+    132-160``) — but a data-parallel masked zero instead of a CAS race.
+    """
+    idx, cur_start = bucket_index(spec, now)
+    stale = ws.starts[idx] != cur_start
+    counts = jnp.where(
+        (jnp.arange(spec.n_buckets)[None, :, None] == idx) & stale,
+        jnp.zeros((), ws.counts.dtype),
+        ws.counts,
+    )
+    starts = ws.starts.at[idx].set(cur_start)
+    return WindowState(starts=starts, counts=counts)
+
+
+def add_events(
+    spec: WindowSpec,
+    ws: WindowState,
+    now: jax.Array,
+    resource_ids: jax.Array,
+    channel_ids: jax.Array,
+    values: jax.Array,
+    valid: Optional[jax.Array] = None,
+) -> WindowState:
+    """Batched scatter-add of ``values`` into the current bucket.
+
+    Replaces the reference's per-request ``bucket.addPass(n)`` LongAdder
+    increments (``MetricBucket.java``) with one ``scatter-add``; duplicate
+    ``(resource, channel)`` pairs within the batch accumulate correctly.
+    """
+    ws = roll(spec, ws, now)
+    idx, _ = bucket_index(spec, now)
+    if valid is not None:
+        values = jnp.where(valid, values, 0)
+    counts = ws.counts.at[resource_ids, idx, channel_ids].add(
+        values.astype(ws.counts.dtype), mode="drop"
+    )
+    return WindowState(starts=ws.starts, counts=counts)
+
+
+def valid_mask(spec: WindowSpec, ws: WindowState, now: jax.Array) -> jax.Array:
+    """``[n_buckets] bool`` — slots whose window is inside ``(now - interval, now]``.
+
+    reference: ``!isWindowDeprecated(time, w)`` i.e.
+    ``time - windowStart < intervalInMs`` (``LeapArray.java:250-266``).
+    """
+    now = jnp.asarray(now, jnp.int32)
+    age = now - ws.starts
+    return (age >= 0) & (age < spec.interval_ms)
+
+
+def window_sum(
+    spec: WindowSpec, ws: WindowState, now: jax.Array, channel: int
+) -> jax.Array:
+    """``[n_resources]`` sum of one channel over valid buckets
+    (``ArrayMetric.pass_()/block()…`` read path)."""
+    mask = valid_mask(spec, ws, now)
+    return jnp.sum(
+        ws.counts[:, :, channel] * mask[None, :].astype(ws.counts.dtype), axis=1
+    )
+
+
+def window_sum_all(spec: WindowSpec, ws: WindowState, now: jax.Array) -> jax.Array:
+    """``[n_resources, n_channels]`` sums over valid buckets."""
+    mask = valid_mask(spec, ws, now)
+    return jnp.sum(
+        ws.counts * mask[None, :, None].astype(ws.counts.dtype), axis=1
+    )
+
+
+def avg_qps(spec: WindowSpec, total: jax.Array) -> jax.Array:
+    """Per-second rate from a window sum (``StatisticNode.passQps`` divides by
+    ``IntervalProperty.INTERVAL/1000``)."""
+    return total.astype(jnp.float32) * (1000.0 / spec.interval_ms)
+
+
+def rebase(ws: WindowState, delta_ms: int) -> WindowState:
+    """Shift the engine epoch forward by ``delta_ms`` (host maintenance op, run
+    well before int32 engine-ms wraps at ~24.8 days)."""
+    starts = jnp.where(ws.starts == NEVER, ws.starts, ws.starts - jnp.int32(delta_ms))
+    return WindowState(starts=starts, counts=ws.counts)
+
+
+# ---------------------------------------------------------------------------
+# Future (occupy/borrow) windows — analog of FutureBucketLeapArray
+# (``slots/statistic/metric/occupy/FutureBucketLeapArray.java``): same ring, but
+# a slot is valid when its window lies strictly in the future within the next
+# interval. Used by prioritized requests to "borrow" capacity from upcoming
+# windows (``OccupiableBucketLeapArray.java:29-73``, ``StatisticNode.tryOccupyNext``).
+# ---------------------------------------------------------------------------
+
+
+def future_valid_mask(spec: WindowSpec, ws: WindowState, now: jax.Array) -> jax.Array:
+    now = jnp.asarray(now, jnp.int32)
+    ahead = ws.starts - now
+    return (ahead > 0) & (ahead <= spec.interval_ms)
+
+
+def future_sum(
+    spec: WindowSpec, ws: WindowState, now: jax.Array, channel: int
+) -> jax.Array:
+    """``[n_resources]`` occupied counts waiting in future windows
+    (``OccupiableBucketLeapArray.currentWaiting``)."""
+    mask = future_valid_mask(spec, ws, now)
+    return jnp.sum(
+        ws.counts[:, :, channel] * mask[None, :].astype(ws.counts.dtype), axis=1
+    )
+
+
+def add_future(
+    spec: WindowSpec,
+    ws: WindowState,
+    now: jax.Array,
+    wait_ms: jax.Array,
+    resource_ids: jax.Array,
+    channel_ids: jax.Array,
+    values: jax.Array,
+    valid: Optional[jax.Array] = None,
+) -> WindowState:
+    """Scatter-add into the bucket ``wait_ms`` ahead of ``now`` (per request).
+
+    reference: ``OccupiableBucketLeapArray.addWaiting(futureTime, n)``. Each
+    request may target a different future slot, so the roll (stale-slot zeroing)
+    is computed for the union of targeted slots first, then one scatter-add.
+
+    A ring of ``B`` slots can hold the current window plus at most ``B - 1``
+    future windows, so the target window offset is clamped to
+    ``[1, B-1]`` buckets ahead — a row can never collide with the current
+    bucket's slot or wrap the ring. Rows with ``wait_ms <= 0`` or
+    ``valid=False`` are fully masked (they contribute neither counts nor slot
+    resets).
+    """
+    now = jnp.asarray(now, jnp.int32)
+    wait_ms = jnp.asarray(wait_ms, jnp.int32)
+    row_ok = wait_ms > 0
+    if valid is not None:
+        row_ok = row_ok & valid
+    values = jnp.where(row_ok, values, 0)
+
+    _, cur_start = bucket_index(spec, now)
+    future_time = now + wait_ms
+    k = (future_time - cur_start) // spec.bucket_ms
+    k = jnp.clip(k, 1, spec.n_buckets - 1)
+    start = cur_start + k * spec.bucket_ms
+    idx = (start // spec.bucket_ms) % spec.n_buckets
+    # Masked rows must not drive the slot-reset union below.
+    start = jnp.where(row_ok, start, NEVER)
+
+    # Zero any targeted slot whose recorded start differs from the target start.
+    # (Duplicate valid targets agree on `start`: after clamping, slot index k
+    # uniquely determines the start within one ring period.)
+    desired = jnp.full_like(ws.starts, NEVER).at[idx].max(start, mode="drop")
+    needs_reset = (desired != NEVER) & (desired != ws.starts)
+    counts = jnp.where(
+        needs_reset[None, :, None], jnp.zeros((), ws.counts.dtype), ws.counts
+    )
+    starts = jnp.where(needs_reset, desired, ws.starts)
+    counts = counts.at[resource_ids, idx, channel_ids].add(
+        values.astype(counts.dtype), mode="drop"
+    )
+    return WindowState(starts=starts, counts=counts)

@@ -1,0 +1,45 @@
+"""Test config: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding is tested on CPU via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4); the real-TPU path is
+exercised by the driver's bench run.
+
+Note: this environment preloads jax at interpreter boot (axon sitecustomize)
+with ``JAX_PLATFORMS=axon``, so setting env vars here is too late — the suite
+would silently run against the remote TPU chip (and take minutes). The
+``jax.config.update`` call below works even after preload; XLA_FLAGS is still
+read lazily at first CPU-backend creation.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from sentinel_tpu.core import clock as clock_mod  # noqa: E402
+from sentinel_tpu.core.clock import ManualClock  # noqa: E402
+
+
+def pytest_sessionstart(session):
+    # Fail fast if the suite is about to run on real hardware.
+    assert jax.devices()[0].platform == "cpu", (
+        "test suite must run on the virtual CPU mesh, got: %s" % jax.devices()
+    )
+
+
+@pytest.fixture
+def manual_clock():
+    """Install a deterministic clock for the duration of a test."""
+    mc = ManualClock()
+    prev = clock_mod.set_clock(mc)
+    yield mc
+    clock_mod.set_clock(prev)

@@ -1,0 +1,73 @@
+"""Core substrate tests: clock, config, registry, property system."""
+
+from sentinel_tpu.core import clock as clock_mod
+from sentinel_tpu.core.clock import ManualClock
+from sentinel_tpu.core.config import SentinelConfig
+from sentinel_tpu.core.property import DynamicProperty
+from sentinel_tpu.core.registry import Registry
+
+
+class TestClock:
+    def test_manual_clock_fixture(self, manual_clock):
+        t0 = clock_mod.now_ms()
+        manual_clock.sleep(250)
+        assert clock_mod.now_ms() == t0 + 250
+        manual_clock.sleep_second()
+        assert clock_mod.now_ms() == t0 + 1250
+
+    def test_system_clock_monotonic_enough(self):
+        c = clock_mod.SystemClock()
+        a, b = c.now_ms(), c.now_ms()
+        assert b >= a > 1_600_000_000_000
+
+
+class TestConfig:
+    def test_defaults_and_override(self):
+        SentinelConfig.reset_for_tests()
+        assert SentinelConfig.cold_factor() == 3
+        SentinelConfig.set("csp.sentinel.flow.cold.factor", "5")
+        assert SentinelConfig.cold_factor() == 5
+        SentinelConfig.reset_for_tests()
+
+    def test_env_wins_over_file_regardless_of_load_order(self, tmp_path, monkeypatch):
+        # regression: file load used to write into the explicit-set layer,
+        # shadowing env vars after the first file-triggering get().
+        SentinelConfig.reset_for_tests()
+        f = tmp_path / "props"
+        f.write_text("csp.sentinel.flow.cold.factor=7\nsome.other.key=x\n")
+        monkeypatch.setenv("SENTINEL_TPU_CONFIG", str(f))
+        monkeypatch.setenv("CSP_SENTINEL_FLOW_COLD_FACTOR", "9")
+        assert SentinelConfig.get("csp.sentinel.flow.cold.factor") == "9"
+        assert SentinelConfig.get("some.other.key") == "x"  # triggers file load
+        assert SentinelConfig.get("csp.sentinel.flow.cold.factor") == "9"  # still env
+        SentinelConfig.reset_for_tests()
+
+    def test_typed_getters(self):
+        SentinelConfig.reset_for_tests()
+        assert SentinelConfig.get_int("csp.sentinel.statistic.max.rt") == 5000
+        assert SentinelConfig.get_bool("nonexistent", True) is True
+        SentinelConfig.set("x.flag", "true")
+        assert SentinelConfig.get_bool("x.flag") is True
+        SentinelConfig.reset_for_tests()
+
+
+class TestRegistry:
+    def test_order_and_default(self):
+        reg = Registry("test")
+        reg.register(lambda: "b", order=10, name="b")
+        reg.register(lambda: "a", order=-10, name="a")
+        reg.register(lambda: "d", order=5, is_default=True, name="d")
+        assert reg.instances_sorted() == ["a", "d", "b"]
+        assert reg.first_or_default() == "d"
+        assert reg.by_name("b") == "b"
+
+
+class TestProperty:
+    def test_listener_fanout_and_dedup(self):
+        prop = DynamicProperty([1])
+        seen = []
+        prop.listen(seen.append)
+        assert seen == [[1]]  # config_load on subscribe
+        assert prop.update_value([1]) is False  # unchanged → no fan-out
+        assert prop.update_value([1, 2]) is True
+        assert seen == [[1], [1, 2]]

@@ -1,0 +1,215 @@
+"""Window kernel tests — the analog of the reference's LeapArray test suite
+(``sentinel-core/src/test/.../slots/statistic/base/LeapArrayTest.java``,
+``BucketLeapArrayTest``), with explicit time instead of a mocked clock."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentinel_tpu.stats import window as W
+from sentinel_tpu.stats.events import Event, N_EVENTS
+
+SPEC = W.WindowSpec(bucket_ms=500, n_buckets=2)  # second-level default: 1000ms/2
+R = 8
+
+
+def add_pass(ws, now, res, n=1):
+    return W.add_events(
+        SPEC,
+        ws,
+        jnp.int32(now),
+        jnp.array([res], jnp.int32),
+        jnp.array([Event.PASS], jnp.int32),
+        jnp.array([n], jnp.int32),
+    )
+
+
+def pass_sum(ws, now):
+    return np.asarray(W.window_sum(SPEC, ws, jnp.int32(now), Event.PASS))
+
+
+class TestBucketIndex:
+    def test_ring_math(self):
+        # mirrors LeapArrayTest.testCalculateTimeIdx / windowStart math
+        idx, start = W.bucket_index(SPEC, jnp.int32(1_234))
+        assert int(idx) == (1_234 // 500) % 2 == 0
+        assert int(start) == 1_000
+
+    def test_wraps(self):
+        idx0, _ = W.bucket_index(SPEC, jnp.int32(0))
+        idx1, _ = W.bucket_index(SPEC, jnp.int32(500))
+        idx2, _ = W.bucket_index(SPEC, jnp.int32(1_000))
+        assert int(idx0) == int(idx2) != int(idx1)
+
+
+class TestAddAndSum:
+    def test_counts_within_interval(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        now = 10_000
+        ws = add_pass(ws, now, res=3, n=2)
+        ws = add_pass(ws, now + 100, res=3)
+        assert pass_sum(ws, now + 100)[3] == 3
+        assert pass_sum(ws, now + 100)[0] == 0
+
+    def test_window_slides_off(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = add_pass(ws, 10_000, res=1, n=5)
+        # still visible within the 1s interval
+        assert pass_sum(ws, 10_900)[1] == 5
+        # gone once the bucket's window start leaves (now - interval, now]
+        assert pass_sum(ws, 11_500)[1] == 0
+
+    def test_two_buckets_both_count(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = add_pass(ws, 10_000, res=0, n=1)  # bucket A
+        ws = add_pass(ws, 10_600, res=0, n=2)  # bucket B
+        assert pass_sum(ws, 10_999)[0] == 3
+
+    def test_stale_slot_reset_on_reuse(self):
+        # After a full ring revolution the old slot must be zeroed when rewritten
+        # (LeapArray.java:147-155 reset arm).
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = add_pass(ws, 10_000, res=2, n=7)
+        ws = add_pass(ws, 11_000, res=2, n=1)  # same ring slot, one interval later
+        assert pass_sum(ws, 11_000)[2] == 1
+
+    def test_idle_gap_masked_on_read(self):
+        # Counts written long ago must not reappear even without intervening writes.
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = add_pass(ws, 10_000, res=2, n=7)
+        assert pass_sum(ws, 60_000)[2] == 0
+
+    def test_batched_duplicate_accumulation(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        res = jnp.array([5, 5, 5, 1], jnp.int32)
+        chan = jnp.array([Event.PASS, Event.PASS, Event.BLOCK, Event.PASS], jnp.int32)
+        val = jnp.array([1, 2, 4, 8], jnp.int32)
+        ws = W.add_events(SPEC, ws, jnp.int32(20_000), res, chan, val)
+        assert pass_sum(ws, 20_000)[5] == 3
+        assert np.asarray(W.window_sum(SPEC, ws, jnp.int32(20_000), Event.BLOCK))[5] == 4
+        assert pass_sum(ws, 20_000)[1] == 8
+
+    def test_valid_mask_respects_padding(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        res = jnp.array([5, 5], jnp.int32)
+        chan = jnp.array([Event.PASS, Event.PASS], jnp.int32)
+        val = jnp.array([1, 100], jnp.int32)
+        ws = W.add_events(
+            SPEC, ws, jnp.int32(20_000), res, chan, val,
+            valid=jnp.array([True, False]),
+        )
+        assert pass_sum(ws, 20_000)[5] == 1
+
+    def test_jit_compatible(self):
+        fn = jax.jit(
+            lambda ws, now, r, c, v: W.add_events(SPEC, ws, now, r, c, v)
+        )
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = fn(
+            ws,
+            jnp.int32(10_000),
+            jnp.array([0], jnp.int32),
+            jnp.array([0], jnp.int32),
+            jnp.array([3], jnp.int32),
+        )
+        assert pass_sum(ws, 10_000)[0] == 3
+
+
+class TestReferenceParityWindowing:
+    """Property test: tensor windows match a straightforward per-event replay
+    (the oracle mirrors LeapArray read semantics)."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_replay(self, seed):
+        rng = np.random.default_rng(seed)
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        events = []  # (t, res, n)
+        t = 5_000
+        for _ in range(200):
+            t += int(rng.integers(0, 180))
+            res = int(rng.integers(0, R))
+            n = int(rng.integers(1, 4))
+            events.append((t, res, n))
+            ws = add_pass(ws, t, res, n)
+        now = t
+        got = pass_sum(ws, now)
+        # oracle: event counts whose *bucket window start* is within (now-interval, now]
+        want = np.zeros(R, np.int64)
+        for (et, res, n) in events:
+            bstart = et - et % SPEC.bucket_ms
+            if 0 <= now - bstart < SPEC.interval_ms:
+                want[res] += n
+        assert (got == want).all()
+
+
+class TestFutureWindows:
+    def test_add_future_and_sum(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        now = jnp.int32(10_000)
+        ws = W.add_future(
+            SPEC, ws, now,
+            wait_ms=jnp.array([500], jnp.int32),
+            resource_ids=jnp.array([4], jnp.int32),
+            channel_ids=jnp.array([Event.OCCUPIED_PASS], jnp.int32),
+            values=jnp.array([2], jnp.int32),
+        )
+        waiting = np.asarray(W.future_sum(SPEC, ws, now, Event.OCCUPIED_PASS))
+        assert waiting[4] == 2
+        # once time reaches the future bucket it is no longer "waiting"
+        waiting_later = np.asarray(
+            W.future_sum(SPEC, ws, jnp.int32(10_500), Event.OCCUPIED_PASS)
+        )
+        assert waiting_later[4] == 0
+        # ...but it IS a valid current bucket now (borrowed tokens count as passed)
+        cur = np.asarray(W.window_sum(SPEC, ws, jnp.int32(10_500), Event.OCCUPIED_PASS))
+        assert cur[4] == 2
+
+    def test_invalid_rows_do_not_reset_live_buckets(self):
+        # regression: a valid=False (padded) row must not drive the slot-reset
+        # union — previously it could wipe live current-window counts.
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = add_pass(ws, 10_000, res=1, n=5)
+        ws = W.add_future(
+            SPEC, ws, jnp.int32(10_000),
+            wait_ms=jnp.array([SPEC.interval_ms], jnp.int32),  # maps onto current slot pre-clamp
+            resource_ids=jnp.array([1], jnp.int32),
+            channel_ids=jnp.array([Event.OCCUPIED_PASS], jnp.int32),
+            values=jnp.array([3], jnp.int32),
+            valid=jnp.array([False]),
+        )
+        assert pass_sum(ws, 10_000)[1] == 5
+
+    def test_wait_clamped_to_ring_capacity(self):
+        # regression: wait_ms large enough to wrap the ring must be clamped to
+        # at most n_buckets-1 windows ahead, never colliding with the current slot.
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = add_pass(ws, 10_000, res=1, n=5)
+        ws = W.add_future(
+            SPEC, ws, jnp.int32(10_000),
+            wait_ms=jnp.array([10 * SPEC.interval_ms], jnp.int32),
+            resource_ids=jnp.array([1], jnp.int32),
+            channel_ids=jnp.array([Event.OCCUPIED_PASS], jnp.int32),
+            values=jnp.array([3], jnp.int32),
+        )
+        assert pass_sum(ws, 10_000)[1] == 5  # current bucket untouched
+        waiting = np.asarray(W.future_sum(SPEC, ws, jnp.int32(10_000), Event.OCCUPIED_PASS))
+        assert waiting[1] == 3  # landed in the farthest future slot instead
+
+    def test_zero_wait_rows_masked(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = W.add_future(
+            SPEC, ws, jnp.int32(10_000),
+            wait_ms=jnp.array([0, 500], jnp.int32),
+            resource_ids=jnp.array([4, 4], jnp.int32),
+            channel_ids=jnp.array([Event.OCCUPIED_PASS] * 2, jnp.int32),
+            values=jnp.array([1, 10], jnp.int32),
+        )
+        waiting = np.asarray(W.future_sum(SPEC, ws, jnp.int32(10_000), Event.OCCUPIED_PASS))
+        assert waiting[4] == 10
+
+    def test_rebase(self):
+        ws = W.make_window(SPEC, R, N_EVENTS)
+        ws = add_pass(ws, 10_000, res=0, n=5)
+        ws2 = W.rebase(ws, 4_000)
+        assert pass_sum(ws2, 6_000)[0] == 5

@@ -23,12 +23,21 @@ class Clock:
     def now_ms(self) -> int:
         raise NotImplementedError
 
+    def wait_ms(self, ms: float) -> None:
+        """Block for ``ms`` (traffic shapers queueing requests). Virtual clocks
+        advance instead of sleeping, keeping shaper tests instantaneous."""
+        raise NotImplementedError
+
 
 class SystemClock(Clock):
     __slots__ = ()
 
     def now_ms(self) -> int:
         return time.time_ns() // 1_000_000
+
+    def wait_ms(self, ms: float) -> None:
+        if ms > 0:
+            time.sleep(ms / 1000.0)
 
 
 class ManualClock(Clock):
@@ -42,6 +51,10 @@ class ManualClock(Clock):
 
     def now_ms(self) -> int:
         return self._ms
+
+    def wait_ms(self, ms: float) -> None:
+        if ms > 0:
+            self._ms += int(ms)
 
     def set_ms(self, ms: int) -> None:
         self._ms = int(ms)

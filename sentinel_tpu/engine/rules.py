@@ -1,0 +1,175 @@
+"""Rules as padded device tensors.
+
+The reference token server holds ``flowId → FlowRule`` maps
+(``ClusterFlowRuleManager.java:46-235``); reloading rules must not retrace the
+jitted step, so the device sees only fixed-shape arrays. The host keeps the
+``flow_id → slot`` assignment (slots are stable across reloads for unchanged
+rules, so sliding-window history survives a rule update — the reference gets
+this by keeping ``ClusterMetric`` objects keyed by flowId).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.engine.config import EngineConfig
+
+
+class ThresholdMode(enum.IntEnum):
+    # ClusterFlowConfig.thresholdType (ClusterRuleConstant): AVG_LOCAL
+    # multiplies the per-client count by the connected-client count
+    # (ClusterFlowChecker.java:43-47); GLOBAL uses count as-is.
+    AVG_LOCAL = 0
+    GLOBAL = 1
+
+
+@dataclass(frozen=True)
+class ClusterFlowRule:
+    """Host-side cluster rule (``FlowRule`` + ``ClusterFlowConfig`` subset).
+
+    ``mode`` defaults to AVG_LOCAL like the reference's
+    ``ClusterFlowConfig.thresholdType`` — a rule set ported from Sentinel with
+    the field omitted keeps its count × connected-clients semantics.
+    """
+
+    flow_id: int
+    count: float
+    mode: ThresholdMode = ThresholdMode.AVG_LOCAL
+    namespace: str = "default"
+
+
+class RuleTable(NamedTuple):
+    """Device tensors, all shaped ``[max_flows]`` (+ ``[max_namespaces]``)."""
+
+    valid: jax.Array  # bool — slot holds an active rule
+    count: jax.Array  # float32 — rule threshold (per-client for AVG_LOCAL)
+    mode: jax.Array  # int8 — ThresholdMode
+    namespace_id: jax.Array  # int32
+    ns_max_qps: jax.Array  # float32 [NS] — GlobalRequestLimiter threshold
+    ns_connected: jax.Array  # int32 [NS] — connected client count (AVG_LOCAL)
+
+
+class RuleIndex:
+    """Host-side flow_id → slot assignment (stable across reloads)."""
+
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        self._lock = threading.RLock()
+        self.slot_of: Dict[int, int] = {}
+        self.ns_of: Dict[str, int] = {}
+        self._free = list(range(config.max_flows - 1, -1, -1))
+        # Slots freed by a reload still hold the removed flow's window history
+        # (and possibly pending future borrows); they MUST be zeroed in the
+        # engine state before reuse — callers drain this via
+        # ``drain_pending_clear(index, state)`` after every build.
+        self.pending_clear: List[int] = []
+
+    def namespace_slot(self, namespace: str) -> int:
+        with self._lock:
+            ns = self.ns_of.get(namespace)
+            if ns is None:
+                if len(self.ns_of) >= self.config.max_namespaces:
+                    raise ValueError("namespace capacity exceeded")
+                ns = self.ns_of[namespace] = len(self.ns_of)
+            return ns
+
+    def assign(self, flow_id: int) -> int:
+        with self._lock:
+            slot = self.slot_of.get(flow_id)
+            if slot is None:
+                if not self._free:
+                    raise ValueError("flow rule capacity exceeded")
+                slot = self._free.pop()
+                self.slot_of[flow_id] = slot
+            return slot
+
+    def release_missing(self, live_flow_ids) -> List[int]:
+        """Free slots whose flow_id is no longer present; returns freed slots."""
+        live = set(live_flow_ids)
+        freed = []
+        with self._lock:
+            for fid in list(self.slot_of):
+                if fid not in live:
+                    slot = self.slot_of.pop(fid)
+                    self._free.append(slot)
+                    freed.append(slot)
+            self.pending_clear.extend(freed)
+        return freed
+
+    def lookup(self, flow_id: int) -> int:
+        """Slot for a flow_id, or -1 (→ NO_RULE verdict)."""
+        return self.slot_of.get(flow_id, -1)
+
+
+def build_rule_table(
+    config: EngineConfig,
+    rules: List[ClusterFlowRule],
+    index: Optional[RuleIndex] = None,
+    ns_max_qps: float = 30_000.0,
+    connected: Optional[Dict[str, int]] = None,
+) -> tuple:
+    """Build/refresh the device rule table. Returns ``(table, index)``.
+
+    ``ns_max_qps`` defaults to the reference's namespace self-protection cap
+    (``ServerFlowConfig.java:31``).
+
+    After a rebuild, call ``drain_pending_clear(index, state)`` so slots freed
+    by removed rules are zeroed before a new flow_id reuses them — otherwise
+    the new flow inherits the removed flow's live window history.
+    """
+    index = index or RuleIndex(config)
+    index.release_missing(r.flow_id for r in rules)
+
+    valid = np.zeros(config.max_flows, dtype=bool)
+    count = np.zeros(config.max_flows, dtype=np.float32)
+    mode = np.zeros(config.max_flows, dtype=np.int8)
+    namespace_id = np.zeros(config.max_flows, dtype=np.int32)
+    ns_max = np.full(config.max_namespaces, float(ns_max_qps), dtype=np.float32)
+    ns_conn = np.ones(config.max_namespaces, dtype=np.int32)
+    for rule in rules:
+        slot = index.assign(rule.flow_id)
+        ns = index.namespace_slot(rule.namespace)
+        valid[slot] = True
+        count[slot] = rule.count
+        mode[slot] = int(rule.mode)
+        namespace_id[slot] = ns
+    for ns_name, n in (connected or {}).items():
+        ns_conn[index.namespace_slot(ns_name)] = max(1, int(n))
+    table = RuleTable(
+        valid=jnp.asarray(valid),
+        count=jnp.asarray(count),
+        mode=jnp.asarray(mode),
+        namespace_id=jnp.asarray(namespace_id),
+        ns_max_qps=jnp.asarray(ns_max),
+        ns_connected=jnp.asarray(ns_conn),
+    )
+    return table, index
+
+
+def drain_pending_clear(index: RuleIndex, state) -> "object":
+    """Zero the window history of slots freed by rule reloads; returns the
+    updated EngineState. Idempotent; call after every ``build_rule_table``."""
+    with index._lock:
+        slots, index.pending_clear = index.pending_clear, []
+    if not slots:
+        return state
+    import jax.numpy as _jnp
+
+    from sentinel_tpu.engine.state import EngineState
+    from sentinel_tpu.stats.window import WindowState
+
+    idx = _jnp.asarray(np.asarray(slots, dtype=np.int32))
+    flow_counts = state.flow.counts.at[idx].set(0)
+    occupy_counts = state.occupy.counts.at[idx].set(0)
+    return EngineState(
+        flow=WindowState(starts=state.flow.starts, counts=flow_counts),
+        occupy=WindowState(starts=state.occupy.starts, counts=occupy_counts),
+        ns=state.ns,
+    )

@@ -1,0 +1,52 @@
+"""Engine state: all mutable counters as one pytree of device arrays.
+
+The analog of the reference's ``ClusterMetricStatistics`` registry of
+per-flowId ``ClusterMetric`` LeapArrays (``metric/ClusterMetric.java:28-79``)
+— flattened into ``[max_flows, n_buckets, events]`` tensors plus a
+``[max_namespaces, n_buckets, 1]`` tensor for the namespace guard
+(``GlobalRequestLimiter``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.engine.config import EngineConfig
+from sentinel_tpu.stats.window import WindowSpec, WindowState, make_window
+
+
+class ClusterEvent(enum.IntEnum):
+    """``ClusterFlowEvent`` (``ClusterMetricBucket``): PASS counts tokens,
+    PASS_REQUEST counts RPCs (a request may acquire N tokens)."""
+
+    PASS = 0
+    PASS_REQUEST = 1
+    BLOCK = 2
+    BLOCK_REQUEST = 3
+    OCCUPIED_PASS = 4
+
+
+N_CLUSTER_EVENTS = len(ClusterEvent)
+
+
+class EngineState(NamedTuple):
+    flow: WindowState  # [F, B, E] current windows
+    occupy: WindowState  # [F, B, 1] future (borrowed) windows
+    ns: WindowState  # [NS, B, 1] namespace request qps guard
+
+
+def flow_spec(config: EngineConfig) -> WindowSpec:
+    return WindowSpec(bucket_ms=config.bucket_ms, n_buckets=config.n_buckets)
+
+
+def make_state(config: EngineConfig) -> EngineState:
+    spec = flow_spec(config)
+    return EngineState(
+        flow=make_window(spec, config.max_flows, N_CLUSTER_EVENTS),
+        occupy=make_window(spec, config.max_flows, 1),
+        ns=make_window(spec, config.max_namespaces, 1),
+    )

@@ -1,0 +1,39 @@
+"""Cluster flow control: token client/server (analog of ``sentinel-cluster``).
+
+- ``protocol``: binary wire codec (5 request types, length-prefixed frames —
+  the shape of ``sentinel-cluster-common-default``'s netty codec).
+- ``token_service``: the ``TokenService`` SPI and its engine-backed default
+  (``DefaultTokenService.java:36`` analog whose decision path is the jitted
+  ``sentinel_tpu.engine.decide`` kernel).
+- ``server``: asyncio transport + micro-batcher (``NettyTransportServer``
+  analog; the batcher is the host front door that turns the 20ms RPC budget
+  into ≤~1ms device batches).
+- ``client``: sync token client with xid-correlated responses, timeout and
+  reconnect (``DefaultClusterTokenClient``/``NettyTransportClient`` analog).
+- ``api``: process-global cluster state (CLIENT/SERVER/OFF) consumed by the
+  local flow checker's cluster branch (``ClusterStateManager`` analog).
+"""
+
+from sentinel_tpu.cluster.token_service import (
+    TokenResult,
+    TokenService,
+    DefaultTokenService,
+)
+from sentinel_tpu.cluster.api import (
+    ClusterMode,
+    get_mode,
+    set_client,
+    set_embedded_server,
+    set_mode,
+)
+
+__all__ = [
+    "TokenResult",
+    "TokenService",
+    "DefaultTokenService",
+    "ClusterMode",
+    "get_mode",
+    "set_mode",
+    "set_client",
+    "set_embedded_server",
+]

@@ -1,0 +1,98 @@
+"""Process-global cluster state + the local flow checker's cluster branch.
+
+Analog of ``ClusterStateManager.java:38-86`` (mode CLIENT=0 / SERVER=1) and
+the verdict-application half of ``FlowRuleChecker.passClusterCheck``
+(``FlowRuleChecker.java:147-208``).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Optional
+
+from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.engine import TokenStatus
+
+
+class ClusterMode(enum.IntEnum):
+    NOT_STARTED = -1
+    CLIENT = 0
+    SERVER = 1  # embedded token server
+
+
+_lock = threading.RLock()
+_mode = ClusterMode.NOT_STARTED
+_client: Optional[TokenService] = None
+_embedded: Optional[TokenService] = None
+
+
+def set_client(client: TokenService) -> None:
+    global _client, _mode
+    with _lock:
+        _client = client
+        _mode = ClusterMode.CLIENT
+
+
+def set_embedded_server(service: TokenService) -> None:
+    global _embedded, _mode
+    with _lock:
+        _embedded = service
+        _mode = ClusterMode.SERVER
+
+
+def set_mode(mode: ClusterMode) -> None:
+    global _mode
+    with _lock:
+        _mode = mode
+
+
+def get_mode() -> ClusterMode:
+    return _mode
+
+
+def _pick_service() -> Optional[TokenService]:
+    """``FlowRuleChecker.pickClusterService`` (``:176-184``)."""
+    if _mode == ClusterMode.CLIENT:
+        return _client
+    if _mode == ClusterMode.SERVER:
+        return _embedded
+    return None
+
+
+def reset_for_tests() -> None:
+    global _mode, _client, _embedded
+    with _lock:
+        _mode = ClusterMode.NOT_STARTED
+        _client = None
+        _embedded = None
+
+
+# -- called from sentinel_tpu.local.flow ------------------------------------
+
+
+def request_token(rule, acquire: int, prioritized: bool) -> Optional[TokenResult]:
+    service = _pick_service()
+    if service is None:
+        return None
+    flow_id = (rule.cluster_config or {}).get("flow_id")
+    if flow_id is None:
+        return None
+    return service.request_token(int(flow_id), acquire, prioritized)
+
+
+def apply_token_result(
+    result: TokenResult, rule, context, node, acquire, prioritized, fallback
+) -> bool:
+    """``FlowRuleChecker.applyTokenResult`` (``:186-208``): OK → pass;
+    SHOULD_WAIT → sleep the hint then pass; BLOCKED → block; anything else
+    (FAIL / NO_RULE / TOO_MANY) → local fallback or pass-through."""
+    if result.status == TokenStatus.OK:
+        return True
+    if result.status == TokenStatus.SHOULD_WAIT:
+        _clock.get_clock().wait_ms(result.wait_ms)
+        return True
+    if result.status == TokenStatus.BLOCKED:
+        return False
+    return fallback(rule, context, node, acquire, prioritized)

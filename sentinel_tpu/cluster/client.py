@@ -1,0 +1,164 @@
+"""Sync token client with xid-correlated responses, timeout and reconnect.
+
+Analog of ``DefaultClusterTokenClient.java:45`` over
+``NettyTransportClient.java:61``: an atomic xid generator, a pending-promise
+map (``TokenClientPromiseHolder.java:30-50``), a hard request timeout
+defaulting to the reference's 20ms (``ClusterConstants.java:44``), and
+lazy reconnect with linear backoff (``NettyTransportClient.java:67``).
+
+The client is sync because its caller is the (sync) flow-checker hot path; a
+background thread owns the socket read side.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.engine import TokenStatus
+
+RECONNECT_DELAY_S = 2.0  # NettyTransportClient.RECONNECT_DELAY_MS analog
+
+
+class _Pending:
+    __slots__ = ("event", "response")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.response: Optional[P.FlowResponse] = None
+
+
+class TokenClient(TokenService):
+    def __init__(self, host: str, port: int, timeout_ms: int = 20):
+        self.host = host
+        self.port = port
+        self.timeout_ms = timeout_ms
+        self._xid = itertools.count(1)
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._reader: Optional[threading.Thread] = None
+        self._last_connect_attempt = 0.0
+
+    # -- connection management ---------------------------------------------
+    def _ensure_connected(self) -> bool:
+        if self._sock is not None:
+            return True
+        with self._state_lock:
+            if self._sock is not None:
+                return True
+            now = time.monotonic()
+            if now - self._last_connect_attempt < RECONNECT_DELAY_S:
+                return False
+            self._last_connect_attempt = now
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=1.0
+                )
+                # create_connection leaves its connect timeout on the socket;
+                # the reader must block indefinitely or idle periods kill the
+                # connection with socket.timeout (an OSError)
+                sock.settimeout(None)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = sock
+            except OSError as e:
+                record_log.warning("token server unreachable: %s", e)
+                return False
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(sock,), daemon=True,
+                name="sentinel-token-client-reader",
+            )
+            self._reader.start()
+            return True
+
+    def _drop_connection(self, sock: socket.socket) -> None:
+        with self._state_lock:
+            if self._sock is sock:
+                self._sock = None
+        try:
+            sock.close()
+        except OSError:
+            pass
+        # fail all waiters so they fall back immediately instead of timing out
+        for pending in list(self._pending.values()):
+            pending.event.set()
+
+    def close(self) -> None:
+        sock = self._sock
+        if sock is not None:
+            self._drop_connection(sock)
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        frames = P.FrameReader()
+        try:
+            while True:
+                data = sock.recv(4096)
+                if not data:
+                    break
+                for payload in frames.feed(data):
+                    rsp = P.decode_response(payload)
+                    pending = self._pending.get(rsp.xid)
+                    if pending is not None:
+                        pending.response = rsp
+                        pending.event.set()
+        except OSError:
+            pass
+        finally:
+            self._drop_connection(sock)
+
+    # -- TokenService -------------------------------------------------------
+    def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
+        rsp = self._roundtrip(
+            P.FlowRequest(next(self._xid), flow_id, acquire, prioritized)
+        )
+        if rsp is None:
+            return TokenResult(TokenStatus.FAIL)
+        return TokenResult(TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms)
+
+    def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
+        rsp = self._roundtrip(
+            P.FlowRequest(
+                next(self._xid), flow_id, acquire, False,
+                P.MsgType.PARAM_FLOW, tuple(param_hashes),
+            )
+        )
+        if rsp is None:
+            return TokenResult(TokenStatus.FAIL)
+        return TokenResult(TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms)
+
+    def ping(self) -> bool:
+        return self._roundtrip(P.Ping(next(self._xid))) is not None
+
+    def _roundtrip(self, req) -> Optional[P.FlowResponse]:
+        """Correlated request/response: register pending, send, wait, pop."""
+        pending = _Pending()
+        self._pending[req.xid] = pending
+        try:
+            if not self._send(P.encode_request(req)):
+                return None
+            if not pending.event.wait(self.timeout_ms / 1000.0):
+                return None  # timeout → caller falls back (20ms budget blown)
+            return pending.response
+        finally:
+            self._pending.pop(req.xid, None)
+
+    def _send(self, data: bytes) -> bool:
+        if not self._ensure_connected():
+            return False
+        sock = self._sock
+        if sock is None:
+            return False
+        try:
+            with self._send_lock:
+                sock.sendall(data)
+            return True
+        except OSError:
+            self._drop_connection(sock)
+            return False

@@ -1,0 +1,138 @@
+"""Binary wire protocol for the token RPC.
+
+Same shape as the reference's netty codec (``sentinel-cluster-common-default``):
+a 2-byte big-endian length prefix (``LengthFieldBasedFrameDecoder(1024,0,2,0,2)``,
+``NettyTransportServer.java:73-101``), then::
+
+    | xid: int32 | type: uint8 | data... |
+
+Request types (``ClusterConstants.java:24-28``): PING=0, FLOW=1, PARAM_FLOW=2,
+CONCURRENT_ACQUIRE=3, CONCURRENT_RELEASE=4.
+
+Flow request data  = ``flow_id:int64, count:int32, priority:uint8``
+(``FlowRequestDataWriter.java:35-37``); flow responses carry
+``status:int8, remaining:int32, wait_ms:int32`` (the reference moves status in
+the response envelope and ``remaining/waitInMs`` in data,
+``FlowResponseDataWriter.java:31-32`` — flattened here).
+
+Param-flow request data = flow request + ``n_params:uint8`` + per-param
+``hash:int64`` (the TPU server sketches param *hashes*; raw values never cross
+the wire — see SURVEY.md §5 long-context note).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+MAX_FRAME = 1024
+_HEAD = struct.Struct(">ib")  # xid, type
+_FLOW_REQ = struct.Struct(">qib")  # flow_id, count, priority
+_FLOW_RSP = struct.Struct(">bii")  # status, remaining, wait_ms
+_LEN = struct.Struct(">H")
+
+
+class MsgType(enum.IntEnum):
+    PING = 0
+    FLOW = 1
+    PARAM_FLOW = 2
+    CONCURRENT_ACQUIRE = 3
+    CONCURRENT_RELEASE = 4
+
+
+@dataclass(frozen=True)
+class FlowRequest:
+    xid: int
+    flow_id: int
+    count: int = 1
+    prioritized: bool = False
+    msg_type: MsgType = MsgType.FLOW
+    param_hashes: Tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class FlowResponse:
+    xid: int
+    msg_type: MsgType
+    status: int
+    remaining: int = 0
+    wait_ms: int = 0
+
+
+@dataclass(frozen=True)
+class Ping:
+    xid: int
+
+
+def encode_request(req) -> bytes:
+    if isinstance(req, Ping):
+        payload = _HEAD.pack(req.xid, MsgType.PING)
+    elif isinstance(req, FlowRequest):
+        payload = _HEAD.pack(req.xid, req.msg_type) + _FLOW_REQ.pack(
+            req.flow_id, req.count, 1 if req.prioritized else 0
+        )
+        if req.msg_type == MsgType.PARAM_FLOW:
+            payload += struct.pack(">B", len(req.param_hashes))
+            for h in req.param_hashes:
+                payload += struct.pack(">q", h)
+    else:
+        raise TypeError(f"unknown request {req!r}")
+    if len(payload) > MAX_FRAME:
+        raise ValueError("frame too large")
+    return _LEN.pack(len(payload)) + payload
+
+
+def encode_response(rsp: FlowResponse) -> bytes:
+    payload = _HEAD.pack(rsp.xid, rsp.msg_type) + _FLOW_RSP.pack(
+        rsp.status, rsp.remaining, rsp.wait_ms
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_request(payload: bytes):
+    xid, mtype = _HEAD.unpack_from(payload, 0)
+    mtype = MsgType(mtype)
+    if mtype == MsgType.PING:
+        return Ping(xid)
+    if mtype in (MsgType.FLOW, MsgType.CONCURRENT_ACQUIRE, MsgType.CONCURRENT_RELEASE):
+        flow_id, count, prio = _FLOW_REQ.unpack_from(payload, _HEAD.size)
+        return FlowRequest(xid, flow_id, count, bool(prio), mtype)
+    if mtype == MsgType.PARAM_FLOW:
+        off = _HEAD.size
+        flow_id, count, prio = _FLOW_REQ.unpack_from(payload, off)
+        off += _FLOW_REQ.size
+        (n,) = struct.unpack_from(">B", payload, off)
+        off += 1
+        hashes = struct.unpack_from(f">{n}q", payload, off) if n else ()
+        return FlowRequest(xid, flow_id, count, bool(prio), mtype, tuple(hashes))
+    raise ValueError(f"unknown message type {mtype}")
+
+
+def decode_response(payload: bytes) -> FlowResponse:
+    xid, mtype = _HEAD.unpack_from(payload, 0)
+    status, remaining, wait_ms = _FLOW_RSP.unpack_from(payload, _HEAD.size)
+    return FlowResponse(xid, MsgType(mtype), status, remaining, wait_ms)
+
+
+class FrameReader:
+    """Incremental length-prefixed frame splitter for a byte stream."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf.extend(data)
+        frames = []
+        while True:
+            if len(self._buf) < _LEN.size:
+                break
+            (n,) = _LEN.unpack_from(self._buf, 0)
+            if n > MAX_FRAME:
+                raise ValueError("oversized frame")
+            if len(self._buf) < _LEN.size + n:
+                break
+            frames.append(bytes(self._buf[_LEN.size : _LEN.size + n]))
+            del self._buf[: _LEN.size + n]
+        return frames

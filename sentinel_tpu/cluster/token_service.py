@@ -1,0 +1,184 @@
+"""TokenService SPI and its engine-backed default implementation.
+
+Analogs: ``sentinel-core/.../cluster/TokenService.java`` (the SPI seam),
+``TokenResult``/``TokenResultStatus``, and the server-side
+``DefaultTokenService.java:36-97`` whose per-request logic is replaced by the
+jitted batch kernel ``sentinel_tpu.engine.decide``.
+
+Both deployment shapes of the reference exist here:
+- **standalone** (``SentinelDefaultTokenServer``): ``server.TokenServer``
+  wraps a ``DefaultTokenService`` behind the TCP front door;
+- **embedded** (``DefaultEmbeddedTokenServer``): the same object serves
+  in-process calls from the local flow checker *and* remote clients.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.engine import (
+    ClusterFlowRule,
+    EngineConfig,
+    TokenStatus,
+    build_rule_table,
+    decide,
+    drain_pending_clear,
+    make_batch,
+    make_state,
+)
+from sentinel_tpu.engine.rules import RuleIndex
+
+
+@dataclass(frozen=True)
+class TokenResult:
+    """``TokenResult.java`` — status + remaining + wait hint."""
+
+    status: TokenStatus
+    remaining: int = 0
+    wait_ms: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == TokenStatus.OK
+
+
+class TokenService:
+    """The SPI: local flow checkers and the transport both speak this."""
+
+    def request_token(
+        self, flow_id: int, acquire: int = 1, prioritized: bool = False
+    ) -> TokenResult:
+        raise NotImplementedError
+
+    def request_params_token(
+        self, flow_id: int, acquire: int, param_hashes: Sequence[int]
+    ) -> TokenResult:
+        raise NotImplementedError
+
+    def request_batch(
+        self, requests: Sequence[Tuple[int, int, bool]]
+    ) -> List[TokenResult]:
+        """Vectorized form: list of (flow_id, acquire, prioritized)."""
+        return [self.request_token(f, a, p) for f, a, p in requests]
+
+
+class DefaultTokenService(TokenService):
+    """Engine-backed token service.
+
+    The reference hot loop (rule lookup → LeapArray read-sum → LongAdder adds,
+    ``ClusterFlowChecker.java:55-120``) runs as one device step per
+    micro-batch; this class owns the device state and the host-side
+    flow_id → slot index, and serializes steps with a lock (single-writer —
+    the race-free analog of the JVM's CAS storm).
+    """
+
+    def __init__(self, config: Optional[EngineConfig] = None):
+        self.config = config or EngineConfig()
+        self._lock = threading.Lock()
+        self._state = make_state(self.config)
+        self._table, self._index = build_rule_table(self.config, [])
+        self._epoch_ms: Optional[int] = None
+        self._connected: Dict[str, int] = {}  # namespace → client count
+        self._ns_max_qps = 30_000.0
+
+    # -- rule management (ClusterFlowRuleManager analog) --------------------
+    def load_rules(
+        self,
+        rules: List[ClusterFlowRule],
+        ns_max_qps: Optional[float] = None,
+        connected: Optional[Dict[str, int]] = None,
+    ) -> None:
+        with self._lock:
+            if ns_max_qps is not None:
+                self._ns_max_qps = ns_max_qps
+            if connected is not None:
+                self._connected.update(connected)
+            self._table, self._index = build_rule_table(
+                self.config, rules, index=self._index,
+                ns_max_qps=self._ns_max_qps, connected=self._connected,
+            )
+            self._state = drain_pending_clear(self._index, self._state)
+
+    def connected_count_changed(self, namespace: str, n: int) -> None:
+        """``ConnectionManager`` callback: AVG_LOCAL thresholds scale with it.
+        Counts persist across rule reloads. Namespaces no rule uses are
+        remembered host-side but allocate no device slot."""
+        with self._lock:
+            self._connected[namespace] = max(1, int(n))
+            ns = self._index.ns_of.get(namespace)
+            if ns is None:
+                return  # no rule in this namespace yet; applied on next load
+            conn = np.array(self._table.ns_connected)  # writable copy
+            conn[ns] = max(1, int(n))
+            self._table = self._table._replace(ns_connected=jnp.asarray(conn))
+
+    # -- time ---------------------------------------------------------------
+    def _engine_now(self) -> int:
+        """Engine-relative int32 ms (see stats.window docstring on rebase)."""
+        wall = _clock.now_ms()
+        if self._epoch_ms is None:
+            self._epoch_ms = wall - 1  # keep engine time strictly positive
+        return wall - self._epoch_ms
+
+    # -- decision path ------------------------------------------------------
+    def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
+        return self.request_batch([(flow_id, acquire, prioritized)])[0]
+
+    def request_batch(self, requests) -> List[TokenResult]:
+        if not requests:
+            return []
+        n = len(requests)
+        cap = self.config.batch_size
+        if n > cap:  # split oversized bursts
+            out = []
+            for i in range(0, n, cap):
+                out.extend(self.request_batch(requests[i : i + cap]))
+            return out
+        with self._lock:
+            slots = [self._index.lookup(f) for f, _, _ in requests]
+            batch = make_batch(
+                self.config,
+                slots,
+                [a for _, a, _ in requests],
+                [p for _, _, p in requests],
+            )
+            now = self._engine_now()
+            self._state, verdicts = decide(
+                self.config, self._state, self._table, batch, jnp.int32(now)
+            )
+        status = np.asarray(verdicts.status)
+        remaining = np.asarray(verdicts.remaining)
+        wait = np.asarray(verdicts.wait_ms)
+        return [
+            TokenResult(TokenStatus(int(status[i])), int(remaining[i]), int(wait[i]))
+            for i in range(n)
+        ]
+
+    def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
+        # wired to the count-min sketch engine in the param-flow milestone
+        return TokenResult(TokenStatus.NO_RULE_EXISTS)
+
+    # -- introspection (FetchClusterMetricCommandHandler analog) ------------
+    def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:
+        from sentinel_tpu.engine.state import ClusterEvent, flow_spec
+        from sentinel_tpu.stats import window as W
+
+        with self._lock:
+            now = self._engine_now()
+            spec = flow_spec(self.config)
+            sums = np.asarray(W.window_sum_all(spec, self._state.flow, jnp.int32(now)))
+            interval_s = spec.interval_ms / 1000.0
+            out = {}
+            for fid, slot in self._index.slot_of.items():
+                out[fid] = {
+                    "pass_qps": float(sums[slot, ClusterEvent.PASS]) / interval_s,
+                    "block_qps": float(sums[slot, ClusterEvent.BLOCK]) / interval_s,
+                    "pass_req_qps": float(sums[slot, ClusterEvent.PASS_REQUEST]) / interval_s,
+                }
+            return out

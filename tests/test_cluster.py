@@ -1,0 +1,262 @@
+"""Cluster token client/server tests.
+
+Mirrors the reference strategy (SURVEY.md §4): checker logic is tested through
+the service directly with a fake clock; the transport is tested over a real
+localhost socket (improving on the reference, which never socket-tests);
+codec round-trips mirror ``FlowResponseDataDecoderTest``.
+"""
+
+import threading
+import time
+
+import pytest
+
+import sentinel_tpu.local as sentinel
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster import api as cluster_api
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.server import TokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.local import BlockException, FlowRule, FlowRuleManager
+
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+G = ThresholdMode.GLOBAL
+
+
+class TestCodec:
+    def test_flow_roundtrip(self):
+        req = P.FlowRequest(xid=7, flow_id=12345678901, count=3, prioritized=True)
+        decoded = P.decode_request(P.encode_request(req)[2:])
+        assert decoded == req
+
+    def test_param_flow_roundtrip(self):
+        req = P.FlowRequest(
+            xid=9, flow_id=42, count=1, prioritized=False,
+            msg_type=P.MsgType.PARAM_FLOW, param_hashes=(123, -456, 2**60),
+        )
+        decoded = P.decode_request(P.encode_request(req)[2:])
+        assert decoded == req
+
+    def test_response_roundtrip(self):
+        rsp = P.FlowResponse(5, P.MsgType.FLOW, int(TokenStatus.SHOULD_WAIT), 17, 250)
+        assert P.decode_response(P.encode_response(rsp)[2:]) == rsp
+
+    def test_frame_reader_reassembles_partial(self):
+        req = P.encode_request(P.Ping(1)) + P.encode_request(P.Ping(2))
+        fr = P.FrameReader()
+        frames = []
+        for i in range(0, len(req), 3):  # drip-feed 3 bytes at a time
+            frames.extend(fr.feed(req[i : i + 3]))
+        assert [P.decode_request(f).xid for f in frames] == [1, 2]
+
+    def test_oversized_frame_rejected(self):
+        fr = P.FrameReader()
+        with pytest.raises(ValueError):
+            fr.feed(b"\xff\xff" + b"x" * 100)
+
+
+class TestTokenServiceDirect:
+    """Service-level checker tests with a fake clock (ClusterFlowCheckerTest)."""
+
+    def test_verdicts(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=2.0, mode=G)])
+        assert svc.request_token(1).ok
+        assert svc.request_token(1).ok
+        r = svc.request_token(1)
+        assert r.status == TokenStatus.BLOCKED
+        manual_clock.sleep(1100)
+        assert svc.request_token(1).ok
+
+    def test_no_rule(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        assert svc.request_token(404).status == TokenStatus.NO_RULE_EXISTS
+
+    def test_batch_split_beyond_capacity(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=1000.0, mode=G)])
+        results = svc.request_batch([(1, 1, False)] * 150)  # > batch_size 64
+        assert len(results) == 150
+        assert all(r.ok for r in results)
+
+    def test_avg_local_with_connected_count(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=5, count=3.0, mode=ThresholdMode.AVG_LOCAL)]
+        )
+        svc.connected_count_changed("default", 2)
+        results = svc.request_batch([(5, 1, False)] * 10)
+        assert sum(r.ok for r in results) == 6  # 3 × 2 clients
+
+    def test_metrics_snapshot(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=5.0, mode=G)])
+        svc.request_batch([(1, 1, False)] * 8)
+        snap = svc.metrics_snapshot()
+        assert snap[1]["pass_qps"] == 5.0
+        assert snap[1]["block_qps"] == 3.0
+
+
+class TestReviewRegressions:
+    def test_connected_count_survives_rule_reload(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=5, count=3.0, mode=ThresholdMode.AVG_LOCAL)]
+        )
+        svc.connected_count_changed("default", 3)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=5, count=4.0, mode=ThresholdMode.AVG_LOCAL)]
+        )
+        results = svc.request_batch([(5, 1, False)] * 20)
+        assert sum(r.ok for r in results) == 12  # 4 × 3 clients, not 4 × 1
+
+    def test_connected_count_unknown_namespace_is_deferred(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.connected_count_changed("ns-without-rules", 7)  # must not raise
+        svc.load_rules(
+            [
+                ClusterFlowRule(
+                    flow_id=9, count=2.0, mode=ThresholdMode.AVG_LOCAL,
+                    namespace="ns-without-rules",
+                )
+            ]
+        )
+        results = svc.request_batch([(9, 1, False)] * 20)
+        assert sum(r.ok for r in results) == 14  # 2 × 7 applied on load
+
+    def test_bind_failure_raises_with_cause_and_allows_retry(self):
+        svc = DefaultTokenService(CFG)
+        s1 = TokenServer(svc, port=0)
+        s1.start()
+        try:
+            s2 = TokenServer(svc, port=s1.port)
+            with pytest.raises(RuntimeError, match="failed to start"):
+                s2.start()
+            # state reset: a later start on a free port succeeds
+            s2.port = 0
+            s2.start()
+            s2.stop()
+        finally:
+            s1.stop()
+
+    def test_concurrent_msgs_fail_without_consuming_flow_budget(self, live_server):
+        server, svc = live_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            rsp = client._roundtrip(
+                P.FlowRequest(
+                    next(client._xid), 1, 1, False, P.MsgType.CONCURRENT_ACQUIRE
+                )
+            )
+            assert rsp is not None and rsp.status == int(TokenStatus.FAIL)
+            # flow budget untouched: all 5 still available
+            oks = sum(client.request_token(1).ok for _ in range(6))
+            assert oks == 5
+        finally:
+            client.close()
+
+
+@pytest.fixture
+def live_server():
+    svc = DefaultTokenService(CFG)
+    svc.load_rules([ClusterFlowRule(flow_id=1, count=5.0, mode=G)])
+    server = TokenServer(svc, port=0, batch_window_ms=0.5)
+    server.start()
+    yield server, svc
+    server.stop()
+
+
+class TestTransport:
+    def test_client_server_roundtrip(self, live_server):
+        server, svc = live_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            assert client.ping()
+            results = [client.request_token(1) for _ in range(8)]
+            assert sum(r.ok for r in results) == 5
+            assert sum(r.status == TokenStatus.BLOCKED for r in results) == 3
+        finally:
+            client.close()
+
+    def test_concurrent_clients_share_budget(self, live_server):
+        server, svc = live_server
+        results = []
+        lock = threading.Lock()
+
+        def worker():
+            client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+            try:
+                mine = [client.request_token(1) for _ in range(4)]
+                with lock:
+                    results.extend(mine)
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(r.ok for r in results) == 5  # global budget across clients
+        assert len(results) == 16
+
+    def test_timeout_returns_fail(self):
+        client = TokenClient("127.0.0.1", 1, timeout_ms=50)  # nothing listening
+        r = client.request_token(1)
+        assert r.status == TokenStatus.FAIL
+        client.close()
+
+
+class TestEmbeddedClusterFlow:
+    """Local flow checker + cluster_mode rule through the embedded service
+    (DefaultEmbeddedTokenServer shape)."""
+
+    @pytest.fixture(autouse=True)
+    def clean(self, manual_clock):
+        sentinel.reset_for_tests()
+        cluster_api.reset_for_tests()
+        yield manual_clock
+        cluster_api.reset_for_tests()
+        sentinel.reset_for_tests()
+
+    def test_cluster_verdict_enforced(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=77, count=2.0, mode=G)])
+        cluster_api.set_embedded_server(svc)
+        FlowRuleManager.load_rules(
+            [
+                FlowRule(
+                    resource="api", count=1000.0, cluster_mode=True,
+                    cluster_config={"flow_id": 77},
+                )
+            ]
+        )
+        ok = blocked = 0
+        for _ in range(5):
+            try:
+                with sentinel.entry("api"):
+                    ok += 1
+            except BlockException:
+                blocked += 1
+        assert (ok, blocked) == (2, 3)
+
+    def test_fallback_to_local_when_no_service(self, manual_clock):
+        # mode NOT_STARTED → cluster check falls back to local rule count
+        FlowRuleManager.load_rules(
+            [
+                FlowRule(
+                    resource="api2", count=3.0, cluster_mode=True,
+                    cluster_config={"flow_id": 88},
+                )
+            ]
+        )
+        ok = blocked = 0
+        for _ in range(5):
+            try:
+                with sentinel.entry("api2"):
+                    ok += 1
+            except BlockException:
+                blocked += 1
+        assert (ok, blocked) == (3, 2)

@@ -1,0 +1,124 @@
+"""Benchmark: cluster token-server decision throughput on one chip.
+
+Measures the steady-state device decision rate of the jitted token-verdict
+kernel at the BASELINE.md configuration (100k flow rules), and prints ONE
+JSON line.
+
+Baseline: the reference token server's default per-namespace self-protection
+cap of 30,000 decisions/s (``ServerFlowConfig.java:31``) — its own statement
+of per-server scale (BASELINE.md). The north-star target is ≥10M/s across a
+v5e-8, i.e. ≥1.25M/s per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.engine import (
+        ClusterFlowRule,
+        EngineConfig,
+        TokenStatus,
+        build_rule_table,
+        make_batch,
+        make_state,
+    )
+    from sentinel_tpu.engine.decide import _decide_core
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    n_flows = 100_000
+    config = EngineConfig(
+        max_flows=n_flows, max_namespaces=64, batch_size=16384
+    )
+
+    rules = [
+        ClusterFlowRule(
+            flow_id=i,
+            count=100.0 + (i % 100),
+            mode=ThresholdMode.GLOBAL,
+            namespace=f"ns{i % 64}",
+        )
+        for i in range(n_flows)
+    ]
+    table, index = build_rule_table(config, rules, ns_max_qps=1e9)
+    state = make_state(config)
+
+    # The server pipelines micro-batches back-to-back, so the capacity
+    # ceiling is the device's sustained batch rate — measured by scanning
+    # a chain of batches inside ONE dispatch (also sidesteps the ~100ms
+    # per-dispatch latency of the remote-tunnel dev setup, which a
+    # co-located server would not pay).
+    chain = 64  # batches per dispatch
+
+    def chained(state, stacked_batches, now0):
+        def body(carry, xs):
+            st, now = carry
+            batch = xs
+            st, verdicts = _decide_core(config, st, table, batch, now)
+            return (st, now + 1), verdicts.status
+
+        (state, _), statuses = jax.lax.scan(
+            body, (state, now0), stacked_batches
+        )
+        return state, statuses
+
+    step = jax.jit(chained, donate_argnums=(0,))
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(chain):
+        slots = rng.integers(0, n_flows, size=config.batch_size).tolist()
+        batches.append(make_batch(config, slots))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+    now = 10_000
+    # warmup / compile
+    state, statuses = step(state, stacked, jnp.int32(now))
+    jax.block_until_ready(statuses)
+    ok_frac = float((np.asarray(statuses[0]) == TokenStatus.OK).mean())
+    assert ok_frac > 0.5, f"warmup sanity: ok fraction {ok_frac}"
+
+    # timed steady state
+    repeats = 5
+    lat = []
+    t_total0 = time.perf_counter()
+    for i in range(repeats):
+        now += chain
+        t0 = time.perf_counter()
+        state, statuses = step(state, stacked, jnp.int32(now))
+        jax.block_until_ready(statuses)
+        lat.append(time.perf_counter() - t0)
+    total = time.perf_counter() - t_total0
+
+    decisions_per_sec = repeats * chain * config.batch_size / total
+    # per-batch device time: the latency a queued micro-batch experiences
+    p99_ms = float(min(lat) / chain * 1e3)
+    baseline = 30_000.0  # reference maxAllowedQps per namespace/server
+    print(
+        json.dumps(
+            {
+                "metric": "flow_decisions_per_sec_per_chip_at_100k_rules",
+                "value": round(decisions_per_sec),
+                "unit": "decisions/s",
+                "vs_baseline": round(decisions_per_sec / baseline, 2),
+                "extra": {
+                    "per_batch_device_ms": round(p99_ms, 3),
+                    "batch_size": config.batch_size,
+                    "backend": jax.devices()[0].platform,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

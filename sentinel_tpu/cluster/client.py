@@ -80,15 +80,19 @@ class TokenClient(TokenService):
 
     def _drop_connection(self, sock: socket.socket) -> None:
         with self._state_lock:
-            if self._sock is sock:
+            was_active = self._sock is sock
+            if was_active:
                 self._sock = None
         try:
             sock.close()
         except OSError:
             pass
-        # fail all waiters so they fall back immediately instead of timing out
-        for pending in list(self._pending.values()):
-            pending.event.set()
+        # Fail waiters so they fall back immediately instead of timing out —
+        # but only when the active connection died; a stale reader thread's
+        # exit must not abort in-flight requests on a newer connection.
+        if was_active:
+            for pending in list(self._pending.values()):
+                pending.event.set()
 
     def close(self) -> None:
         sock = self._sock

@@ -25,6 +25,7 @@ from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.engine import (
     ClusterFlowRule,
     EngineConfig,
+    EngineState,
     TokenStatus,
     build_rule_table,
     decide,
@@ -119,12 +120,29 @@ class DefaultTokenService(TokenService):
             self._table = self._table._replace(ns_connected=jnp.asarray(conn))
 
     # -- time ---------------------------------------------------------------
+    # int32 engine-ms wraps after ~24.8 days; re-base well before that.
+    # Callers hold self._lock.
+    _REBASE_AFTER_MS = 2**30  # ~12.4 days
+
     def _engine_now(self) -> int:
-        """Engine-relative int32 ms (see stats.window docstring on rebase)."""
+        """Engine-relative int32 ms; automatically re-bases the epoch (and
+        shifts all window starts) long before int32 wraparound."""
         wall = _clock.now_ms()
         if self._epoch_ms is None:
             self._epoch_ms = wall - 1  # keep engine time strictly positive
-        return wall - self._epoch_ms
+        now = wall - self._epoch_ms
+        if now > self._REBASE_AFTER_MS:
+            from sentinel_tpu.stats.window import rebase
+
+            delta = now - 60_000  # keep the last minute of history addressable
+            self._state = EngineState(
+                flow=rebase(self._state.flow, delta),
+                occupy=rebase(self._state.occupy, delta),
+                ns=rebase(self._state.ns, delta),
+            )
+            self._epoch_ms += delta
+            now -= delta
+        return now
 
     # -- decision path ------------------------------------------------------
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:

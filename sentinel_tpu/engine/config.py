@@ -26,6 +26,10 @@ class EngineConfig(NamedTuple):
     # the admission mask is a subset of the sequential-greedy set; decide()
     # rejects even values)
     admission_refine_iters: int = 3
+    # segment-prefix implementation: "matmul" ([N,N] masked matmuls — MXU
+    # eats these for free up to N≈8k), "sort" (argsort+cumsum, O(N log N),
+    # wins beyond), or "auto" (matmul for batch_size <= 8192)
+    prefix_impl: str = "auto"
 
     @property
     def interval_ms(self) -> int:

@@ -95,46 +95,113 @@ def make_batch(
     )
 
 
-def _prefix_mats(n: int):
-    """Strictly-lower triangular [N, N] mask (row i sees columns j < i)."""
-    i = jnp.arange(n)
-    strict = (i[:, None] > i[None, :]).astype(jnp.float32)
-    return strict
+def _segment_prefix_builder(keys: jax.Array, impl: str = "auto"):
+    """Build an exclusive segment-prefix-sum operator over batch order.
+
+    ``prefix(contrib)[i]`` = sum of ``contrib[j]`` for all ``j < i`` with
+    ``keys[j] == keys[i]`` — the in-batch "earlier same-flow tokens" quantity.
+
+    Two implementations (empirically on a v5e chip the matmul wins up to
+    N≈8k — the MXU makes the [N, N] masked matmul nearly free while sorts
+    are comparatively expensive; beyond that the O(N log N) sort wins and
+    avoids the [N, N] materialization entirely):
+
+    - ``matmul``: ``[N, N]`` same-key strictly-lower mask @ contrib.
+    - ``sort``: stable argsort + cumsum + per-segment rebase. Stable sort
+      preserves batch order within a segment, which the greedy-admission
+      semantics require.
+    """
+    n = keys.shape[0]
+    if impl == "auto":
+        impl = "matmul" if n <= 8192 else "sort"
+    if impl not in ("matmul", "sort"):
+        raise ValueError(f"unknown prefix_impl {impl!r}; use 'auto'|'matmul'|'sort'")
+
+    if impl == "matmul":
+        i = jnp.arange(n)
+        tri = (i[:, None] > i[None, :])
+        same = (keys[:, None] == keys[None, :]) & tri
+        mat = same.astype(jnp.float32)
+
+        def prefix_mat(contrib: jax.Array) -> jax.Array:
+            return mat @ contrib
+
+        return prefix_mat
+
+    order = jnp.argsort(keys, stable=True)
+    keys_sorted = keys[order]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), keys_sorted[1:] != keys_sorted[:-1]]
+    )
+    inv = jnp.argsort(order, stable=True)
+
+    def prefix_sort(contrib: jax.Array) -> jax.Array:
+        c = contrib[order]
+        incl = jnp.cumsum(c)
+        excl = incl - c
+        base = jax.lax.cummax(jnp.where(seg_start, excl, -jnp.inf))
+        return (excl - base)[inv]
+
+    return prefix_sort
 
 
-@partial(jax.jit, static_argnames=("config",))
-def decide(
+def _decide_core(
     config: EngineConfig,
     state: EngineState,
     rules: RuleTable,
     batch: RequestBatch,
     now: jax.Array,
+    axis_name: Optional[str] = None,
 ) -> tuple:
-    """``(state, rules, batch, now) -> (state', verdicts)`` — fully on device."""
+    """The decision pipeline, single-shard or mesh-sharded.
+
+    With ``axis_name`` set (inside ``shard_map`` over a mesh axis that shards
+    the flow dimension of ``state.flow``/``state.occupy`` and the per-flow
+    rule arrays), each device evaluates the requests whose flow slot it owns
+    and three ``psum``\\ s stitch the global picture together: rule ownership,
+    namespace ids, and the final verdicts. The namespace window is replicated
+    and updated identically on every device (its inputs are all global), so
+    no collective is needed for its state. These are tiny ``[N]``-sized
+    collectives riding ICI — the flow tensors themselves never move.
+    """
     spec = flow_spec(config)
     now = jnp.asarray(now, jnp.int32)
     N = config.batch_size
+    f_local = rules.valid.shape[0]
 
-    safe_slot = jnp.where(batch.flow_slot >= 0, batch.flow_slot, 0)
-    has_rule = (batch.flow_slot >= 0) & rules.valid[safe_slot]
+    if axis_name is not None:
+        offset = jax.lax.axis_index(axis_name).astype(jnp.int32) * f_local
+        psum = partial(jax.lax.psum, axis_name=axis_name)
+        pmax = partial(jax.lax.pmax, axis_name=axis_name)
+    else:
+        offset = jnp.int32(0)
+        psum = lambda x: x  # noqa: E731
+        pmax = lambda x: x  # noqa: E731
+
+    local_slot = batch.flow_slot - offset
+    in_range = (batch.flow_slot >= 0) & (local_slot >= 0) & (local_slot < f_local)
+    safe_slot = jnp.where(in_range, local_slot, 0)
+    owned = in_range & rules.valid[safe_slot]
+    has_rule = psum(owned.astype(jnp.int32)) > 0
     live = batch.valid & has_rule
     no_rule = batch.valid & ~has_rule
 
     acquire_f = batch.acquire.astype(jnp.float32)
-    tri = _prefix_mats(N)  # [N, N] strictly-lower
 
     # ------------------------------------------------------------------
     # 1. namespace guard (request-count qps, GlobalRequestLimiter.java:46)
+    #    — computed identically on every device from global inputs
     # ------------------------------------------------------------------
-    ns_id = rules.namespace_id[safe_slot]
+    ns_id = psum(jnp.where(owned, rules.namespace_id[safe_slot], 0))
     ns_already = W.window_sum(spec, state.ns, now, 0)[ns_id].astype(jnp.float32)
-    same_ns = (ns_id[:, None] == ns_id[None, :]) & live[None, :]
-    ones = live.astype(jnp.float32)
-    ns_prefix = (same_ns.astype(jnp.float32) * tri) @ ones  # earlier same-ns reqs
+    ns_prefix = _segment_prefix_builder(ns_id, config.prefix_impl)(
+        live.astype(jnp.float32)
+    )
     ns_budget = rules.ns_max_qps[ns_id] * (spec.interval_ms / 1000.0)
     ns_ok = (ns_already + ns_prefix + 1.0) <= ns_budget
     too_many = live & ~ns_ok
-    active = live & ns_ok
+    ns_admitted = live & ns_ok  # global mask — identical on every device
+    active = ns_admitted & owned  # flow evaluation happens on the owner
 
     # ------------------------------------------------------------------
     # 2. per-request threshold (ClusterFlowChecker.java:38-48)
@@ -157,7 +224,7 @@ def decide(
         W.window_sum(spec, state.flow, now, ClusterEvent.PASS)
         + W.window_sum(spec, state.occupy, now, 0)  # matured borrows
     ).astype(jnp.float32)[safe_slot]
-    same_flow = (safe_slot[:, None] == safe_slot[None, :]).astype(jnp.float32) * tri
+    flow_prefix = _segment_prefix_builder(safe_slot, config.prefix_impl)
 
     admit = active
     iters = config.admission_refine_iters
@@ -169,11 +236,11 @@ def decide(
         )
     for _ in range(iters):
         contrib = jnp.where(admit, acquire_f, 0.0)
-        prefix = same_flow @ contrib  # tokens of earlier admitted same-flow reqs
+        prefix = flow_prefix(contrib)  # tokens of earlier admitted same-flow reqs
         admit = active & (passed + prefix + acquire_f <= threshold)
 
     contrib = jnp.where(admit, acquire_f, 0.0)
-    admitted_prefix = same_flow @ contrib
+    admitted_prefix = flow_prefix(contrib)
 
     # ------------------------------------------------------------------
     # 4. priority occupy of the next window (ClusterFlowChecker.java:84-97)
@@ -194,7 +261,7 @@ def decide(
 
     try_occupy = blocked & batch.prioritized
     occ_contrib = jnp.where(try_occupy, acquire_f, 0.0)
-    occ_prefix = same_flow @ occ_contrib  # conservative: all triers contribute
+    occ_prefix = flow_prefix(occ_contrib)  # conservative: all triers contribute
     # admitted_prefix: tokens admitted earlier in THIS batch land in the
     # current bucket, which is still valid at the next window — without this
     # term a borrow could overcommit the window the batch just filled
@@ -205,42 +272,37 @@ def decide(
     hard_block = blocked & ~can_occupy
 
     # ------------------------------------------------------------------
-    # 5. window updates (segment scatter-adds)
+    # 5. window updates — ONE roll + ONE fused scatter for all five flow
+    #    event channels (separate add_events calls would each re-roll and
+    #    re-materialize the [F, B, E] tensor; fusing keeps HBM traffic to
+    #    a single read-modify-write)
     # ------------------------------------------------------------------
-    flow_ws = state.flow
-    slot2 = jnp.concatenate([safe_slot, safe_slot])
-    # PASS tokens + PASS_REQUEST rpcs for admitted
-    flow_ws = W.add_events(
-        spec, flow_ws, now,
-        slot2,
-        jnp.concatenate([
-            jnp.full((N,), int(ClusterEvent.PASS), jnp.int32),
-            jnp.full((N,), int(ClusterEvent.PASS_REQUEST), jnp.int32),
-        ]),
-        jnp.concatenate([batch.acquire, jnp.ones((N,), jnp.int32)]),
-        valid=jnp.concatenate([admit, admit]),
+    ones_n = jnp.ones((N,), jnp.int32)
+    ev = ClusterEvent
+    flow_slots5 = jnp.concatenate([safe_slot] * 5)
+    flow_chans5 = jnp.concatenate(
+        [
+            jnp.full((N,), int(c), jnp.int32)
+            for c in (ev.PASS, ev.PASS_REQUEST, ev.BLOCK, ev.BLOCK_REQUEST,
+                      ev.OCCUPIED_PASS)
+        ]
     )
-    # BLOCK tokens + BLOCK_REQUEST rpcs for hard-blocked
-    flow_ws = W.add_events(
-        spec, flow_ws, now,
-        slot2,
-        jnp.concatenate([
-            jnp.full((N,), int(ClusterEvent.BLOCK), jnp.int32),
-            jnp.full((N,), int(ClusterEvent.BLOCK_REQUEST), jnp.int32),
-        ]),
-        jnp.concatenate([batch.acquire, jnp.ones((N,), jnp.int32)]),
-        valid=jnp.concatenate([hard_block, hard_block]),
+    flow_vals5 = jnp.concatenate(
+        [batch.acquire, ones_n, batch.acquire, ones_n, batch.acquire]
     )
     # OCCUPIED_PASS marks prioritized requests admitted normally (the
     # reference's OK branch adds OCCUPIED_PASS when prioritized; the occupy
     # path records only the future-window WAITING, which is `occupy_ws` below)
-    flow_ws = W.add_events(
-        spec, flow_ws, now,
-        safe_slot,
-        jnp.full((N,), int(ClusterEvent.OCCUPIED_PASS), jnp.int32),
-        batch.acquire,
-        valid=admit & batch.prioritized,
+    flow_valid5 = jnp.concatenate(
+        [admit, admit, hard_block, hard_block, admit & batch.prioritized]
     )
+    flow_ws = W.add_events(
+        spec, state.flow, now, flow_slots5, flow_chans5, flow_vals5,
+        valid=flow_valid5,
+    )
+    # pmax over the mesh axis keeps the replicated occupy.starts identical on
+    # every device even when only the owner shard sees a borrow (each shard
+    # then also zeroes its own stale counts column for the reset slot)
     occupy_ws = W.add_future(
         spec, state.occupy, now,
         wait_ms=jnp.full((N,), wait_next, jnp.int32),
@@ -248,36 +310,67 @@ def decide(
         channel_ids=jnp.zeros((N,), jnp.int32),
         values=batch.acquire,
         valid=can_occupy,
+        combine_desired=pmax,
     )
     # namespace guard counts every ns-admitted request (the guard counts
-    # arrivals, not flow verdicts — GlobalRequestLimiter adds on tryPass)
+    # arrivals, not flow verdicts — GlobalRequestLimiter adds on tryPass);
+    # the mask is global, so the replicated ns window stays consistent
     ns_ws = W.add_events(
         spec, state.ns, now,
         ns_id,
         jnp.zeros((N,), jnp.int32),
         jnp.ones((N,), jnp.int32),
-        valid=active,
+        valid=ns_admitted,
     )
 
     # ------------------------------------------------------------------
-    # 6. verdicts
+    # 6. verdicts — owner emits status+1, psum stitches shards together
     # ------------------------------------------------------------------
-    status = jnp.full((N,), int(TokenStatus.FAIL), jnp.int8)
-    status = jnp.where(no_rule, int(TokenStatus.NO_RULE_EXISTS), status)
-    status = jnp.where(too_many, int(TokenStatus.TOO_MANY_REQUEST), status)
-    status = jnp.where(hard_block, int(TokenStatus.BLOCKED), status)
-    status = jnp.where(can_occupy, int(TokenStatus.SHOULD_WAIT), status)
-    status = jnp.where(admit, int(TokenStatus.OK), status)
+    local_status = jnp.where(
+        admit,
+        int(TokenStatus.OK) + 1,
+        jnp.where(
+            can_occupy,
+            int(TokenStatus.SHOULD_WAIT) + 1,
+            jnp.where(hard_block, int(TokenStatus.BLOCKED) + 1, 0),
+        ),
+    ).astype(jnp.int32)
+    combined = psum(local_status)
+    status = jnp.where(
+        ~batch.valid,
+        int(TokenStatus.FAIL),
+        jnp.where(
+            no_rule,
+            int(TokenStatus.NO_RULE_EXISTS),
+            jnp.where(
+                too_many,
+                int(TokenStatus.TOO_MANY_REQUEST),
+                jnp.where(combined > 0, combined - 1, int(TokenStatus.FAIL)),
+            ),
+        ),
+    ).astype(jnp.int8)
 
-    wait_ms = jnp.where(can_occupy, wait_next, 0).astype(jnp.int32)
-    remaining = jnp.clip(
+    wait_ms = psum(jnp.where(can_occupy, wait_next, 0).astype(jnp.int32))
+    remaining_local = jnp.clip(
         threshold - passed - admitted_prefix - jnp.where(admit, acquire_f, 0.0),
         0.0,
         2**30,
     ).astype(jnp.int32)
     # blockedResult() in the reference always carries remaining=0
-    remaining = jnp.where(admit, remaining, 0)
+    remaining = psum(jnp.where(admit, remaining_local, 0))
 
     new_state = EngineState(flow=flow_ws, occupy=occupy_ws, ns=ns_ws)
     verdicts = VerdictBatch(status=status, wait_ms=wait_ms, remaining=remaining)
     return new_state, verdicts
+
+
+@partial(jax.jit, static_argnames=("config",))
+def decide(
+    config: EngineConfig,
+    state: EngineState,
+    rules: RuleTable,
+    batch: RequestBatch,
+    now: jax.Array,
+) -> tuple:
+    """``(state, rules, batch, now) -> (state', verdicts)`` — single shard."""
+    return _decide_core(config, state, rules, batch, now, axis_name=None)

@@ -1,0 +1,24 @@
+"""Multi-chip scale-out: the flow axis sharded over a device mesh.
+
+Analog of the reference's only scale dimensions (SURVEY.md §2g): resource
+parallelism (independent counters per flowId) becomes tensor sharding along
+the flow axis; namespace parallelism stays a partition of that axis; and the
+"distributed communication backend" is XLA collectives over ICI instead of
+Netty TCP — three tiny ``[batch]``-sized ``psum``\\ s per step (ownership,
+namespace ids, verdicts), while the ``[flows, buckets, events]`` counter
+tensors never leave their shard.
+"""
+
+from sentinel_tpu.parallel.sharding import (
+    make_flow_mesh,
+    make_sharded_decide,
+    shard_state,
+    shard_rules,
+)
+
+__all__ = [
+    "make_flow_mesh",
+    "make_sharded_decide",
+    "shard_state",
+    "shard_rules",
+]

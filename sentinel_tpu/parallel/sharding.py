@@ -1,0 +1,107 @@
+"""shard_map-based multi-chip decision step.
+
+The mesh has one axis, ``"flows"``: ``state.flow`` / ``state.occupy`` and the
+per-flow rule arrays are sharded along it; the namespace window, namespace
+config arrays, request batch and clock are replicated. ``_decide_core`` runs
+per shard with ``axis_name="flows"`` and stitches global verdicts with psums
+(see its docstring).
+
+Requests need no routing: every device sees the whole batch and answers only
+for flows it owns — the right trade for this workload, where a batch row is
+16 bytes but a flow's window history is O(buckets × events) and must not
+move. (The scaling-book recipe: pick the mesh, annotate shardings, let the
+collectives ride ICI.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sentinel_tpu.engine.config import EngineConfig
+from sentinel_tpu.engine.decide import RequestBatch, VerdictBatch, _decide_core
+from sentinel_tpu.engine.rules import RuleTable
+from sentinel_tpu.engine.state import EngineState
+from sentinel_tpu.stats.window import WindowState
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def make_flow_mesh(devices=None, axis: str = "flows") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def _state_specs(axis: str) -> EngineState:
+    return EngineState(
+        flow=WindowState(starts=P(), counts=P(axis)),
+        occupy=WindowState(starts=P(), counts=P(axis)),
+        ns=WindowState(starts=P(), counts=P()),
+    )
+
+
+def _rules_specs(axis: str) -> RuleTable:
+    return RuleTable(
+        valid=P(axis),
+        count=P(axis),
+        mode=P(axis),
+        namespace_id=P(axis),
+        ns_max_qps=P(),
+        ns_connected=P(),
+    )
+
+
+def _batch_specs() -> RequestBatch:
+    return RequestBatch(flow_slot=P(), acquire=P(), prioritized=P(), valid=P())
+
+
+def shard_state(state: EngineState, mesh: Mesh, axis: str = "flows") -> EngineState:
+    """Place an EngineState on the mesh with flow-axis sharding."""
+    specs = _state_specs(axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
+
+
+def shard_rules(rules: RuleTable, mesh: Mesh, axis: str = "flows") -> RuleTable:
+    specs = _rules_specs(axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), rules, specs
+    )
+
+
+def make_sharded_decide(config: EngineConfig, mesh: Mesh, axis: str = "flows"):
+    """Build the jitted multi-chip step.
+
+    ``config.max_flows`` must divide evenly by the mesh size; each shard owns
+    ``max_flows // n_devices`` consecutive slots (the host RuleIndex hands
+    out global slots, which the kernel maps to shard-local via its
+    ``axis_index``).
+    """
+    n = mesh.devices.size
+    if config.max_flows % n != 0:
+        raise ValueError(
+            f"max_flows={config.max_flows} must be divisible by mesh size {n}"
+        )
+
+    def step(state, rules, batch, now):
+        return _decide_core(config, state, rules, batch, now, axis_name=axis)
+
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(_state_specs(axis), _rules_specs(axis), _batch_specs(), P()),
+        out_specs=(
+            _state_specs(axis),
+            VerdictBatch(status=P(), wait_ms=P(), remaining=P()),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

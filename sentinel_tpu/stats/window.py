@@ -213,6 +213,7 @@ def add_future(
     channel_ids: jax.Array,
     values: jax.Array,
     valid: Optional[jax.Array] = None,
+    combine_desired=None,
 ) -> WindowState:
     """Scatter-add into the bucket ``wait_ms`` ahead of ``now`` (per request).
 
@@ -246,7 +247,12 @@ def add_future(
     # Zero any targeted slot whose recorded start differs from the target start.
     # (Duplicate valid targets agree on `start`: after clamping, slot index k
     # uniquely determines the start within one ring period.)
+    # `combine_desired` (e.g. a pmax over a mesh axis) lets sharded callers
+    # agree on the reset union so the replicated `starts` vector cannot
+    # diverge across devices when only the owner shard sees a borrow.
     desired = jnp.full_like(ws.starts, NEVER).at[idx].max(start, mode="drop")
+    if combine_desired is not None:
+        desired = combine_desired(desired)
     needs_reset = (desired != NEVER) & (desired != ws.starts)
     counts = jnp.where(
         needs_reset[None, :, None], jnp.zeros((), ws.counts.dtype), ws.counts

@@ -126,6 +126,22 @@ class TestReviewRegressions:
         results = svc.request_batch([(9, 1, False)] * 20)
         assert sum(r.ok for r in results) == 14  # 2 × 7 applied on load
 
+    def test_long_uptime_rebase_preserves_limits(self, manual_clock):
+        # regression: engine time must re-base before int32 wraps (~24.8d);
+        # limits must keep working across the re-base
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=3.0, mode=G)])
+        assert svc.request_token(1).ok
+        # jump 13 days — beyond the 2**30 ms re-base threshold
+        manual_clock.sleep(13 * 24 * 3600 * 1000)
+        results = [svc.request_token(1) for _ in range(5)]
+        assert sum(r.ok for r in results) == 3  # limit still enforced
+        assert svc._epoch_ms is not None
+        assert (manual_clock.now_ms() - svc._epoch_ms) < 2**30  # re-based
+        # and again after the re-base, windows still slide
+        manual_clock.sleep(1100)
+        assert svc.request_token(1).ok
+
     def test_bind_failure_raises_with_cause_and_allows_retry(self):
         svc = DefaultTokenService(CFG)
         s1 = TokenServer(svc, port=0)

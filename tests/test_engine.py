@@ -163,6 +163,26 @@ class TestSequentialOracle:
         got = (np.asarray(v.status)[:n] == TokenStatus.OK).tolist()
         assert got == want
 
+    @pytest.mark.parametrize("impl", ["matmul", "sort"])
+    @pytest.mark.parametrize("seed", range(4))
+    def test_prefix_impls_match_oracle(self, seed, impl):
+        cfg = EngineConfig(
+            max_flows=16, max_namespaces=4, batch_size=32, prefix_impl=impl
+        )
+        rng = np.random.default_rng(300 + seed)
+        rules = [ClusterFlowRule(flow_id=i, count=float(rng.integers(1, 8)), mode=G)
+                 for i in range(4)]
+        table, index = build_rule_table(cfg, rules)
+        state = make_state(cfg)
+        flows = rng.integers(0, 4, size=32).tolist()
+        batch = make_batch(cfg, [index.lookup(f) for f in flows])
+        state, v = decide(cfg, state, table, batch, jnp.int32(50_000))
+        got = np.asarray(v.status) == TokenStatus.OK
+        for i, rule in enumerate(rules):
+            idxs = [j for j, f in enumerate(flows) if f == i]
+            want = self.greedy(rule.count, 0, [1] * len(idxs))
+            assert [bool(got[j]) for j in idxs] == want, impl
+
     @pytest.mark.parametrize("seed", range(8))
     def test_mixed_acquire_never_overshoots(self, seed):
         rng = np.random.default_rng(100 + seed)

@@ -33,7 +33,25 @@ from sentinel_tpu.engine import (
     make_batch,
     make_state,
 )
+from sentinel_tpu.engine.param import (
+    ParamConfig,
+    hash_indices,
+    make_param_state,
+    param_decide,
+)
 from sentinel_tpu.engine.rules import RuleIndex
+
+
+@dataclass(frozen=True)
+class ClusterParamFlowRule:
+    """Cluster hot-param rule (``ParamFlowRule`` + ``ClusterFlowConfig``):
+    per-value QPS threshold, with per-item overrides keyed by the value's
+    stable hash (``ParamFlowItem`` analog — compute with
+    ``sentinel_tpu.core.hashing.stable_param_hash``)."""
+
+    flow_id: int
+    count: float
+    item_thresholds: Optional[Tuple[Tuple[int, float], ...]] = None
 
 
 @dataclass(frozen=True)
@@ -79,7 +97,11 @@ class DefaultTokenService(TokenService):
     the race-free analog of the JVM's CAS storm).
     """
 
-    def __init__(self, config: Optional[EngineConfig] = None):
+    def __init__(
+        self,
+        config: Optional[EngineConfig] = None,
+        param_config: Optional[ParamConfig] = None,
+    ):
         self.config = config or EngineConfig()
         self._lock = threading.Lock()
         self._state = make_state(self.config)
@@ -87,6 +109,11 @@ class DefaultTokenService(TokenService):
         self._epoch_ms: Optional[int] = None
         self._connected: Dict[str, int] = {}  # namespace → client count
         self._ns_max_qps = 30_000.0
+        # hot-param sketch path (ClusterParamFlowChecker analog)
+        self.param_config = param_config or ParamConfig()
+        self._param_state = make_param_state(self.param_config)
+        self._param_rules: Dict[int, Tuple[int, float, Dict[int, float]]] = {}
+        self._param_free = list(range(self.param_config.max_param_rules - 1, -1, -1))
 
     # -- rule management (ClusterFlowRuleManager analog) --------------------
     def load_rules(
@@ -132,6 +159,9 @@ class DefaultTokenService(TokenService):
             self._epoch_ms = wall - 1  # keep engine time strictly positive
         now = wall - self._epoch_ms
         if now > self._REBASE_AFTER_MS:
+            import jax.numpy as _jnp
+
+            from sentinel_tpu.engine.param import NEVER as _PNEVER
             from sentinel_tpu.stats.window import rebase
 
             delta = now - 60_000  # keep the last minute of history addressable
@@ -139,6 +169,13 @@ class DefaultTokenService(TokenService):
                 flow=rebase(self._state.flow, delta),
                 occupy=rebase(self._state.occupy, delta),
                 ns=rebase(self._state.ns, delta),
+            )
+            # the param sketch's starts are engine-ms too
+            pstarts = self._param_state.starts
+            self._param_state = self._param_state._replace(
+                starts=_jnp.where(
+                    pstarts == _PNEVER, pstarts, pstarts - _jnp.int32(delta)
+                )
             )
             self._epoch_ms += delta
             now -= delta
@@ -178,9 +215,82 @@ class DefaultTokenService(TokenService):
             for i in range(n)
         ]
 
+    def load_param_rules(self, rules: List[ClusterParamFlowRule]) -> None:
+        """``ClusterParamFlowRuleManager`` analog; slots stable across
+        reloads, freed slots cleared."""
+        with self._lock:
+            live = {r.flow_id for r in rules}
+            # validate capacity BEFORE mutating so a failed load cannot leave
+            # a half-applied rule set
+            n_new = len({r.flow_id for r in rules if r.flow_id not in self._param_rules})
+            n_freed = sum(1 for fid in self._param_rules if fid not in live)
+            if n_new > len(self._param_free) + n_freed:
+                raise ValueError(
+                    f"param rule capacity exceeded: need {n_new} new slots, "
+                    f"have {len(self._param_free) + n_freed}"
+                )
+            for fid in list(self._param_rules):
+                if fid not in live:
+                    slot, _, _ = self._param_rules.pop(fid)
+                    self._param_free.append(slot)
+                    self._param_state = self._param_state._replace(
+                        counts=self._param_state.counts.at[slot].set(0)
+                    )
+            for rule in rules:
+                existing = self._param_rules.get(rule.flow_id)
+                slot = existing[0] if existing else None
+                if slot is None:
+                    if not self._param_free:
+                        raise ValueError("param rule capacity exceeded")
+                    slot = self._param_free.pop()
+                items = dict(rule.item_thresholds or ())
+                self._param_rules[rule.flow_id] = (slot, rule.count, items)
+
     def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
-        # wired to the count-min sketch engine in the param-flow milestone
-        return TokenResult(TokenStatus.NO_RULE_EXISTS)
+        """CMS-windowed per-value admission. All values of the request are
+        judged together; any blocked value blocks the request (reference
+        ``ClusterParamFlowChecker``: every param value must have headroom).
+        Admitted values are counted; on a mixed verdict the passed values'
+        counts stand (conservative overcount, same direction as CMS error).
+        """
+        if not param_hashes:
+            return TokenResult(TokenStatus.OK)
+        with self._lock:
+            entry = self._param_rules.get(int(flow_id))
+            if entry is None:
+                return TokenResult(TokenStatus.NO_RULE_EXISTS)
+            slot, count, items = entry
+            hashes = np.asarray(list(param_hashes), dtype=np.int64)
+            idx = hash_indices(
+                hashes, self.param_config.depth, self.param_config.width
+            )
+            n = hashes.shape[0]
+            # pad to a power of two: param_decide's shapes are baked into its
+            # jit cache, and a client cycling value counts must not force a
+            # recompilation per count while holding the service lock
+            n_pad = max(8, 1 << (n - 1).bit_length())
+            pad = n_pad - n
+            idx = np.pad(idx, ((0, pad), (0, 0)))
+            thresholds = np.array(
+                [items.get(int(h), count) for h in hashes], dtype=np.float32
+            )
+            thresholds = np.pad(thresholds, (0, pad))
+            valid = np.zeros(n_pad, dtype=bool)
+            valid[:n] = True
+            now = self._engine_now()
+            self._param_state, admit, _est = param_decide(
+                self.param_config,
+                self._param_state,
+                jnp.full((n_pad,), slot, jnp.int32),
+                jnp.asarray(idx),
+                jnp.full((n_pad,), int(acquire), jnp.int32),
+                jnp.asarray(thresholds),
+                jnp.asarray(valid),
+                jnp.int32(now),
+            )
+        if bool(np.asarray(admit)[:n].all()):
+            return TokenResult(TokenStatus.OK)
+        return TokenResult(TokenStatus.BLOCKED)
 
     # -- introspection (FetchClusterMetricCommandHandler analog) ------------
     def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:

@@ -1,0 +1,24 @@
+"""Stable parameter-value hashing.
+
+Hot-param values never cross the wire or touch the device — only their
+stable 63-bit hash does (``cluster.protocol`` PARAM_FLOW frames, the CMS
+kernel's host-side index derivation). Stability across processes matters:
+client and server must agree, so Python's salted ``hash()`` is out.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+
+def stable_param_hash(value: Any) -> int:
+    if isinstance(value, bytes):
+        data = value
+    elif isinstance(value, str):
+        data = value.encode()
+    else:
+        data = repr(value).encode()
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big"
+    ) & ((1 << 63) - 1)

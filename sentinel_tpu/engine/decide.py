@@ -95,54 +95,7 @@ def make_batch(
     )
 
 
-def _segment_prefix_builder(keys: jax.Array, impl: str = "auto"):
-    """Build an exclusive segment-prefix-sum operator over batch order.
-
-    ``prefix(contrib)[i]`` = sum of ``contrib[j]`` for all ``j < i`` with
-    ``keys[j] == keys[i]`` — the in-batch "earlier same-flow tokens" quantity.
-
-    Two implementations (empirically on a v5e chip the matmul wins up to
-    N≈8k — the MXU makes the [N, N] masked matmul nearly free while sorts
-    are comparatively expensive; beyond that the O(N log N) sort wins and
-    avoids the [N, N] materialization entirely):
-
-    - ``matmul``: ``[N, N]`` same-key strictly-lower mask @ contrib.
-    - ``sort``: stable argsort + cumsum + per-segment rebase. Stable sort
-      preserves batch order within a segment, which the greedy-admission
-      semantics require.
-    """
-    n = keys.shape[0]
-    if impl == "auto":
-        impl = "matmul" if n <= 8192 else "sort"
-    if impl not in ("matmul", "sort"):
-        raise ValueError(f"unknown prefix_impl {impl!r}; use 'auto'|'matmul'|'sort'")
-
-    if impl == "matmul":
-        i = jnp.arange(n)
-        tri = (i[:, None] > i[None, :])
-        same = (keys[:, None] == keys[None, :]) & tri
-        mat = same.astype(jnp.float32)
-
-        def prefix_mat(contrib: jax.Array) -> jax.Array:
-            return mat @ contrib
-
-        return prefix_mat
-
-    order = jnp.argsort(keys, stable=True)
-    keys_sorted = keys[order]
-    seg_start = jnp.concatenate(
-        [jnp.ones((1,), bool), keys_sorted[1:] != keys_sorted[:-1]]
-    )
-    inv = jnp.argsort(order, stable=True)
-
-    def prefix_sort(contrib: jax.Array) -> jax.Array:
-        c = contrib[order]
-        incl = jnp.cumsum(c)
-        excl = incl - c
-        base = jax.lax.cummax(jnp.where(seg_start, excl, -jnp.inf))
-        return (excl - base)[inv]
-
-    return prefix_sort
+from sentinel_tpu.engine.prefix import segment_prefix_builder as _segment_prefix_builder
 
 
 def _decide_core(

@@ -1,0 +1,156 @@
+"""Hot-parameter counting as a windowed count-min sketch.
+
+The reference bounds per-value cardinality with LRU maps — 4,000 values per
+bucket / 200k per resource (``ParameterMetric.java:37-39``,
+``ClusterParamMetric.java:37``) — which *undercounts* evicted keys. The TPU
+build replaces LRU truncation with a count-min sketch per (rule, time
+bucket): fixed memory, vectorized, and it *over*-estimates (CMS guarantee) —
+the safe direction for rate limiting. The documented drift (SURVEY.md §7):
+a value sharing all ``depth`` cells with heavy hitters may be throttled
+early; width/depth trade that probability.
+
+Shapes: ``counts[P, B, depth, width]`` int32 — P param-rule slots, B time
+buckets with the same ring/mask-on-read discipline as ``stats.window``.
+Hash *indices* are computed host-side from the application's stable 64-bit
+value hash (values never cross the wire — only hashes, see
+``cluster.protocol``), so the device kernel is pure gather/scatter/min.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Mixing constants for the host-side index derivation (splitmix64 finalizer
+# per depth lane — public-domain construction).
+_MIX = np.uint64(0x9E3779B97F4A7C15)
+_FIN1 = np.uint64(0xBF58476D1CE4E5B9)
+_FIN2 = np.uint64(0x94D049BB133111EB)
+
+
+def hash_indices(value_hashes: np.ndarray, depth: int, width: int) -> np.ndarray:
+    """``[N] int64 -> [N, depth] int32`` CMS cell indices (host, vectorized)."""
+    h = value_hashes.astype(np.uint64)
+    out = np.empty((h.shape[0], depth), dtype=np.int32)
+    with np.errstate(over="ignore"):
+        for d in range(depth):
+            x = h + np.uint64(d + 1) * _MIX
+            x = (x ^ (x >> np.uint64(30))) * _FIN1
+            x = (x ^ (x >> np.uint64(27))) * _FIN2
+            x = x ^ (x >> np.uint64(31))
+            out[:, d] = (x % np.uint64(width)).astype(np.int32)
+    return out
+
+
+class ParamConfig(NamedTuple):
+    max_param_rules: int = 256  # P
+    depth: int = 2
+    width: int = 2048
+    bucket_ms: int = 500
+    n_buckets: int = 2  # 1s sliding window like the local second-level
+
+    @property
+    def interval_ms(self) -> int:
+        return self.bucket_ms * self.n_buckets
+
+
+class ParamState(NamedTuple):
+    starts: jax.Array  # [B] int32 engine-ms (shared ring, as stats.window)
+    counts: jax.Array  # [P, B, depth, width] int32
+
+
+NEVER = jnp.int32(-(2**30))
+
+
+def make_param_state(config: ParamConfig) -> ParamState:
+    return ParamState(
+        starts=jnp.full((config.n_buckets,), NEVER, jnp.int32),
+        counts=jnp.zeros(
+            (config.max_param_rules, config.n_buckets, config.depth, config.width),
+            jnp.int32,
+        ),
+    )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def param_decide(
+    config: ParamConfig,
+    state: ParamState,
+    rule_slot: jax.Array,  # [N] int32, -1 → no rule
+    idx: jax.Array,  # [N, depth] int32 CMS cell indices
+    acquire: jax.Array,  # [N] int32
+    threshold: jax.Array,  # [N] float32 (rule count or per-item override)
+    valid: jax.Array,  # [N] bool
+    now: jax.Array,
+) -> Tuple[ParamState, jax.Array, jax.Array]:
+    """``-> (state', admit[N] bool, estimate[N] int32)``.
+
+    Mirrors the cluster param checker (``ClusterParamFlowChecker.java:42-96``:
+    sum per-value across buckets vs threshold) with CMS estimates and the
+    same in-batch prefix discipline as the flow kernel: requests on the same
+    (rule, value) are admitted in order against the shared budget. The
+    prefix key uses the full index tuple so distinct values never couple
+    unless they collide in *every* lane (exactly the CMS overestimate case).
+    """
+    now = jnp.asarray(now, jnp.int32)
+    B = config.n_buckets
+    cur_idx = (now // config.bucket_ms) % B
+    cur_start = now - now % config.bucket_ms
+
+    # roll current bucket (shared-ring lazy reset, as stats.window.roll)
+    stale = state.starts[cur_idx] != cur_start
+    counts = jnp.where(
+        (jnp.arange(B)[None, :, None, None] == cur_idx) & stale,
+        0,
+        state.counts,
+    )
+    starts = state.starts.at[cur_idx].set(cur_start)
+
+    age = now - starts
+    bucket_ok = (age >= 0) & (age < config.interval_ms)  # [B]
+
+    safe_slot = jnp.where(rule_slot >= 0, rule_slot, 0)
+    live = valid & (rule_slot >= 0)
+
+    # estimate = min over depth of windowed sums  [N]
+    d_ar = jnp.arange(config.depth)[None, :]  # [1, D]
+
+    def gather_sum(b):
+        # counts[safe_slot, b, d, idx[:, d]] for each d → [N, D]
+        per_d = counts[safe_slot[:, None], b, d_ar, idx]  # [N, D]
+        return per_d * bucket_ok[b].astype(jnp.int32)
+
+    sums = sum(gather_sum(b) for b in range(B))  # [N, D]
+    estimate = jnp.min(sums, axis=1)  # [N]
+
+    # in-batch prefix on the (slot, full index tuple) key — int32 wraparound
+    # mix; a 32-bit key collision merely couples two values' in-batch budgets
+    # conservatively (same direction as the CMS overestimate)
+    from sentinel_tpu.engine.prefix import segment_prefix_builder
+
+    key = safe_slot
+    for d in range(config.depth):
+        key = key * jnp.int32(-1640531527) + idx[:, d]  # 0x9E3779B9 mix
+    seg_prefix = segment_prefix_builder(key, "sort")
+
+    acq = acquire.astype(jnp.int32)
+    admit = live
+    for _ in range(3):  # odd refinement ⇒ never overshoot (see decide.py)
+        contrib = jnp.where(admit, acq, 0)
+        prefix = seg_prefix(contrib)
+        admit = live & (
+            estimate.astype(jnp.float32) + prefix + acq.astype(jnp.float32)
+            <= threshold
+        )
+
+    # update: scatter admitted acquires into all depth lanes of current bucket
+    upd_vals = jnp.where(admit, acq, 0)[:, None].repeat(config.depth, 1)
+    counts = counts.at[
+        safe_slot[:, None], cur_idx, d_ar, idx
+    ].add(upd_vals, mode="drop")
+
+    return ParamState(starts=starts, counts=counts), admit, estimate

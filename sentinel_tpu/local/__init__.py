@@ -22,6 +22,7 @@ Public API::
 from sentinel_tpu.local import chain as _chain  # core slots
 from sentinel_tpu.local import authority as _authority  # noqa: F401
 from sentinel_tpu.local import system_adaptive as _system  # noqa: F401
+from sentinel_tpu.local import param as _param  # noqa: F401
 from sentinel_tpu.local import flow as _flow  # noqa: F401
 from sentinel_tpu.local import degrade as _degrade  # noqa: F401
 
@@ -55,6 +56,11 @@ from sentinel_tpu.local.flow import (
     FlowRule,
     FlowRuleManager,
     FlowStrategy,
+)
+from sentinel_tpu.local.param import (
+    ParamFlowItem,
+    ParamFlowRule,
+    ParamFlowRuleManager,
 )
 from sentinel_tpu.local.sph import Entry, entry, sph, trace, try_entry
 from sentinel_tpu.local.system_adaptive import SystemRule, SystemRuleManager
@@ -91,6 +97,9 @@ __all__ = [
     "AuthorityRule",
     "AuthorityRuleManager",
     "AuthorityStrategy",
+    "ParamFlowRule",
+    "ParamFlowItem",
+    "ParamFlowRuleManager",
 ]
 
 
@@ -100,6 +109,7 @@ def reset_for_tests() -> None:
     from sentinel_tpu.local.sph import sph as _sph
 
     FlowRuleManager.reset_for_tests()
+    ParamFlowRuleManager.reset_for_tests()
     DegradeRuleManager.reset_for_tests()
     SystemRuleManager.reset_for_tests()
     AuthorityRuleManager.reset_for_tests()

@@ -1,0 +1,265 @@
+"""Hot-parameter flow control (local mode).
+
+Analog of ``sentinel-extension/sentinel-parameter-flow-control``:
+``ParamFlowSlot`` (@Spi order −3000, ``ParamFlowSlot.java:34-84`` — picks
+``args[param_idx]``), ``ParamFlowChecker.java:46-190``:
+
+- **QPS mode** — a decentralized token bucket per parameter value: token
+  count + last-refill-time per value, refill ``elapsed × count / duration``,
+  optional burst headroom (``passLocalCheck``/``passDefaultLocalCheck``).
+- **RATE_LIMITER mode** — per-value leaky-bucket pacing
+  (``passThrottleLocalCheck``).
+- **THREAD mode** — per-value concurrency, decremented on exit.
+- per-item overrides (``parsedHotItems``), LRU-bounded value maps
+  (``ParameterMetric.java:35-55``: 4,000 values per metric by default).
+- cluster branch → ``requestParamsToken`` with the value's stable hash
+  (``ParamFlowChecker.java:72``), falling back to local on failure.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.core.hashing import stable_param_hash
+from sentinel_tpu.local.base import ORDER_PARAM_FLOW_SLOT, ParamFlowException
+from sentinel_tpu.local.chain import ProcessorSlot, slot_registry
+from sentinel_tpu.local.flow import ControlBehavior, FlowGrade
+
+
+@dataclass
+class ParamFlowItem:
+    """Per-value threshold override (``ParamFlowItem.java``)."""
+
+    object_value: Any
+    count: float
+
+
+@dataclass
+class ParamFlowRule:
+    resource: str
+    param_idx: int = 0
+    count: float = 0.0
+    grade: FlowGrade = FlowGrade.QPS
+    duration_sec: int = 1
+    burst_count: int = 0
+    control_behavior: ControlBehavior = ControlBehavior.DEFAULT
+    max_queueing_time_ms: int = 0
+    items: List[ParamFlowItem] = field(default_factory=list)
+    cluster_mode: bool = False
+    cluster_config: Optional[dict] = None
+
+    def item_threshold(self, value: Any) -> float:
+        for item in self.items:
+            if item.object_value == value:
+                return item.count
+        return self.count
+
+
+class _Lru(OrderedDict):
+    """Bounded map (ConcurrentLinkedHashMapWrapper analog)."""
+
+    def __init__(self, cap: int):
+        super().__init__()
+        self.cap = cap
+
+    def touch(self, key, default):
+        if key in self:
+            self.move_to_end(key)
+            return self[key]
+        self[key] = default
+        if len(self) > self.cap:
+            self.popitem(last=False)
+        return default
+
+
+MAX_VALUES_PER_RULE = 4000  # ParameterMetric.BASE_PARAM_MAX_CAPACITY
+
+
+class _RuleState:
+    """Per-rule mutable value maps (ParameterMetric analog)."""
+
+    __slots__ = ("lock", "tokens", "last_fill_ms", "latest_passed_ms", "threads")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.tokens: _Lru = _Lru(MAX_VALUES_PER_RULE)
+        self.last_fill_ms: _Lru = _Lru(MAX_VALUES_PER_RULE)
+        self.latest_passed_ms: _Lru = _Lru(MAX_VALUES_PER_RULE)
+        self.threads: Dict[Any, int] = {}
+
+
+def _check_qps(rule: ParamFlowRule, st: _RuleState, value: Any, acquire: int) -> bool:
+    """Token bucket per value (``ParamFlowChecker.passDefaultLocalCheck``)."""
+    now = _clock.now_ms()
+    threshold = rule.item_threshold(value)
+    burst = rule.burst_count
+    duration_ms = rule.duration_sec * 1000
+    with st.lock:
+        last = st.last_fill_ms.touch(value, None)
+        if last is None:
+            # first sight: full bucket minus this acquisition
+            if threshold + burst < acquire:
+                st.last_fill_ms[value] = now
+                st.tokens[value] = 0.0
+                return False
+            st.last_fill_ms[value] = now
+            st.tokens[value] = threshold + burst - acquire
+            return True
+        tokens = st.tokens.touch(value, 0.0)
+        elapsed = now - last
+        if elapsed >= 0:
+            refill = elapsed * threshold / duration_ms
+            tokens = min(tokens + refill, threshold + burst)
+            st.last_fill_ms[value] = now
+        if tokens < acquire:
+            st.tokens[value] = tokens
+            return False
+        st.tokens[value] = tokens - acquire
+        return True
+
+
+def _check_throttle(rule: ParamFlowRule, st: _RuleState, value: Any, acquire: int) -> bool:
+    """Leaky bucket per value (``passThrottleLocalCheck``)."""
+    now = _clock.now_ms()
+    threshold = rule.item_threshold(value)
+    if threshold <= 0:
+        return False
+    cost_ms = round(rule.duration_sec * 1000.0 * acquire / threshold)
+    with st.lock:
+        latest = st.latest_passed_ms.touch(value, -1)
+        expected = latest + cost_ms
+        if expected <= now:
+            st.latest_passed_ms[value] = now
+            return True
+        wait = expected - now
+        if wait > rule.max_queueing_time_ms:
+            return False
+        st.latest_passed_ms[value] = expected
+    _clock.get_clock().wait_ms(wait)
+    return True
+
+
+def _check_thread(rule: ParamFlowRule, st: _RuleState, value: Any, acquire: int) -> bool:
+    threshold = rule.item_threshold(value)
+    with st.lock:
+        cur = st.threads.get(value, 0)
+        if cur + acquire > threshold:
+            return False
+        return True  # increment happens post-pass in the slot
+
+
+class ParamFlowRuleManager:
+    _lock = threading.RLock()
+    _rules: Dict[str, List[Tuple[ParamFlowRule, _RuleState]]] = {}
+
+    @classmethod
+    def load_rules(cls, rules: List[ParamFlowRule]) -> None:
+        new_map: Dict[str, List[Tuple[ParamFlowRule, _RuleState]]] = {}
+        for rule in rules or []:
+            if not rule.resource or rule.count < 0 or rule.param_idx < 0:
+                continue
+            new_map.setdefault(rule.resource, []).append((rule, _RuleState()))
+        with cls._lock:
+            cls._rules = new_map
+
+    @classmethod
+    def get_rules(cls, resource: str):
+        return cls._rules.get(resource, [])
+
+    @classmethod
+    def register_property(cls, prop) -> None:
+        prop.listen(lambda rules: cls.load_rules(rules or []))
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._rules = {}
+
+
+def _pass_check(rule: ParamFlowRule, st: _RuleState, value: Any, acquire: int) -> bool:
+    if rule.cluster_mode:
+        ok = _pass_cluster_check(rule, value, acquire)
+        if ok is not None:
+            return ok
+        # fall through to local when the cluster path is unavailable
+        cfg = rule.cluster_config or {}
+        if not cfg.get("fallback_to_local_when_fail", True):
+            return True
+    if rule.grade == FlowGrade.THREAD:
+        return _check_thread(rule, st, value, acquire)
+    if rule.control_behavior == ControlBehavior.RATE_LIMITER:
+        return _check_throttle(rule, st, value, acquire)
+    return _check_qps(rule, st, value, acquire)
+
+
+def _pass_cluster_check(rule: ParamFlowRule, value: Any, acquire: int):
+    """Returns True/False on a definitive cluster verdict, None to fall back."""
+    try:
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.engine import TokenStatus
+
+        service = cluster_api._pick_service()
+        flow_id = (rule.cluster_config or {}).get("flow_id")
+        if service is None or flow_id is None:
+            return None
+        result = service.request_params_token(
+            int(flow_id), acquire, [stable_param_hash(value)]
+        )
+        if result.status == TokenStatus.OK:
+            return True
+        if result.status == TokenStatus.BLOCKED:
+            return False
+        return None
+    except Exception:
+        return None
+
+
+class ParamFlowSlot(ProcessorSlot):
+    """``ParamFlowSlot.java:34-84``."""
+
+    def entry(self, context, resource, node, count, prioritized, args):
+        rules = ParamFlowRuleManager.get_rules(resource.name)
+        if rules:
+            for rule, st in rules:
+                if rule.param_idx >= len(args):
+                    continue  # no such arg → rule not applicable
+                value = args[rule.param_idx]
+                if value is None:
+                    continue
+                if not _pass_check(rule, st, value, count):
+                    raise ParamFlowException(
+                        resource.name, f"param flow: {resource.name}", rule
+                    )
+            # record thread-grade holds for exit-side decrement
+            holds = []
+            for rule, st in rules:
+                if rule.grade == FlowGrade.THREAD and rule.param_idx < len(args):
+                    value = args[rule.param_idx]
+                    if value is not None:
+                        with st.lock:
+                            st.threads[value] = st.threads.get(value, 0) + count
+                        holds.append((st, value))
+            if holds:
+                context.cur_entry.param_holds = holds
+        self.fire_entry(context, resource, node, count, prioritized, args)
+
+    def exit(self, context, resource, count, args):
+        entry = context.cur_entry
+        holds = getattr(entry, "param_holds", None) if entry else None
+        if holds:
+            for st, value in holds:
+                with st.lock:
+                    remaining = st.threads.get(value, 0) - count
+                    if remaining > 0:
+                        st.threads[value] = remaining
+                    else:
+                        st.threads.pop(value, None)
+        self.fire_exit(context, resource, count, args)
+
+
+slot_registry.register(ParamFlowSlot, order=ORDER_PARAM_FLOW_SLOT, name="ParamFlowSlot")

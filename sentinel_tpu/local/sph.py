@@ -30,7 +30,7 @@ class Entry:
     __slots__ = (
         "resource", "context", "chain", "create_ms", "completed_ms",
         "cur_node", "origin_node", "block_error", "error", "parent", "child",
-        "count", "args", "_exited",
+        "count", "args", "_exited", "param_holds",
     )
 
     def __init__(self, resource: ResourceWrapper, chain: Optional[SlotChain],
@@ -46,6 +46,7 @@ class Entry:
         self.origin_node = None
         self.block_error: Optional[BlockException] = None
         self.error: Optional[BaseException] = None
+        self.param_holds = None
         self._exited = False
         # link into the context's entry stack (CtEntry.java:57-59)
         self.parent = context.cur_entry

@@ -1,0 +1,298 @@
+"""Hot-parameter flow control tests.
+
+Local token-bucket semantics mirror ``ParamFlowThrottleRateLimitingTest`` /
+``ParamFlowDefaultCheckerTest``; the CMS engine is property-tested for its
+one-sided error guarantee (estimate >= true count — the safe direction)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sentinel_tpu.local as sentinel
+from sentinel_tpu.core.hashing import stable_param_hash
+from sentinel_tpu.engine.param import (
+    ParamConfig,
+    hash_indices,
+    make_param_state,
+    param_decide,
+)
+from sentinel_tpu.local import (
+    BlockException,
+    FlowGrade,
+    ParamFlowItem,
+    ParamFlowRule,
+    ParamFlowRuleManager,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_engine(manual_clock):
+    sentinel.reset_for_tests()
+    yield manual_clock
+    sentinel.reset_for_tests()
+
+
+def hit(resource, value, n=1):
+    ok = blocked = 0
+    for _ in range(n):
+        try:
+            with sentinel.entry(resource, args=(value,)):
+                ok += 1
+        except BlockException:
+            blocked += 1
+    return ok, blocked
+
+
+class TestLocalParamQps:
+    def test_per_value_budgets_independent(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="hot", param_idx=0, count=5)]
+        )
+        assert hit("hot", "alice", 8) == (5, 3)
+        assert hit("hot", "bob", 8) == (5, 3)  # separate bucket
+
+    def test_token_refill_over_time(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="hot2", param_idx=0, count=10)]
+        )
+        assert hit("hot2", "k", 10) == (10, 0)
+        assert hit("hot2", "k", 1) == (0, 1)  # drained
+        manual_clock.sleep(500)  # half the duration → ~5 tokens back
+        ok, _ = hit("hot2", "k", 10)
+        assert 4 <= ok <= 6
+
+    def test_burst_headroom(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="hot3", param_idx=0, count=2, burst_count=3)]
+        )
+        ok, blocked = hit("hot3", "k", 8)
+        assert ok == 5  # count + burst on first window
+
+    def test_item_override(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [
+                ParamFlowRule(
+                    resource="hot4", param_idx=0, count=1,
+                    items=[ParamFlowItem("vip", 10)],
+                )
+            ]
+        )
+        assert hit("hot4", "vip", 12) == (10, 2)
+        assert hit("hot4", "pleb", 3) == (1, 2)
+
+    def test_missing_arg_passes(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="hot5", param_idx=2, count=1)]
+        )
+        ok, blocked = hit("hot5", "x", 5)  # args has only idx 0
+        assert (ok, blocked) == (5, 0)
+
+    def test_thread_grade_releases_on_exit(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [
+                ParamFlowRule(
+                    resource="hot6", param_idx=0, count=1,
+                    grade=FlowGrade.THREAD,
+                )
+            ]
+        )
+        e1 = sentinel.entry("hot6", args=("k",))
+        with pytest.raises(BlockException):
+            sentinel.entry("hot6", args=("k",))
+        # another value unaffected
+        e2 = sentinel.entry("hot6", args=("other",))
+        e2.exit()
+        e1.exit()
+        e3 = sentinel.entry("hot6", args=("k",))  # released
+        e3.exit()
+
+    def test_rate_limiter_mode_paces(self, manual_clock):
+        from sentinel_tpu.local import ControlBehavior
+
+        ParamFlowRuleManager.load_rules(
+            [
+                ParamFlowRule(
+                    resource="hot7", param_idx=0, count=10,
+                    control_behavior=ControlBehavior.RATE_LIMITER,
+                    max_queueing_time_ms=2000,
+                )
+            ]
+        )
+        t0 = manual_clock.now_ms()
+        ok, blocked = hit("hot7", "k", 5)
+        assert ok == 5
+        assert manual_clock.now_ms() - t0 == pytest.approx(400, abs=1)
+
+
+class TestCmsEngine:
+    CFG = ParamConfig(max_param_rules=8, depth=2, width=512)
+
+    def _decide(self, state, slots, hashes, thresholds, now, acquire=1):
+        idx = hash_indices(np.asarray(hashes, np.int64), self.CFG.depth, self.CFG.width)
+        n = len(slots)
+        return param_decide(
+            self.CFG,
+            state,
+            jnp.asarray(np.asarray(slots, np.int32)),
+            jnp.asarray(idx),
+            jnp.full((n,), acquire, jnp.int32),
+            jnp.asarray(np.asarray(thresholds, np.float32)),
+            jnp.ones((n,), bool),
+            jnp.int32(now),
+        )
+
+    def test_threshold_enforced_per_value(self):
+        state = make_param_state(self.CFG)
+        h = stable_param_hash("user-1")
+        state, admit, est = self._decide(
+            state, [0] * 10, [h] * 10, [4.0] * 10, now=10_000
+        )
+        assert np.asarray(admit).sum() == 4
+
+    def test_values_independent(self):
+        state = make_param_state(self.CFG)
+        hashes = [stable_param_hash(f"u{i}") for i in range(50)]
+        state, admit, _ = self._decide(
+            state, [0] * 50, hashes, [1.0] * 50, now=10_000
+        )
+        assert np.asarray(admit).all()  # one token each, all distinct values
+
+    def test_window_slides(self):
+        state = make_param_state(self.CFG)
+        h = stable_param_hash("k")
+        state, admit, _ = self._decide(state, [0], [h], [1.0], now=10_000)
+        assert np.asarray(admit)[0]
+        state, admit, _ = self._decide(state, [0], [h], [1.0], now=10_400)
+        assert not np.asarray(admit)[0]
+        state, admit, _ = self._decide(state, [0], [h], [1.0], now=11_100)
+        assert np.asarray(admit)[0]  # old bucket expired
+
+    def test_rules_isolated_by_slot(self):
+        state = make_param_state(self.CFG)
+        h = stable_param_hash("shared-key")
+        state, admit, _ = self._decide(state, [0, 1], [h, h], [1.0, 1.0], 10_000)
+        assert np.asarray(admit).all()  # same value, different rules
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_estimate_never_undercounts(self, seed):
+        # CMS guarantee: estimate >= true windowed count per value
+        rng = np.random.default_rng(seed)
+        state = make_param_state(self.CFG)
+        true_counts = {}
+        now = 10_000
+        for _ in range(8):
+            vals = rng.integers(0, 30, size=16)
+            hashes = [stable_param_hash(int(v)) for v in vals]
+            # estimates reflect PRE-batch state (in-batch coupling is the
+            # prefix term's job) → compare against the pre-batch snapshot
+            snapshot = dict(true_counts)
+            state, admit, est = self._decide(
+                state, [0] * 16, hashes, [1e9] * 16, now
+            )
+            adm = np.asarray(admit)
+            est = np.asarray(est)
+            for i, v in enumerate(vals):
+                assert est[i] >= snapshot.get(int(v), 0)
+                if adm[i]:
+                    true_counts[int(v)] = true_counts.get(int(v), 0) + 1
+        assert sum(true_counts.values()) == 128
+
+
+class TestClusterParamPath:
+    def test_end_to_end_via_service(self, manual_clock):
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.cluster.token_service import (
+            ClusterParamFlowRule,
+            DefaultTokenService,
+        )
+        from sentinel_tpu.engine import EngineConfig
+
+        svc = DefaultTokenService(EngineConfig(max_flows=16, max_namespaces=4,
+                                               batch_size=16))
+        svc.load_param_rules(
+            [
+                ClusterParamFlowRule(
+                    flow_id=500, count=2.0,
+                    item_thresholds=((stable_param_hash("vip"), 10.0),),
+                )
+            ]
+        )
+        cluster_api.set_embedded_server(svc)
+        try:
+            ParamFlowRuleManager.load_rules(
+                [
+                    ParamFlowRule(
+                        resource="chot", param_idx=0, count=1e9,
+                        cluster_mode=True, cluster_config={"flow_id": 500},
+                    )
+                ]
+            )
+            assert hit("chot", "norm", 4) == (2, 2)
+            assert hit("chot", "vip", 12) == (10, 2)
+        finally:
+            cluster_api.reset_for_tests()
+
+    def test_param_state_survives_epoch_rebase(self, manual_clock):
+        from sentinel_tpu.cluster.token_service import (
+            ClusterParamFlowRule,
+            DefaultTokenService,
+        )
+        from sentinel_tpu.engine import EngineConfig, TokenStatus
+
+        svc = DefaultTokenService(EngineConfig(max_flows=16, max_namespaces=4,
+                                               batch_size=16))
+        svc.load_param_rules([ClusterParamFlowRule(flow_id=3, count=2.0)])
+        h = stable_param_hash("k")
+        assert svc.request_params_token(3, 1, [h]).status == TokenStatus.OK
+        manual_clock.sleep(13 * 24 * 3600 * 1000)  # force a rebase
+        svc.request_token(999)  # trigger _engine_now via the flow path
+        # after the rebase the window machinery must still work end-to-end
+        assert svc.request_params_token(3, 1, [h]).status == TokenStatus.OK
+        assert svc.request_params_token(3, 1, [h]).status == TokenStatus.OK
+        assert svc.request_params_token(3, 1, [h]).status == TokenStatus.BLOCKED
+
+    def test_partial_load_rejected_atomically(self, manual_clock):
+        from sentinel_tpu.cluster.token_service import (
+            ClusterParamFlowRule,
+            DefaultTokenService,
+        )
+        from sentinel_tpu.engine import EngineConfig
+        from sentinel_tpu.engine.param import ParamConfig
+
+        svc = DefaultTokenService(
+            EngineConfig(max_flows=16, max_namespaces=4, batch_size=16),
+            ParamConfig(max_param_rules=2),
+        )
+        svc.load_param_rules([ClusterParamFlowRule(flow_id=1, count=1.0),
+                              ClusterParamFlowRule(flow_id=2, count=1.0)])
+        with pytest.raises(ValueError, match="capacity"):
+            svc.load_param_rules(
+                [ClusterParamFlowRule(flow_id=i, count=1.0) for i in (3, 4, 5)]
+            )
+        # original rule set untouched
+        assert set(svc._param_rules) == {1, 2}
+
+    def test_wire_protocol_param_request(self, manual_clock):
+        from sentinel_tpu.cluster.client import TokenClient
+        from sentinel_tpu.cluster.server import TokenServer
+        from sentinel_tpu.cluster.token_service import (
+            ClusterParamFlowRule,
+            DefaultTokenService,
+        )
+        from sentinel_tpu.engine import EngineConfig, TokenStatus
+
+        svc = DefaultTokenService(EngineConfig(max_flows=16, max_namespaces=4,
+                                               batch_size=16))
+        svc.load_param_rules([ClusterParamFlowRule(flow_id=7, count=1.0)])
+        server = TokenServer(svc, port=0)
+        server.start()
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            h = stable_param_hash("x")
+            r1 = client.request_params_token(7, 1, [h])
+            r2 = client.request_params_token(7, 1, [h])
+            assert r1.status == TokenStatus.OK
+            assert r2.status == TokenStatus.BLOCKED
+        finally:
+            client.close()
+            server.stop()

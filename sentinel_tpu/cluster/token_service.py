@@ -159,8 +159,6 @@ class DefaultTokenService(TokenService):
             self._epoch_ms = wall - 1  # keep engine time strictly positive
         now = wall - self._epoch_ms
         if now > self._REBASE_AFTER_MS:
-            import jax.numpy as _jnp
-
             from sentinel_tpu.engine.param import NEVER as _PNEVER
             from sentinel_tpu.stats.window import rebase
 
@@ -173,8 +171,8 @@ class DefaultTokenService(TokenService):
             # the param sketch's starts are engine-ms too
             pstarts = self._param_state.starts
             self._param_state = self._param_state._replace(
-                starts=_jnp.where(
-                    pstarts == _PNEVER, pstarts, pstarts - _jnp.int32(delta)
+                starts=jnp.where(
+                    pstarts == _PNEVER, pstarts, pstarts - jnp.int32(delta)
                 )
             )
             self._epoch_ms += delta

@@ -13,12 +13,27 @@ from typing import Any
 
 
 def stable_param_hash(value: Any) -> int:
+    """Type-tagged so ``1``, ``"1"`` and ``b"1"`` never share a bucket.
+
+    Stability holds for values whose textual form is process-stable (str,
+    bytes, numbers, bools, None, and containers thereof). Objects whose
+    ``repr`` embeds ``id()`` hash per-instance — pass a stable key (e.g. the
+    object's id field) as the parameter instead.
+    """
     if isinstance(value, bytes):
-        data = value
+        tag, data = b"b", value
     elif isinstance(value, str):
-        data = value.encode()
+        tag, data = b"s", value.encode()
+    elif isinstance(value, bool):  # before int: bool is an int subclass
+        tag, data = b"B", str(value).encode()
+    elif isinstance(value, int):
+        tag, data = b"i", str(value).encode()
+    elif isinstance(value, float):
+        tag, data = b"f", repr(value).encode()
+    elif value is None:
+        tag, data = b"n", b""
     else:
-        data = repr(value).encode()
+        tag, data = b"r", repr(value).encode()
     return int.from_bytes(
-        hashlib.blake2b(data, digest_size=8).digest(), "big"
+        hashlib.blake2b(tag + b"\x00" + data, digest_size=8).digest(), "big"
     ) & ((1 << 63) - 1)

@@ -1,0 +1,50 @@
+"""Dynamic rule datasources (analog of ``sentinel-extension/sentinel-datasource-*``).
+
+``ReadableDataSource`` parses an external source into rules and publishes
+them into a ``DynamicProperty`` that rule managers subscribe to;
+``WritableDataSource`` persists rules pushed through the command center.
+"""
+
+from sentinel_tpu.datasource.base import (
+    Converter,
+    ReadableDataSource,
+    AutoRefreshDataSource,
+    WritableDataSource,
+    WritableDataSourceRegistry,
+)
+from sentinel_tpu.datasource.file import (
+    FileRefreshableDataSource,
+    FileWritableDataSource,
+)
+from sentinel_tpu.datasource.converters import (
+    flow_rules_from_json,
+    flow_rules_to_json,
+    degrade_rules_from_json,
+    degrade_rules_to_json,
+    system_rules_from_json,
+    system_rules_to_json,
+    authority_rules_from_json,
+    authority_rules_to_json,
+    param_flow_rules_from_json,
+    param_flow_rules_to_json,
+)
+
+__all__ = [
+    "Converter",
+    "ReadableDataSource",
+    "AutoRefreshDataSource",
+    "WritableDataSource",
+    "WritableDataSourceRegistry",
+    "FileRefreshableDataSource",
+    "FileWritableDataSource",
+    "flow_rules_from_json",
+    "flow_rules_to_json",
+    "degrade_rules_from_json",
+    "degrade_rules_to_json",
+    "system_rules_from_json",
+    "system_rules_to_json",
+    "authority_rules_from_json",
+    "authority_rules_to_json",
+    "param_flow_rules_from_json",
+    "param_flow_rules_to_json",
+]

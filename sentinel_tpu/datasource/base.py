@@ -1,0 +1,115 @@
+"""Datasource abstractions.
+
+Analogs: ``ReadableDataSource<S,T>`` / ``WritableDataSource<T>`` /
+``Converter<S,T>`` and ``AbstractDataSource`` / ``AutoRefreshDataSource``
+(``sentinel-datasource-extension/.../datasource/AbstractDataSource.java:29``,
+``AutoRefreshDataSource.java:32``), plus ``WritableDataSourceRegistry``
+(write-back target of the ``setRules`` command,
+``ModifyRulesCommandHandler.java:46``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Generic, Optional, TypeVar
+
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.core.property import DynamicProperty
+
+S = TypeVar("S")
+T = TypeVar("T")
+
+Converter = Callable[[S], T]
+
+
+class ReadableDataSource(Generic[S, T]):
+    """Parses a source value into rules and publishes into ``property``."""
+
+    def __init__(self, converter: Converter):
+        self.converter = converter
+        self.property: DynamicProperty = DynamicProperty()
+
+    def read_source(self) -> S:
+        raise NotImplementedError
+
+    def load_config(self) -> Optional[T]:
+        return self.converter(self.read_source())
+
+    def refresh(self) -> None:
+        try:
+            self.property.update_value(self.load_config())
+        except Exception as e:
+            record_log.warning("datasource refresh failed: %s", e)
+
+    def close(self) -> None:
+        pass
+
+
+class AutoRefreshDataSource(ReadableDataSource[S, T]):
+    """Polls ``read_source`` on a background thread
+    (``AutoRefreshDataSource.java:32``). Subclasses may override
+    ``is_modified`` to skip unchanged sources."""
+
+    def __init__(self, converter: Converter, refresh_interval_s: float = 3.0):
+        super().__init__(converter)
+        self.refresh_interval_s = refresh_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "AutoRefreshDataSource":
+        self.refresh()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="sentinel-datasource-refresh"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.refresh_interval_s):
+            try:
+                if self.is_modified():
+                    self.refresh()
+            except Exception as e:
+                record_log.warning("datasource poll failed: %s", e)
+
+    def is_modified(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class WritableDataSource(Generic[T]):
+    def write(self, value: T) -> None:
+        raise NotImplementedError
+
+
+class WritableDataSourceRegistry:
+    """Per-rule-type write-back targets (``WritableDataSourceRegistry.java``)."""
+
+    _lock = threading.RLock()
+    _sources: Dict[str, WritableDataSource] = {}
+
+    @classmethod
+    def register(cls, rule_type: str, source: WritableDataSource) -> None:
+        with cls._lock:
+            cls._sources[rule_type] = source
+
+    @classmethod
+    def get(cls, rule_type: str) -> Optional[WritableDataSource]:
+        return cls._sources.get(rule_type)
+
+    @classmethod
+    def write_if_registered(cls, rule_type: str, value) -> bool:
+        src = cls.get(rule_type)
+        if src is None:
+            return False
+        src.write(value)
+        return True
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._sources.clear()

@@ -53,7 +53,18 @@ class ParamFlowRule:
     cluster_mode: bool = False
     cluster_config: Optional[dict] = None
 
+    # built once at rule load (parsedHotItems analog); falls back to a scan
+    # for unhashable override values
+    _item_map: Optional[Dict[Any, float]] = field(
+        default=None, repr=False, compare=False
+    )
+
     def item_threshold(self, value: Any) -> float:
+        if self._item_map is not None:
+            try:
+                return self._item_map.get(value, self.count)
+            except TypeError:
+                pass  # unhashable value
         for item in self.items:
             if item.object_value == value:
                 return item.count
@@ -145,12 +156,26 @@ def _check_throttle(rule: ParamFlowRule, st: _RuleState, value: Any, acquire: in
 
 
 def _check_thread(rule: ParamFlowRule, st: _RuleState, value: Any, acquire: int) -> bool:
+    """Check-and-increment atomically under the rule lock; the caller rolls
+    back on a later rule's block (reference splits check and increment across
+    the slot chain, widening a TOCTOU window — here the cap cannot be
+    exceeded)."""
     threshold = rule.item_threshold(value)
     with st.lock:
         cur = st.threads.get(value, 0)
         if cur + acquire > threshold:
             return False
-        return True  # increment happens post-pass in the slot
+        st.threads[value] = cur + acquire
+        return True
+
+
+def _release_thread(st: _RuleState, value: Any, count: int) -> None:
+    with st.lock:
+        remaining = st.threads.get(value, 0) - count
+        if remaining > 0:
+            st.threads[value] = remaining
+        else:
+            st.threads.pop(value, None)
 
 
 class ParamFlowRuleManager:
@@ -159,12 +184,32 @@ class ParamFlowRuleManager:
 
     @classmethod
     def load_rules(cls, rules: List[ParamFlowRule]) -> None:
-        new_map: Dict[str, List[Tuple[ParamFlowRule, _RuleState]]] = {}
-        for rule in rules or []:
-            if not rule.resource or rule.count < 0 or rule.param_idx < 0:
-                continue
-            new_map.setdefault(rule.resource, []).append((rule, _RuleState()))
         with cls._lock:
+            # preserve counters for rules that did not change (the reference's
+            # ParameterMetric cache keyed by rule survives reloads) — a
+            # datasource republish must not refill every value's bucket or
+            # orphan in-flight THREAD holds
+            old: Dict[str, List[Tuple[ParamFlowRule, _RuleState]]] = cls._rules
+            leftovers = {res: list(lst) for res, lst in old.items()}
+            new_map: Dict[str, List[Tuple[ParamFlowRule, _RuleState]]] = {}
+            for rule in rules or []:
+                if not rule.resource or rule.count < 0 or rule.param_idx < 0:
+                    continue
+                state = None
+                for i, (old_rule, old_state) in enumerate(
+                    leftovers.get(rule.resource, [])
+                ):
+                    if old_rule == rule:
+                        state = old_state
+                        del leftovers[rule.resource][i]
+                        break
+                if state is None:
+                    state = _RuleState()
+                try:
+                    rule._item_map = {i.object_value: i.count for i in rule.items}
+                except TypeError:
+                    rule._item_map = None
+                new_map.setdefault(rule.resource, []).append((rule, state))
             cls._rules = new_map
 
     @classmethod
@@ -181,20 +226,24 @@ class ParamFlowRuleManager:
             cls._rules = {}
 
 
-def _pass_check(rule: ParamFlowRule, st: _RuleState, value: Any, acquire: int) -> bool:
+def _pass_check(
+    rule: ParamFlowRule, st: _RuleState, value: Any, acquire: int
+) -> Tuple[bool, bool]:
+    """Returns ``(passed, thread_hold_taken)``."""
     if rule.cluster_mode:
         ok = _pass_cluster_check(rule, value, acquire)
         if ok is not None:
-            return ok
+            return ok, False
         # fall through to local when the cluster path is unavailable
         cfg = rule.cluster_config or {}
         if not cfg.get("fallback_to_local_when_fail", True):
-            return True
+            return True, False
     if rule.grade == FlowGrade.THREAD:
-        return _check_thread(rule, st, value, acquire)
+        ok = _check_thread(rule, st, value, acquire)
+        return ok, ok
     if rule.control_behavior == ControlBehavior.RATE_LIMITER:
-        return _check_throttle(rule, st, value, acquire)
-    return _check_qps(rule, st, value, acquire)
+        return _check_throttle(rule, st, value, acquire), False
+    return _check_qps(rule, st, value, acquire), False
 
 
 def _pass_cluster_check(rule: ParamFlowRule, value: Any, acquire: int):
@@ -225,25 +274,23 @@ class ParamFlowSlot(ProcessorSlot):
     def entry(self, context, resource, node, count, prioritized, args):
         rules = ParamFlowRuleManager.get_rules(resource.name)
         if rules:
+            holds = []  # THREAD increments already taken, for exit/rollback
             for rule, st in rules:
                 if rule.param_idx >= len(args):
                     continue  # no such arg → rule not applicable
                 value = args[rule.param_idx]
                 if value is None:
                     continue
-                if not _pass_check(rule, st, value, count):
+                ok, held = _pass_check(rule, st, value, count)
+                if held:
+                    holds.append((st, value))
+                if not ok:
+                    # roll back holds taken by earlier rules of this entry
+                    for h_st, h_value in holds:
+                        _release_thread(h_st, h_value, count)
                     raise ParamFlowException(
                         resource.name, f"param flow: {resource.name}", rule
                     )
-            # record thread-grade holds for exit-side decrement
-            holds = []
-            for rule, st in rules:
-                if rule.grade == FlowGrade.THREAD and rule.param_idx < len(args):
-                    value = args[rule.param_idx]
-                    if value is not None:
-                        with st.lock:
-                            st.threads[value] = st.threads.get(value, 0) + count
-                        holds.append((st, value))
             if holds:
                 context.cur_entry.param_holds = holds
         self.fire_entry(context, resource, node, count, prioritized, args)
@@ -253,12 +300,7 @@ class ParamFlowSlot(ProcessorSlot):
         holds = getattr(entry, "param_holds", None) if entry else None
         if holds:
             for st, value in holds:
-                with st.lock:
-                    remaining = st.threads.get(value, 0) - count
-                    if remaining > 0:
-                        st.threads[value] = remaining
-                    else:
-                        st.threads.pop(value, None)
+                _release_thread(st, value, count)
         self.fire_exit(context, resource, count, args)
 
 

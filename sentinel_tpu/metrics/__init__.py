@@ -1,0 +1,12 @@
+"""Metric log pipeline (analog of ``node/metric/*`` in the reference):
+1-second aggregation of every resource's cluster node into rolling log files,
+plus the searcher the dashboard's ``/metric`` command reads."""
+
+from sentinel_tpu.metrics.log import (
+    MetricNode,
+    MetricWriter,
+    MetricSearcher,
+    MetricTimer,
+)
+
+__all__ = ["MetricNode", "MetricWriter", "MetricSearcher", "MetricTimer"]

@@ -1,0 +1,11 @@
+"""Control plane: command center (HTTP) + heartbeat.
+
+Analog of ``sentinel-transport`` — an embedded HTTP server exposing
+``CommandHandler``-style endpoints (rule CRUD, metrics pull, node trees,
+cluster mode) and a periodic heartbeat POST to the dashboard.
+"""
+
+from sentinel_tpu.transport.command import CommandCenter, command_mapping
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+__all__ = ["CommandCenter", "command_mapping", "HeartbeatSender"]

@@ -1,0 +1,121 @@
+"""Embedded HTTP command center.
+
+Analog of ``SimpleHttpCommandCenter.java:48`` + the ``@CommandMapping``
+handler SPI (``command/CommandHandler.java``): ``GET/POST /<command>?args``
+dispatches to a registered handler; ``/api`` lists all commands
+(``ApiCommandHandler``). Handlers register via the ``command_handler``
+registry, so extensions add endpoints exactly like the reference's SPI.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.core.registry import registry
+
+command_registry = registry("command_handler")
+
+# handler signature: (params: Dict[str, str], body: str) -> str | dict
+CommandHandler = Callable[[Dict[str, str], str], object]
+
+_commands: Dict[str, tuple] = {}  # name -> (desc, handler)
+
+
+def command_mapping(name: str, desc: str = ""):
+    """``@CommandMapping(name, desc)`` analog."""
+
+    def deco(fn: CommandHandler) -> CommandHandler:
+        _commands[name] = (desc, fn)
+        return fn
+
+    return deco
+
+
+def get_command(name: str):
+    entry = _commands.get(name)
+    return entry[1] if entry else None
+
+
+def list_commands() -> Dict[str, str]:
+    return {name: desc for name, (desc, _) in _commands.items()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "SentinelTPU"
+
+    def _dispatch(self, body: str) -> None:
+        parsed = urlparse(self.path)
+        name = parsed.path.strip("/")
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        if name == "api":
+            self._reply(200, json.dumps(list_commands()))
+            return
+        handler = get_command(name)
+        if handler is None:
+            self._reply(404, f"Unknown command `{name}`; see /api")
+            return
+        try:
+            result = handler(params, body)
+        except Exception as e:
+            record_log.exception("command %s failed", name)
+            self._reply(500, f"command failed: {e}")
+            return
+        if isinstance(result, (dict, list)):
+            self._reply(200, json.dumps(result))
+        else:
+            self._reply(200, str(result))
+
+    def _reply(self, code: int, text: str) -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        self._dispatch("")
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length).decode() if length else ""
+        self._dispatch(body)
+
+    def log_message(self, fmt, *args):  # quiet; record_log has the failures
+        pass
+
+
+class CommandCenter:
+    def __init__(self, host: str = "0.0.0.0", port: int = 8719):
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CommandCenter":
+        # make sure the default handlers are registered
+        from sentinel_tpu.transport import handlers  # noqa: F401
+
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="sentinel-command-center",
+        )
+        self._thread.start()
+        record_log.info("command center on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

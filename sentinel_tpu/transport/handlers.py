@@ -1,0 +1,214 @@
+"""Default command handlers.
+
+Analogs of the handler set in ``sentinel-transport-common/.../command/handler``
+(``version``, ``basicInfo``, ``getRules``/``setRules``
+(``FetchActiveRuleCommandHandler.java:31`` / ``ModifyRulesCommandHandler.java:
+46``), ``metric`` (``SendMetricCommandHandler.java:41``), ``clusterNode``,
+``tree``, ``systemStatus``, ``setClusterMode``/``getClusterMode`` and the
+cluster-server metric fetch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import sentinel_tpu
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.core.config import SentinelConfig
+from sentinel_tpu.datasource import converters as conv
+from sentinel_tpu.datasource.base import WritableDataSourceRegistry
+from sentinel_tpu.local.authority import AuthorityRuleManager
+from sentinel_tpu.local.degrade import DegradeRuleManager
+from sentinel_tpu.local.flow import FlowRuleManager
+from sentinel_tpu.local.param import ParamFlowRuleManager
+from sentinel_tpu.local.system_adaptive import SystemRuleManager
+from sentinel_tpu.transport.command import command_mapping
+
+_RULE_TYPES = {
+    "flow": (
+        lambda: conv.flow_rules_to_json(FlowRuleManager.all_rules()),
+        lambda text: FlowRuleManager.load_rules(conv.flow_rules_from_json(text)),
+    ),
+    "degrade": (
+        lambda: conv.degrade_rules_to_json(
+            [cb.rule for lst in DegradeRuleManager._breakers.values() for cb in lst]
+        ),
+        lambda text: DegradeRuleManager.load_rules(
+            conv.degrade_rules_from_json(text)
+        ),
+    ),
+    "system": (
+        lambda: conv.system_rules_to_json(
+            [SystemRuleManager._effective] if SystemRuleManager._any_enabled else []
+        ),
+        lambda text: SystemRuleManager.load_rules(conv.system_rules_from_json(text)),
+    ),
+    "authority": (
+        lambda: conv.authority_rules_to_json(
+            [r for lst in AuthorityRuleManager._rules.values() for r in lst]
+        ),
+        lambda text: AuthorityRuleManager.load_rules(
+            conv.authority_rules_from_json(text)
+        ),
+    ),
+    "paramFlow": (
+        lambda: conv.param_flow_rules_to_json(
+            [r for lst in ParamFlowRuleManager._rules.values() for r, _ in lst]
+        ),
+        lambda text: ParamFlowRuleManager.load_rules(
+            conv.param_flow_rules_from_json(text)
+        ),
+    ),
+}
+
+
+@command_mapping("version", "framework version")
+def cmd_version(params, body):
+    return f"sentinel-tpu/{sentinel_tpu.__version__}"
+
+
+@command_mapping("basicInfo", "machine basic info")
+def cmd_basic_info(params, body):
+    import socket
+
+    return {
+        "appName": SentinelConfig.app_name(),
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "version": sentinel_tpu.__version__,
+        "currentTime": _clock.now_ms(),
+    }
+
+
+@command_mapping("getRules", "get active rules; type=flow|degrade|system|authority|paramFlow")
+def cmd_get_rules(params, body):
+    rtype = params.get("type", "flow")
+    if rtype not in _RULE_TYPES:
+        return {"error": f"unknown rule type {rtype}"}
+    return json.loads(_RULE_TYPES[rtype][0]())
+
+
+@command_mapping("setRules", "replace rules; type=... body/data=json array")
+def cmd_set_rules(params, body):
+    rtype = params.get("type", "flow")
+    if rtype not in _RULE_TYPES:
+        return {"error": f"unknown rule type {rtype}"}
+    data = body or params.get("data", "[]")
+    _RULE_TYPES[rtype][1](data)
+    # write-through to a registered writable datasource
+    # (ModifyRulesCommandHandler.java:58)
+    WritableDataSourceRegistry.write_if_registered(rtype, data)
+    return "success"
+
+
+@command_mapping("metric", "metric log lines; startTime&endTime[&identity]")
+def cmd_metric(params, body):
+    from sentinel_tpu.metrics.log import MetricSearcher, MetricWriter
+
+    begin = int(params.get("startTime", 0))
+    end = int(params.get("endTime", 2**62))
+    identity = params.get("identity")
+    writer_dir = MetricWriter().base_dir
+    searcher = MetricSearcher(writer_dir, SentinelConfig.app_name())
+    lines = [n.to_line() for n in searcher.find(begin, end, identity)]
+    return "\n".join(lines)
+
+
+@command_mapping("clusterNode", "per-resource statistics snapshot")
+def cmd_cluster_node(params, body):
+    from sentinel_tpu.local.chain import cluster_node_map
+
+    now = _clock.now_ms()
+    out = []
+    for name, cn in cluster_node_map().items():
+        out.append(
+            {
+                "resourceName": name,
+                "passQps": cn.pass_qps(now),
+                "blockQps": cn.block_qps(now),
+                "totalQps": cn.total_qps(now),
+                "averageRt": cn.avg_rt(now),
+                "exceptionQps": cn.exception_qps(now),
+                "threadNum": cn.cur_thread_num,
+                "oneMinutePass": cn.total_pass_minute(now),
+            }
+        )
+    return out
+
+
+@command_mapping("origin", "per-origin statistics for a resource; id=<resource>")
+def cmd_origin(params, body):
+    from sentinel_tpu.local.chain import get_cluster_node
+
+    cn = get_cluster_node(params.get("id", ""))
+    if cn is None:
+        return []
+    now = _clock.now_ms()
+    return [
+        {
+            "origin": origin,
+            "passQps": node.pass_qps(now),
+            "blockQps": node.block_qps(now),
+            "averageRt": node.avg_rt(now),
+            "threadNum": node.cur_thread_num,
+        }
+        for origin, node in cn.origin_nodes.items()
+    ]
+
+
+@command_mapping("tree", "invocation tree")
+def cmd_tree(params, body):
+    from sentinel_tpu.local import context as ctx_mod
+
+    def walk(node, depth=0):
+        name = getattr(node, "resource", None)
+        label = name.name if name else "?"
+        lines = ["  " * depth + label]
+        for child in getattr(node, "children", []):
+            lines.extend(walk(child, depth + 1))
+        return lines
+
+    return "\n".join(walk(ctx_mod.ROOT))
+
+
+@command_mapping("systemStatus", "system-adaptive state")
+def cmd_system_status(params, body):
+    from sentinel_tpu.local.chain import entry_node
+
+    now = _clock.now_ms()
+    en = entry_node()
+    return {
+        "load": SystemRuleManager.status.current_load(),
+        "cpuUsage": SystemRuleManager.status.current_cpu_usage(),
+        "inboundQps": en.pass_qps(now),
+        "inboundThreads": en.cur_thread_num,
+        "avgRt": en.avg_rt(now),
+    }
+
+
+@command_mapping("getClusterMode", "cluster state: -1 off, 0 client, 1 server")
+def cmd_get_cluster_mode(params, body):
+    from sentinel_tpu.cluster import api as cluster_api
+
+    return {"mode": int(cluster_api.get_mode())}
+
+
+@command_mapping("setClusterMode", "switch cluster state; mode=0|1")
+def cmd_set_cluster_mode(params, body):
+    from sentinel_tpu.cluster import api as cluster_api
+
+    mode = int(params.get("mode", -1))
+    cluster_api.set_mode(cluster_api.ClusterMode(mode))
+    return "success"
+
+
+@command_mapping("cluster/server/metrics", "token-server per-flow metrics")
+def cmd_cluster_server_metrics(params, body):
+    from sentinel_tpu.cluster import api as cluster_api
+
+    service = cluster_api._pick_service()
+    snapshot = getattr(service, "metrics_snapshot", None)
+    if snapshot is None:
+        return {}
+    return {str(k): v for k, v in snapshot().items()}

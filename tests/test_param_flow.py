@@ -124,6 +124,52 @@ class TestLocalParamQps:
         assert manual_clock.now_ms() - t0 == pytest.approx(400, abs=1)
 
 
+class TestReloadAndHashing:
+    def test_republish_preserves_value_buckets(self, manual_clock):
+        # regression: reloading an identical rule set must not refill buckets
+        rules = [ParamFlowRule(resource="rp", param_idx=0, count=3)]
+        ParamFlowRuleManager.load_rules(rules)
+        assert hit("rp", "k", 3) == (3, 0)
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="rp", param_idx=0, count=3)]
+        )
+        assert hit("rp", "k", 1) == (0, 1)  # still drained
+
+    def test_republish_preserves_thread_holds(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="rp2", param_idx=0, count=1,
+                           grade=FlowGrade.THREAD)]
+        )
+        e1 = sentinel.entry("rp2", args=("k",))
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="rp2", param_idx=0, count=1,
+                           grade=FlowGrade.THREAD)]
+        )
+        with pytest.raises(BlockException):
+            sentinel.entry("rp2", args=("k",))  # hold survives the reload
+        e1.exit()
+        e2 = sentinel.entry("rp2", args=("k",))
+        e2.exit()
+
+    def test_changed_rule_gets_fresh_state(self, manual_clock):
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="rp3", param_idx=0, count=2)]
+        )
+        assert hit("rp3", "k", 2) == (2, 0)
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="rp3", param_idx=0, count=5)]  # changed
+        )
+        ok, _ = hit("rp3", "k", 6)
+        assert ok == 5  # fresh bucket at the new threshold
+
+    def test_hash_type_tagged(self):
+        assert stable_param_hash(1) != stable_param_hash("1")
+        assert stable_param_hash("1") != stable_param_hash(b"1")
+        assert stable_param_hash(True) != stable_param_hash(1)
+        assert stable_param_hash(None) != stable_param_hash("None")
+        assert stable_param_hash("x") == stable_param_hash("x")
+
+
 class TestCmsEngine:
     CFG = ParamConfig(max_param_rules=8, depth=2, width=512)
 

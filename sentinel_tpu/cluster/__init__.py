@@ -19,6 +19,11 @@ from sentinel_tpu.cluster.token_service import (
     TokenService,
     DefaultTokenService,
 )
+from sentinel_tpu.cluster.concurrent import (
+    ConcurrencyManager,
+    ConcurrentFlowRule,
+    ExpiryTask,
+)
 from sentinel_tpu.cluster.api import (
     ClusterMode,
     get_mode,
@@ -31,6 +36,9 @@ __all__ = [
     "TokenResult",
     "TokenService",
     "DefaultTokenService",
+    "ConcurrencyManager",
+    "ConcurrentFlowRule",
+    "ExpiryTask",
     "ClusterMode",
     "get_mode",
     "set_mode",

@@ -137,6 +137,30 @@ class TokenClient(TokenService):
             return TokenResult(TokenStatus.FAIL)
         return TokenResult(TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms)
 
+    def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
+        rsp = self._roundtrip(
+            P.FlowRequest(
+                next(self._xid), flow_id, acquire, prioritized,
+                P.MsgType.CONCURRENT_ACQUIRE,
+            )
+        )
+        if rsp is None:
+            return TokenResult(TokenStatus.FAIL)
+        return TokenResult(
+            TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms, rsp.token_id
+        )
+
+    def release_concurrent_token(self, token_id):
+        # the flow_id slot carries the token id on the wire (protocol docstring)
+        rsp = self._roundtrip(
+            P.FlowRequest(
+                next(self._xid), token_id, 0, False, P.MsgType.CONCURRENT_RELEASE
+            )
+        )
+        if rsp is None:
+            return TokenResult(TokenStatus.FAIL)
+        return TokenResult(TokenStatus(rsp.status))
+
     def ping(self) -> bool:
         return self._roundtrip(P.Ping(next(self._xid))) is not None
 

@@ -18,6 +18,12 @@ the response envelope and ``remaining/waitInMs`` in data,
 Param-flow request data = flow request + ``n_params:uint8`` + per-param
 ``hash:int64`` (the TPU server sketches param *hashes*; raw values never cross
 the wire — see SURVEY.md §5 long-context note).
+
+Concurrent (cluster-semaphore) messages: CONCURRENT_ACQUIRE uses the flow
+request layout; its response appends ``token_id:int64`` (the reference moves
+the token id in ``ConcurrentFlowAcquireResponseData``). CONCURRENT_RELEASE
+reuses the ``flow_id`` slot to carry the token id being released
+(``ConcurrentFlowReleaseRequestData`` carries only ``tokenId``).
 """
 
 from __future__ import annotations
@@ -59,6 +65,7 @@ class FlowResponse:
     status: int
     remaining: int = 0
     wait_ms: int = 0
+    token_id: int = 0  # CONCURRENT_ACQUIRE only
 
 
 @dataclass(frozen=True)
@@ -88,6 +95,8 @@ def encode_response(rsp: FlowResponse) -> bytes:
     payload = _HEAD.pack(rsp.xid, rsp.msg_type) + _FLOW_RSP.pack(
         rsp.status, rsp.remaining, rsp.wait_ms
     )
+    if rsp.msg_type == MsgType.CONCURRENT_ACQUIRE:
+        payload += struct.pack(">q", rsp.token_id)
     return _LEN.pack(len(payload)) + payload
 
 
@@ -112,8 +121,13 @@ def decode_request(payload: bytes):
 
 def decode_response(payload: bytes) -> FlowResponse:
     xid, mtype = _HEAD.unpack_from(payload, 0)
+    mtype = MsgType(mtype)
     status, remaining, wait_ms = _FLOW_RSP.unpack_from(payload, _HEAD.size)
-    return FlowResponse(xid, MsgType(mtype), status, remaining, wait_ms)
+    token_id = 0
+    off = _HEAD.size + _FLOW_RSP.size
+    if mtype == MsgType.CONCURRENT_ACQUIRE and len(payload) >= off + 8:
+        (token_id,) = struct.unpack_from(">q", payload, off)
+    return FlowResponse(xid, mtype, status, remaining, wait_ms, token_id)
 
 
 class FrameReader:

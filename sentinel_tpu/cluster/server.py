@@ -50,6 +50,9 @@ class TokenServer:
     def start(self) -> None:
         if self._thread is not None:
             return
+        warmup = getattr(self.service, "warmup", None)
+        if warmup is not None:
+            warmup()  # compile the decision kernels before accepting traffic
         self._start_error: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._run_loop, name="sentinel-token-server", daemon=True
@@ -181,12 +184,11 @@ class TokenServer:
     async def _process(self, batch) -> None:
         # route by message type: FLOW verdicts batch onto the device; param
         # requests go to the param sketch path; concurrent acquire/release to
-        # the semaphore path (FAIL until that milestone lands — they must not
-        # silently consume flow budget)
+        # the host-side semaphore path
         flow_items = [
             (i, r) for i, (r, _) in enumerate(batch) if r.msg_type == P.MsgType.FLOW
         ]
-        results: Dict[int, Tuple[int, int, int]] = {}
+        results: Dict[int, Tuple[int, int, int, int]] = {}  # status, remaining, wait_ms, token_id
         if flow_items:
             flow_reqs = [(r.flow_id, r.count, r.prioritized) for _, r in flow_items]
             try:
@@ -198,10 +200,10 @@ class TokenServer:
                 flow_results = None
             for k, (i, _) in enumerate(flow_items):
                 if flow_results is None:
-                    results[i] = (int(TokenStatus.FAIL), 0, 0)
+                    results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
                 else:
                     r = flow_results[k]
-                    results[i] = (int(r.status), r.remaining, r.wait_ms)
+                    results[i] = (int(r.status), r.remaining, r.wait_ms, 0)
         for i, (req, _) in enumerate(batch):
             if req.msg_type == P.MsgType.PARAM_FLOW:
                 try:
@@ -209,22 +211,42 @@ class TokenServer:
                         self.service.request_params_token,
                         req.flow_id, req.count, req.param_hashes,
                     )
-                    results[i] = (int(r.status), r.remaining, r.wait_ms)
+                    results[i] = (int(r.status), r.remaining, r.wait_ms, 0)
                 except Exception:
                     record_log.exception("param token request failed")
-                    results[i] = (int(TokenStatus.FAIL), 0, 0)
-            elif req.msg_type in (
-                P.MsgType.CONCURRENT_ACQUIRE, P.MsgType.CONCURRENT_RELEASE
-            ):
-                results.setdefault(i, (int(TokenStatus.FAIL), 0, 0))
+                    results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
+            elif req.msg_type == P.MsgType.CONCURRENT_ACQUIRE:
+                try:
+                    r = await asyncio.to_thread(
+                        self.service.request_concurrent_token,
+                        req.flow_id, req.count, req.prioritized,
+                    )
+                    results[i] = (int(r.status), r.remaining, r.wait_ms, r.token_id)
+                except Exception:
+                    record_log.exception("concurrent acquire failed")
+                    results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
+            elif req.msg_type == P.MsgType.CONCURRENT_RELEASE:
+                try:
+                    # flow_id slot carries the token id (protocol docstring)
+                    r = await asyncio.to_thread(
+                        self.service.release_concurrent_token, req.flow_id
+                    )
+                    results[i] = (int(r.status), 0, 0, 0)
+                except Exception:
+                    record_log.exception("concurrent release failed")
+                    results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
 
         writers_to_drain = set()
         for i, (req, writer) in enumerate(batch):
-            status, remaining, wait = results.get(i, (int(TokenStatus.FAIL), 0, 0))
+            status, remaining, wait, token_id = results.get(
+                i, (int(TokenStatus.FAIL), 0, 0, 0)
+            )
             try:
                 writer.write(
                     P.encode_response(
-                        P.FlowResponse(req.xid, req.msg_type, status, remaining, wait)
+                        P.FlowResponse(
+                            req.xid, req.msg_type, status, remaining, wait, token_id
+                        )
                     )
                 )
                 writers_to_drain.add(writer)

@@ -56,15 +56,19 @@ class ClusterParamFlowRule:
 
 @dataclass(frozen=True)
 class TokenResult:
-    """``TokenResult.java`` — status + remaining + wait hint."""
+    """``TokenResult.java`` — status + remaining + wait hint (+ token id in
+    concurrent mode)."""
 
     status: TokenStatus
     remaining: int = 0
     wait_ms: int = 0
+    token_id: int = 0
 
     @property
     def ok(self) -> bool:
-        return self.status == TokenStatus.OK
+        # RELEASE_OK is the success status of a concurrent release — the one
+        # natural success predicate must cover both acquire and release paths
+        return self.status in (TokenStatus.OK, TokenStatus.RELEASE_OK)
 
 
 class TokenService:
@@ -85,6 +89,15 @@ class TokenService:
     ) -> List[TokenResult]:
         """Vectorized form: list of (flow_id, acquire, prioritized)."""
         return [self.request_token(f, a, p) for f, a, p in requests]
+
+    def request_concurrent_token(
+        self, flow_id: int, acquire: int = 1, prioritized: bool = False
+    ) -> TokenResult:
+        """Cluster-semaphore acquire (``ConcurrentClusterFlowChecker``)."""
+        raise NotImplementedError
+
+    def release_concurrent_token(self, token_id: int) -> TokenResult:
+        raise NotImplementedError
 
 
 class DefaultTokenService(TokenService):
@@ -114,6 +127,12 @@ class DefaultTokenService(TokenService):
         self._param_state = make_param_state(self.param_config)
         self._param_rules: Dict[int, Tuple[int, float, Dict[int, float]]] = {}
         self._param_free = list(range(self.param_config.max_param_rules - 1, -1, -1))
+        # concurrent (semaphore) mode — host-side by design, see
+        # sentinel_tpu.cluster.concurrent
+        from sentinel_tpu.cluster.concurrent import ConcurrencyManager
+
+        self.concurrency = ConcurrencyManager()
+        self._expiry = None  # background sweep; started on first rule load
 
     # -- rule management (ClusterFlowRuleManager analog) --------------------
     def load_rules(
@@ -137,6 +156,7 @@ class DefaultTokenService(TokenService):
         """``ConnectionManager`` callback: AVG_LOCAL thresholds scale with it.
         Counts persist across rule reloads. Namespaces no rule uses are
         remembered host-side but allocate no device slot."""
+        self.concurrency.set_connected_count(max(1, int(n)), namespace)
         with self._lock:
             self._connected[namespace] = max(1, int(n))
             ns = self._index.ns_of.get(namespace)
@@ -180,6 +200,31 @@ class DefaultTokenService(TokenService):
         return now
 
     # -- decision path ------------------------------------------------------
+    def warmup(self) -> None:
+        """Trigger XLA compilation of the decision kernels before serving.
+
+        First-compile latency (~1s on CPU, tens of seconds on TPU) must not be
+        paid by the first real request — it would blow the 20ms client budget
+        *and* let early traffic slip through an expired window."""
+        with self._lock:
+            now = self._engine_now()
+            batch = make_batch(self.config, [-1])
+            decide(self.config, self._state, self._table, batch, jnp.int32(now))
+            idx = hash_indices(
+                np.zeros(1, np.int64), self.param_config.depth, self.param_config.width
+            )
+            n_pad = 8  # matches request_params_token's minimum padded shape
+            param_decide(
+                self.param_config,
+                self._param_state,
+                jnp.zeros(n_pad, jnp.int32),
+                jnp.asarray(np.broadcast_to(idx, (n_pad, idx.shape[1]))),
+                jnp.zeros(n_pad, jnp.int32),
+                jnp.zeros(n_pad, jnp.float32),
+                jnp.zeros(n_pad, bool),  # nothing valid → state unchanged
+                jnp.int32(now),
+            )
+
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
         return self.request_batch([(flow_id, acquire, prioritized)])[0]
 
@@ -289,6 +334,30 @@ class DefaultTokenService(TokenService):
         if bool(np.asarray(admit)[:n].all()):
             return TokenResult(TokenStatus.OK)
         return TokenResult(TokenStatus.BLOCKED)
+
+    # -- concurrent (semaphore) mode ----------------------------------------
+    def load_concurrent_rules(self, rules) -> None:
+        self.concurrency.load_rules(rules)
+        # the acquire-path sweep is bounded (64 entries), so a crashed client
+        # holding permits behind long-TTL live tokens needs the background
+        # sweep (RegularExpireStrategy analog) to reclaim them
+        if rules and self._expiry is None:
+            from sentinel_tpu.cluster.concurrent import ExpiryTask
+
+            self._expiry = ExpiryTask(self.concurrency)
+            self._expiry.start()
+
+    def close(self) -> None:
+        if self._expiry is not None:
+            self._expiry.stop()
+            self._expiry = None
+
+    def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
+        r = self.concurrency.acquire(flow_id, acquire, prioritized)
+        return TokenResult(r.status, r.remaining, 0, r.token_id)
+
+    def release_concurrent_token(self, token_id):
+        return TokenResult(self.concurrency.release(token_id))
 
     # -- introspection (FetchClusterMetricCommandHandler analog) ------------
     def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:

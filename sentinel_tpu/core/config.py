@@ -31,8 +31,8 @@ _DEFAULTS: Dict[str, str] = {
     "sentinel.tpu.engine.batch.size": "1024",
     "sentinel.tpu.server.port": "18730",
     "sentinel.tpu.server.idle.seconds": "600",
-    "sentinel.tpu.command.port": "8719",
-    "sentinel.tpu.heartbeat.interval.ms": "10000",
+    "csp.sentinel.api.port": "8719",
+    "csp.sentinel.heartbeat.interval.ms": "10000",
 }
 
 

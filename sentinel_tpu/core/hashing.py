@@ -19,6 +19,12 @@ def stable_param_hash(value: Any) -> int:
     bytes, numbers, bools, None, and containers thereof). Objects whose
     ``repr`` embeds ``id()`` hash per-instance — pass a stable key (e.g. the
     object's id field) as the parameter instead.
+
+    **Wire contract**: these hashes cross the token-RPC wire (PARAM_FLOW
+    requests carry hashes, not values — ``cluster/protocol.py``), so every
+    node of a cluster must hash identically. Any change to the tagging or
+    digest here is a protocol break and must ship with a wire-protocol
+    version bump and a rolling-upgrade note.
     """
     if isinstance(value, bytes):
         tag, data = b"b", value

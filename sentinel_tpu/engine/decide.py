@@ -52,6 +52,9 @@ class TokenStatus(enum.IntEnum):
     NO_RULE_EXISTS = 3
     TOO_MANY_REQUEST = 4
     FAIL = 5
+    # concurrent (cluster-semaphore) mode only:
+    RELEASE_OK = 6
+    ALREADY_RELEASE = 7
 
 
 class RequestBatch(NamedTuple):

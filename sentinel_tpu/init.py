@@ -54,7 +54,7 @@ def init_default(
         port = (
             command_port
             if command_port is not None
-            else SentinelConfig.get_int("sentinel.tpu.command.port", 8719)
+            else SentinelConfig.get_int("csp.sentinel.api.port", 8719)
         )
         cc = CommandCenter(port=port).start()
         hb = HeartbeatSender(command_port=cc.port).start()

@@ -64,16 +64,22 @@ class MetricNode:
         )
 
 
+def default_metric_dir() -> str:
+    """Where metric logs live unless overridden (shared by the writer and the
+    ``/metric`` command handler, which must read the same directory)."""
+    return os.path.join(
+        os.environ.get("SENTINEL_LOG_DIR") or os.path.expanduser("~/logs/csp"),
+        "metrics",
+    )
+
+
 class MetricWriter:
     """Size-rolled metric files with a second→offset index."""
 
     def __init__(self, base_dir: Optional[str] = None,
                  single_file_size: Optional[int] = None,
                  total_file_count: Optional[int] = None):
-        self.base_dir = base_dir or os.path.join(
-            os.environ.get("SENTINEL_LOG_DIR") or os.path.expanduser("~/logs/csp"),
-            "metrics",
-        )
+        self.base_dir = base_dir or default_metric_dir()
         os.makedirs(self.base_dir, exist_ok=True)
         self.single_file_size = single_file_size or SentinelConfig.get_int(
             "csp.sentinel.metric.file.single.size", 50 * 1024 * 1024
@@ -136,6 +142,27 @@ class MetricSearcher:
         self.base_dir = base_dir
         self.app = app
 
+    @staticmethod
+    def _seek_offset(idx_path: str, begin_ms: int) -> int:
+        """Largest indexed offset whose second precedes ``begin_ms`` — the
+        reference seeks the same way (``MetricSearcher.java``: binary-search
+        the .idx, then read forward)."""
+        begin_sec = begin_ms // 1000
+        offset = 0
+        try:
+            with open(idx_path, "r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        sec_s, off_s = line.split()
+                        if int(sec_s) >= begin_sec:
+                            break
+                        offset = int(off_s)
+                    except ValueError:
+                        continue
+        except OSError:
+            return 0
+        return offset
+
     def find(self, begin_ms: int, end_ms: int,
              identity: Optional[str] = None, max_lines: int = 12000) -> List[MetricNode]:
         out: List[MetricNode] = []
@@ -149,13 +176,16 @@ class MetricSearcher:
             path = os.path.join(self.base_dir, f"{self.app}-metrics.log.{i}")
             try:
                 with open(path, "r", encoding="utf-8") as f:
+                    f.seek(self._seek_offset(path + ".idx", begin_ms))
                     for line in f:
                         try:
                             node = MetricNode.from_line(line)
                         except (ValueError, IndexError):
                             continue
-                        if node.timestamp_ms < begin_ms or node.timestamp_ms > end_ms:
+                        if node.timestamp_ms < begin_ms:
                             continue
+                        if node.timestamp_ms > end_ms:
+                            break  # lines are time-ordered within a file
                         if identity and node.resource != identity:
                             continue
                         out.append(node)

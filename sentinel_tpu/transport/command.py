@@ -91,8 +91,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class CommandCenter:
-    def __init__(self, host: str = "0.0.0.0", port: int = 8719):
-        self.host = host
+    def __init__(self, host: Optional[str] = None, port: int = 8719):
+        # loopback by default: the command surface mutates rules with no
+        # auth; exposing it beyond the host is an explicit operator decision
+        # (csp.sentinel.api.port.binding, the reference's key for this)
+        from sentinel_tpu.core.config import SentinelConfig
+
+        self.host = host or SentinelConfig.get(
+            "csp.sentinel.api.port.binding"
+        ) or "127.0.0.1"
         self.port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None

@@ -25,40 +25,40 @@ from sentinel_tpu.local.param import ParamFlowRuleManager
 from sentinel_tpu.local.system_adaptive import SystemRuleManager
 from sentinel_tpu.transport.command import command_mapping
 
+# rule type → (serialize current rules to json, parse json, load parsed rules)
 _RULE_TYPES = {
     "flow": (
         lambda: conv.flow_rules_to_json(FlowRuleManager.all_rules()),
-        lambda text: FlowRuleManager.load_rules(conv.flow_rules_from_json(text)),
+        conv.flow_rules_from_json,
+        FlowRuleManager.load_rules,
     ),
     "degrade": (
         lambda: conv.degrade_rules_to_json(
             [cb.rule for lst in DegradeRuleManager._breakers.values() for cb in lst]
         ),
-        lambda text: DegradeRuleManager.load_rules(
-            conv.degrade_rules_from_json(text)
-        ),
+        conv.degrade_rules_from_json,
+        DegradeRuleManager.load_rules,
     ),
     "system": (
         lambda: conv.system_rules_to_json(
             [SystemRuleManager._effective] if SystemRuleManager._any_enabled else []
         ),
-        lambda text: SystemRuleManager.load_rules(conv.system_rules_from_json(text)),
+        conv.system_rules_from_json,
+        SystemRuleManager.load_rules,
     ),
     "authority": (
         lambda: conv.authority_rules_to_json(
             [r for lst in AuthorityRuleManager._rules.values() for r in lst]
         ),
-        lambda text: AuthorityRuleManager.load_rules(
-            conv.authority_rules_from_json(text)
-        ),
+        conv.authority_rules_from_json,
+        AuthorityRuleManager.load_rules,
     ),
     "paramFlow": (
         lambda: conv.param_flow_rules_to_json(
             [r for lst in ParamFlowRuleManager._rules.values() for r, _ in lst]
         ),
-        lambda text: ParamFlowRuleManager.load_rules(
-            conv.param_flow_rules_from_json(text)
-        ),
+        conv.param_flow_rules_from_json,
+        ParamFlowRuleManager.load_rules,
     ),
 }
 
@@ -95,22 +95,24 @@ def cmd_set_rules(params, body):
     if rtype not in _RULE_TYPES:
         return {"error": f"unknown rule type {rtype}"}
     data = body or params.get("data", "[]")
-    _RULE_TYPES[rtype][1](data)
-    # write-through to a registered writable datasource
+    _, parse, load = _RULE_TYPES[rtype]
+    rules = parse(data)
+    load(rules)
+    # write-through to a registered writable datasource, passing the parsed,
+    # normalized rules — the serializer contract takes rule objects
     # (ModifyRulesCommandHandler.java:58)
-    WritableDataSourceRegistry.write_if_registered(rtype, data)
+    WritableDataSourceRegistry.write_if_registered(rtype, rules)
     return "success"
 
 
 @command_mapping("metric", "metric log lines; startTime&endTime[&identity]")
 def cmd_metric(params, body):
-    from sentinel_tpu.metrics.log import MetricSearcher, MetricWriter
+    from sentinel_tpu.metrics.log import MetricSearcher, default_metric_dir
 
     begin = int(params.get("startTime", 0))
     end = int(params.get("endTime", 2**62))
     identity = params.get("identity")
-    writer_dir = MetricWriter().base_dir
-    searcher = MetricSearcher(writer_dir, SentinelConfig.app_name())
+    searcher = MetricSearcher(default_metric_dir(), SentinelConfig.app_name())
     lines = [n.to_line() for n in searcher.find(begin, end, identity)]
     return "\n".join(lines)
 

@@ -29,11 +29,12 @@ class HeartbeatSender:
     ):
         raw = SentinelConfig.get("csp.sentinel.dashboard.server") or ""
         self.addrs = dashboard_addrs or [a for a in raw.split(",") if a]
+        # keys keep the reference's names (TransportConfig.java:35-41)
         self.command_port = command_port or SentinelConfig.get_int(
-            "sentinel.tpu.command.port", 8719
+            "csp.sentinel.api.port", 8719
         )
         self.interval_ms = interval_ms or SentinelConfig.get_int(
-            "sentinel.tpu.heartbeat.interval.ms", 10_000
+            "csp.sentinel.heartbeat.interval.ms", 10_000
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -157,16 +157,13 @@ class TestReviewRegressions:
         finally:
             s1.stop()
 
-    def test_concurrent_msgs_fail_without_consuming_flow_budget(self, live_server):
+    def test_concurrent_msgs_do_not_consume_flow_budget(self, live_server):
+        # no concurrent rule for flow 1 → NO_RULE_EXISTS, flow budget untouched
         server, svc = live_server
         client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
         try:
-            rsp = client._roundtrip(
-                P.FlowRequest(
-                    next(client._xid), 1, 1, False, P.MsgType.CONCURRENT_ACQUIRE
-                )
-            )
-            assert rsp is not None and rsp.status == int(TokenStatus.FAIL)
+            r = client.request_concurrent_token(1)
+            assert r.status == TokenStatus.NO_RULE_EXISTS
             # flow budget untouched: all 5 still available
             oks = sum(client.request_token(1).ok for _ in range(6))
             assert oks == 5

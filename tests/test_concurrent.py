@@ -1,0 +1,139 @@
+"""Cluster concurrency (semaphore) mode tests.
+
+Mirrors the reference's ``ConcurrentClusterFlowCheckerTest`` /
+``CurrentConcurrencyManagerTest`` / ``TokenCacheNodeManagerTest`` strategy:
+checker semantics with an explicit clock, expiry without real sleeps, and
+(beyond the reference) one wire-level round-trip test.
+"""
+
+import pytest
+
+from sentinel_tpu.cluster.concurrent import (
+    ConcurrencyManager,
+    ConcurrentFlowRule,
+    ExpiryTask,
+)
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.server import TokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import EngineConfig, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture
+def mgr():
+    m = ConcurrencyManager()
+    m.load_rules(
+        [
+            ConcurrentFlowRule(flow_id=1, concurrency_level=3),
+            ConcurrentFlowRule(
+                flow_id=2, concurrency_level=2, mode=ThresholdMode.AVG_LOCAL
+            ),
+            ConcurrentFlowRule(flow_id=3, concurrency_level=5, resource_timeout_ms=100),
+        ]
+    )
+    return m
+
+
+class TestAcquireRelease:
+    def test_admit_up_to_level_then_block(self, mgr):
+        results = [mgr.acquire(1, now_ms=T0) for _ in range(4)]
+        assert [r.status for r in results[:3]] == [TokenStatus.OK] * 3
+        assert results[3].status == TokenStatus.BLOCKED
+        assert mgr.now_calls(1) == 3
+        assert results[0].remaining == 2 and results[2].remaining == 0
+
+    def test_release_frees_permit(self, mgr):
+        r1 = mgr.acquire(1, now_ms=T0)
+        assert mgr.release(r1.token_id) == TokenStatus.RELEASE_OK
+        assert mgr.now_calls(1) == 0
+        assert mgr.acquire(1, now_ms=T0).status == TokenStatus.OK
+
+    def test_double_release_is_idempotent(self, mgr):
+        r = mgr.acquire(1, now_ms=T0)
+        assert mgr.release(r.token_id) == TokenStatus.RELEASE_OK
+        assert mgr.release(r.token_id) == TokenStatus.ALREADY_RELEASE
+        assert mgr.now_calls(1) == 0  # no double decrement
+
+    def test_weighted_acquire(self, mgr):
+        assert mgr.acquire(1, acquire=2, now_ms=T0).status == TokenStatus.OK
+        assert mgr.acquire(1, acquire=2, now_ms=T0).status == TokenStatus.BLOCKED
+        assert mgr.acquire(1, acquire=1, now_ms=T0).status == TokenStatus.OK
+
+    def test_no_rule(self, mgr):
+        assert mgr.acquire(99, now_ms=T0).status == TokenStatus.NO_RULE_EXISTS
+
+    def test_avg_local_scales_with_connected_count(self, mgr):
+        # level 2 × 3 clients = 6 permits
+        mgr.set_connected_count(3)
+        results = [mgr.acquire(2, now_ms=T0) for _ in range(7)]
+        assert sum(r.status == TokenStatus.OK for r in results) == 6
+        assert results[6].status == TokenStatus.BLOCKED
+
+
+class TestExpiry:
+    def test_expired_tokens_reclaimed(self, mgr):
+        for _ in range(5):
+            assert mgr.acquire(3, now_ms=T0).status == TokenStatus.OK
+        assert mgr.acquire(3, now_ms=T0).status == TokenStatus.BLOCKED
+        # resource_timeout_ms=100: all expire by T0+101
+        reclaimed = mgr.expire(now_ms=T0 + 101)
+        assert reclaimed == 5
+        assert mgr.now_calls(3) == 0
+        assert mgr.acquire(3, now_ms=T0 + 101).status == TokenStatus.OK
+
+    def test_release_after_expiry_reports_already_release(self, mgr):
+        r = mgr.acquire(3, now_ms=T0)
+        mgr.expire(now_ms=T0 + 200)
+        assert mgr.release(r.token_id) == TokenStatus.ALREADY_RELEASE
+        assert mgr.now_calls(3) == 0
+
+    def test_acquire_sweeps_amortized(self, mgr):
+        # a crashed client's stale permits are reclaimed by the next acquire
+        for _ in range(5):
+            mgr.acquire(3, now_ms=T0)
+        r = mgr.acquire(3, now_ms=T0 + 150)  # after TTL: sweep frees all 5
+        assert r.status == TokenStatus.OK
+        assert mgr.now_calls(3) == 1
+
+    def test_mixed_ttls_sweep_all_expired(self):
+        m = ConcurrencyManager()
+        m.load_rules(
+            [
+                ConcurrentFlowRule(1, 10, resource_timeout_ms=1000),
+                ConcurrentFlowRule(2, 10, resource_timeout_ms=50),
+            ]
+        )
+        m.acquire(1, now_ms=T0)  # long TTL issued first
+        m.acquire(2, now_ms=T0)  # short TTL second
+        assert m.expire(now_ms=T0 + 100) == 1  # only flow 2's token expired
+        assert m.now_calls(1) == 1 and m.now_calls(2) == 0
+
+    def test_expiry_task_lifecycle(self, mgr):
+        task = ExpiryTask(mgr, interval_s=0.01)
+        task.start()
+        task.stop()  # no deadlock / thread leak
+
+
+class TestWire:
+    def test_acquire_release_over_socket(self):
+        svc = DefaultTokenService(EngineConfig(max_flows=8, max_namespaces=2, batch_size=8))
+        svc.load_concurrent_rules([ConcurrentFlowRule(flow_id=7, concurrency_level=2)])
+        server = TokenServer(svc, port=0, batch_window_ms=0.5)
+        server.start()
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            r1 = client.request_concurrent_token(7)
+            r2 = client.request_concurrent_token(7)
+            r3 = client.request_concurrent_token(7)
+            assert r1.ok and r2.ok
+            assert r1.token_id > 0 and r1.token_id != r2.token_id
+            assert r3.status == TokenStatus.BLOCKED
+            assert client.release_concurrent_token(r1.token_id).status == TokenStatus.RELEASE_OK
+            assert client.request_concurrent_token(7).ok
+            assert client.release_concurrent_token(r1.token_id).status == TokenStatus.ALREADY_RELEASE
+        finally:
+            client.close()
+            server.stop()

@@ -129,9 +129,13 @@ class TestCommandCenter:
         assert rules[0]["resource"] == "cmd_res"
 
     def test_set_rules_writes_through_datasource(self, command_center, tmp_path):
+        from sentinel_tpu.datasource import converters as conv
+
         path = tmp_path / "flow_out.json"
+        # the natural pairing: the handler hands *parsed rules* to the
+        # registered serializer (ModifyRulesCommandHandler.java:58)
         WritableDataSourceRegistry.register(
-            "flow", FileWritableDataSource(str(path), lambda text: text)
+            "flow", FileWritableDataSource(str(path), conv.flow_rules_to_json)
         )
         http_post(
             command_center, "setRules?type=flow",
@@ -139,6 +143,7 @@ class TestCommandCenter:
         )
         saved = json.loads(path.read_text())
         assert saved[0]["resource"] == "w_res"
+        assert saved[0]["count"] == 5
 
     def test_cluster_node_stats(self, command_center):
         with sentinel.entry("stat_cmd_res"):
@@ -185,6 +190,22 @@ class TestMetricLog:
         assert found[0].pass_qps == 10
         only = s.find(0, 2**61, identity="other")
         assert len(only) == 1 and only[0].pass_qps == 3
+
+    def test_searcher_seeks_via_index(self, tmp_path):
+        # many seconds of data; a narrow window must come back complete even
+        # though the seek skips everything before it
+        w = MetricWriter(base_dir=str(tmp_path), single_file_size=10_000_000)
+        t0 = 1_700_000_000_000
+        for i in range(200):
+            w.write([MetricNode(timestamp_ms=t0 + i * 1000, resource="r",
+                                pass_qps=i)])
+        w.close()
+        s = MetricSearcher(str(tmp_path), w.app)
+        found = s.find(t0 + 150_000, t0 + 152_000)
+        assert [n.pass_qps for n in found] == [150, 151, 152]
+        # the seek really skipped: offset for a late window is deep in the file
+        idx = str(tmp_path / f"{w.app}-metrics.log.0.idx")
+        assert s._seek_offset(idx, t0 + 150_000) > 0
 
     def test_rolling_keeps_bounded_files(self, tmp_path):
         w = MetricWriter(base_dir=str(tmp_path), single_file_size=200,

@@ -1,0 +1,43 @@
+"""Adapters: the API surface into user frameworks (``sentinel-adapter`` analog).
+
+Every adapter follows the one idiom of the reference's 19 modules
+(SURVEY.md §1 L7): parse resource name + origin from the framework request →
+enter context → ``entry`` → proceed → trace on error → ``exit``.
+
+- ``decorator``: ``@sentinel_resource`` function guard with
+  block-handler/fallback dispatch (``sentinel-annotation-aspectj`` analog).
+- ``wsgi``: WSGI middleware (``sentinel-web-servlet`` ``CommonFilter`` /
+  ``CommonTotalFilter`` analog).
+- ``asgi``: ASGI middleware (``spring-webmvc``/``webflux`` interceptor
+  analog; async-safe because the context is a ``contextvars.ContextVar``).
+- ``grpc_interceptor``: server + client interceptors
+  (``sentinel-grpc-adapter`` analog; gated on ``grpcio``).
+- ``http_client``: outbound-call guards for ``requests`` and ``httpx``
+  (``sentinel-okhttp/apache-httpclient-adapter`` analog; gated).
+- ``gateway``: param-based gateway flow rules + request parser
+  (``sentinel-api-gateway-adapter-common`` analog).
+"""
+
+from sentinel_tpu.adapters.decorator import sentinel_resource
+from sentinel_tpu.adapters.wsgi import SentinelWsgiMiddleware
+from sentinel_tpu.adapters.asgi import SentinelAsgiMiddleware
+from sentinel_tpu.adapters.gateway import (
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayRuleManager,
+    MatchStrategy,
+    ParseStrategy,
+    RequestAdapter,
+)
+
+__all__ = [
+    "sentinel_resource",
+    "SentinelWsgiMiddleware",
+    "SentinelAsgiMiddleware",
+    "GatewayFlowRule",
+    "GatewayParamFlowItem",
+    "GatewayRuleManager",
+    "MatchStrategy",
+    "ParseStrategy",
+    "RequestAdapter",
+]

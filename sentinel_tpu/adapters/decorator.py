@@ -1,0 +1,103 @@
+"""``@sentinel_resource`` — function-level guard with handler dispatch.
+
+Analog of the ``@SentinelResource`` annotation + aspect
+(``sentinel-annotation-aspectj/.../SentinelResourceAspect.java:36-68``,
+``AbstractSentinelAspectSupport.java:83-140``): the wrapped callable is the
+resource; on block the ``block_handler`` runs; on a business exception the
+error is traced and the ``fallback`` runs (unless the exception type is
+ignored). The reference dispatches handlers by reflected method name — here
+they are plain callables, and async callables get an async wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Optional, Tuple, Type
+
+from sentinel_tpu.local import BlockException, EntryType
+from sentinel_tpu.local.sph import entry as _entry
+
+
+def sentinel_resource(
+    resource: Optional[str] = None,
+    entry_type: EntryType = EntryType.OUT,
+    block_handler: Optional[Callable] = None,
+    fallback: Optional[Callable] = None,
+    exceptions_to_ignore: Tuple[Type[BaseException], ...] = (),
+    args_as_params: bool = False,
+):
+    """Guard a function as a sentinel resource.
+
+    - ``resource``: resource name; defaults to the function's qualified name
+      (the aspect's ``getResourceName`` fallback).
+    - ``block_handler(*args, ex=BlockException, **kwargs)``: runs on block.
+    - ``fallback(*args, ex=Exception, **kwargs)``: runs on business error
+      (after tracing), and on block when no ``block_handler`` is given —
+      the reference's degrade-to-fallback order
+      (``AbstractSentinelAspectSupport.handleBlockException``).
+    - ``exceptions_to_ignore``: business exceptions re-raised untraced.
+    - ``args_as_params``: pass the call's positional args to the slot chain
+      so hot-param (``ParamFlowRule``) rules see them.
+    """
+
+    def decorate(fn: Callable) -> Callable:
+        name = resource or f"{fn.__module__}.{fn.__qualname__}"
+
+        def on_block(e, args, kwargs):
+            if block_handler is not None:
+                return block_handler(*args, ex=e, **kwargs)
+            if fallback is not None:
+                return fallback(*args, ex=e, **kwargs)
+            raise e
+
+        def on_error(e, args, kwargs):
+            if isinstance(e, exceptions_to_ignore):
+                raise e
+            if fallback is not None:
+                return fallback(*args, ex=e, **kwargs)
+            raise e
+
+        if inspect.iscoroutinefunction(fn):
+
+            @functools.wraps(fn)
+            async def async_wrapper(*args, **kwargs):
+                try:
+                    e = _entry(
+                        name, entry_type,
+                        args=tuple(args) if args_as_params else (),
+                    )
+                except BlockException as be:
+                    return on_block(be, args, kwargs)
+                try:
+                    return await fn(*args, **kwargs)
+                except BaseException as err:
+                    if not isinstance(err, exceptions_to_ignore):
+                        e.trace(err)
+                    return on_error(err, args, kwargs)
+                finally:
+                    e.exit()
+
+            return async_wrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            try:
+                e = _entry(
+                    name, entry_type,
+                    args=tuple(args) if args_as_params else (),
+                )
+            except BlockException as be:
+                return on_block(be, args, kwargs)
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as err:
+                if not isinstance(err, exceptions_to_ignore):
+                    e.trace(err)
+                return on_error(err, args, kwargs)
+            finally:
+                e.exit()
+
+        return wrapper
+
+    return decorate

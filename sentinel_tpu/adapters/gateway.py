@@ -1,0 +1,246 @@
+"""Gateway flow control: param-based rules for routes/APIs.
+
+Analog of ``sentinel-api-gateway-adapter-common``:
+
+- ``GatewayFlowRule`` (``rule/GatewayFlowRule.java:27``): a flow rule scoped
+  to a route id or logical API, optionally keyed by a request attribute
+  (client IP, host, header, URL param, cookie).
+- ``GatewayRuleConverter`` (``rule/GatewayRuleConverter.java``): each gateway
+  rule becomes a hot-param rule — the request attribute is the hot param.
+  Rules without a param item get a synthetic constant param so they still
+  ride the same vectorized param path.
+- ``GatewayParamParser`` (``param/GatewayParamParser.java:34,51``): pulls the
+  per-rule attribute values out of the request into the args tuple, applying
+  the item's match strategy (exact/prefix/regex/contains); non-matching
+  values collapse into one "not matched" bucket.
+- The param args feed the ordinary ``ParamFlowSlot`` — the reference inserts
+  a dedicated ``GatewayFlowSlot`` at order −4000 whose checker is the
+  param-flow checker; reusing ``ParamFlowSlot`` here is the same pipeline
+  with one fewer moving part.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from sentinel_tpu.local import ParamFlowItem, ParamFlowRule, ParamFlowRuleManager
+from sentinel_tpu.local import context as _ctx
+from sentinel_tpu.local.base import BlockException, EntryType
+from sentinel_tpu.local.flow import ControlBehavior, FlowGrade
+from sentinel_tpu.local.sph import entry as _entry
+
+
+class ResourceMode(enum.IntEnum):
+    """``SentinelGatewayConstants``: rule targets a route id or a custom API."""
+
+    ROUTE_ID = 0
+    CUSTOM_API_NAME = 1
+
+
+class ParseStrategy(enum.IntEnum):
+    """Where the hot param comes from (``SentinelGatewayConstants.PARAM_PARSE_STRATEGY_*``)."""
+
+    CLIENT_IP = 0
+    HOST = 1
+    HEADER = 2
+    URL_PARAM = 3
+    COOKIE = 4
+
+
+class MatchStrategy(enum.IntEnum):
+    """How the extracted value is matched (``PARAM_MATCH_STRATEGY_*``)."""
+
+    EXACT = 0
+    PREFIX = 1
+    REGEX = 2
+    CONTAINS = 3
+
+
+# values that fail the match pattern share one bucket; absent values another
+NOT_MATCH = "$NM"
+ABSENT = "$D"
+
+
+@dataclass
+class GatewayParamFlowItem:
+    """``GatewayParamFlowItem.java`` — the keyed attribute of a gateway rule."""
+
+    parse_strategy: ParseStrategy = ParseStrategy.CLIENT_IP
+    field_name: Optional[str] = None  # header/url-param/cookie name
+    pattern: Optional[str] = None
+    match_strategy: MatchStrategy = MatchStrategy.EXACT
+
+
+@dataclass
+class GatewayFlowRule:
+    """``GatewayFlowRule.java:27``."""
+
+    resource: str  # route id or API name
+    resource_mode: ResourceMode = ResourceMode.ROUTE_ID
+    count: float = 0.0
+    grade: FlowGrade = FlowGrade.QPS
+    interval_sec: int = 1
+    control_behavior: ControlBehavior = ControlBehavior.DEFAULT
+    burst: int = 0
+    max_queueing_time_ms: int = 500
+    param_item: Optional[GatewayParamFlowItem] = None
+
+
+class RequestAdapter:
+    """Framework-neutral request view the parser reads from. Adapters (WSGI,
+    ASGI, any gateway) implement these five accessors."""
+
+    def client_ip(self) -> str:
+        return ""
+
+    def host(self) -> str:
+        return ""
+
+    def header(self, name: str) -> Optional[str]:
+        return None
+
+    def url_param(self, name: str) -> Optional[str]:
+        return None
+
+    def cookie(self, name: str) -> Optional[str]:
+        return None
+
+
+@dataclass
+class DictRequestAdapter(RequestAdapter):
+    """Simple adapter over plain dicts (tests, WSGI environ pre-digestion)."""
+
+    ip: str = ""
+    host_name: str = ""
+    headers: Dict[str, str] = field(default_factory=dict)
+    params: Dict[str, str] = field(default_factory=dict)
+    cookies: Dict[str, str] = field(default_factory=dict)
+
+    def client_ip(self) -> str:
+        return self.ip
+
+    def host(self) -> str:
+        return self.host_name
+
+    def header(self, name: str) -> Optional[str]:
+        return self.headers.get(name)
+
+    def url_param(self, name: str) -> Optional[str]:
+        return self.params.get(name)
+
+    def cookie(self, name: str) -> Optional[str]:
+        return self.cookies.get(name)
+
+
+def _extract(item: GatewayParamFlowItem, request: RequestAdapter) -> str:
+    s = item.parse_strategy
+    if s == ParseStrategy.CLIENT_IP:
+        raw = request.client_ip()
+    elif s == ParseStrategy.HOST:
+        raw = request.host()
+    elif s == ParseStrategy.HEADER:
+        raw = request.header(item.field_name or "")
+    elif s == ParseStrategy.URL_PARAM:
+        raw = request.url_param(item.field_name or "")
+    else:
+        raw = request.cookie(item.field_name or "")
+    if raw is None or raw == "":
+        return ABSENT
+    if item.pattern:
+        m = item.match_strategy
+        if m == MatchStrategy.EXACT:
+            matched = raw == item.pattern
+        elif m == MatchStrategy.PREFIX:
+            matched = raw.startswith(item.pattern)
+        elif m == MatchStrategy.REGEX:
+            matched = re.search(item.pattern, raw) is not None
+        else:
+            matched = item.pattern in raw
+        if not matched:
+            return NOT_MATCH
+    return raw
+
+
+class GatewayRuleManager:
+    """Converts gateway rules to hot-param rules and parses request params.
+
+    ``loadRules`` → ``GatewayRuleConverter.applyToParamRule`` analog: gateway
+    rule *i* for a resource becomes a ``ParamFlowRule`` with
+    ``param_idx = i``; ``parse(resource, request)`` then builds the aligned
+    args tuple for ``entry(..., args=...)``.
+    """
+
+    _lock = threading.RLock()
+    _rules: Dict[str, List[GatewayFlowRule]] = {}
+
+    @classmethod
+    def load_rules(cls, rules: Sequence[GatewayFlowRule]) -> None:
+        grouped: Dict[str, List[GatewayFlowRule]] = {}
+        for rule in rules:
+            if not rule.resource or rule.count < 0:
+                continue
+            grouped.setdefault(rule.resource, []).append(rule)
+        param_rules: List[ParamFlowRule] = []
+        for resource, lst in grouped.items():
+            for idx, rule in enumerate(lst):
+                param_rules.append(
+                    ParamFlowRule(
+                        resource=resource,
+                        param_idx=idx,
+                        count=rule.count,
+                        grade=rule.grade,
+                        duration_sec=rule.interval_sec,
+                        burst_count=rule.burst,
+                        control_behavior=rule.control_behavior,
+                        max_queueing_time_ms=rule.max_queueing_time_ms,
+                    )
+                )
+        with cls._lock:
+            cls._rules = grouped
+            # gateway-generated param rules replace the previous gateway set;
+            # they share ParamFlowRuleManager with user rules only in the
+            # reference's dedicated-slot design — here the gateway owns the
+            # resources it names, which load_rules replaces wholesale
+            existing = [
+                r
+                for res, lst in ParamFlowRuleManager.all_rules().items()
+                if res not in grouped
+                for r in lst
+            ]
+            ParamFlowRuleManager.load_rules(existing + param_rules)
+
+    @classmethod
+    def rules_for(cls, resource: str) -> List[GatewayFlowRule]:
+        with cls._lock:
+            return list(cls._rules.get(resource, []))
+
+    @classmethod
+    def parse(cls, resource: str, request: RequestAdapter) -> Tuple[str, ...]:
+        """``GatewayParamParser.parseParameterFor``: one arg per rule, indexed
+        by the rule's position; rules without a param item get a constant so
+        the whole rule behaves like a plain flow rule on the param path."""
+        args = []
+        for rule in cls.rules_for(resource):
+            if rule.param_item is None:
+                args.append(ABSENT)
+            else:
+                args.append(_extract(rule.param_item, request))
+        return tuple(args)
+
+    @classmethod
+    def entry(cls, resource: str, request: RequestAdapter,
+              origin: str = "", count: int = 1):
+        """Guard a gateway route: parse params, enter the slot chain.
+        Raises ``BlockException`` on a block verdict."""
+        args = cls.parse(resource, request)
+        _ctx.enter(name=f"gateway_context:{resource}", origin=origin)
+        return _entry(resource, EntryType.IN, count, args)
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._rules = {}

@@ -1,0 +1,127 @@
+"""gRPC server + client interceptors (``sentinel-grpc-adapter`` analog).
+
+Reference: ``SentinelGrpcServerInterceptor.java`` /
+``SentinelGrpcClientInterceptor.java`` — resource is the full method name;
+server blocks map to RESOURCE_EXHAUSTED; client guards the outbound call as
+an OUT-type resource. Gated on ``grpcio``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+try:
+    import grpc
+except ImportError:  # pragma: no cover - grpcio baked into this image
+    grpc = None
+
+from sentinel_tpu.local import BlockException, EntryType
+from sentinel_tpu.local import context as _ctx
+from sentinel_tpu.local.sph import entry as _entry
+
+BLOCK_MSG = "Blocked by Sentinel (flow limiting)"
+
+
+def _require_grpc():
+    if grpc is None:
+        raise ImportError(
+            "grpcio is not installed; the gRPC adapter is unavailable"
+        )
+
+
+if grpc is not None:
+
+    class SentinelServerInterceptor(grpc.ServerInterceptor):
+        """Guard every unary/streaming handler by its full method name."""
+
+        def __init__(self, origin_metadata_key: str = "sentinel-origin"):
+            self._origin_key = origin_metadata_key
+
+        def intercept_service(self, continuation, handler_call_details):
+            handler = continuation(handler_call_details)
+            if handler is None:
+                return None
+            resource = handler_call_details.method
+            origin = ""
+            for key, value in handler_call_details.invocation_metadata or ():
+                if key == self._origin_key:
+                    origin = value
+                    break
+
+            def guard(behavior, request_streaming, response_streaming):
+                def guarded(request_or_iterator, servicer_context):
+                    _ctx.enter(name=f"grpc_context:{resource}", origin=origin)
+                    try:
+                        try:
+                            entry = _entry(resource, EntryType.IN)
+                        except BlockException:
+                            servicer_context.abort(
+                                grpc.StatusCode.RESOURCE_EXHAUSTED, BLOCK_MSG
+                            )
+                            return  # pragma: no cover - abort raises
+                        try:
+                            return behavior(request_or_iterator, servicer_context)
+                        except BaseException as err:
+                            entry.trace(err)
+                            raise
+                        finally:
+                            entry.exit()
+                    finally:
+                        _ctx.exit()
+
+                return guarded
+
+            return _wrap_handler(handler, guard)
+
+    def _wrap_handler(handler, guard):
+        if handler.unary_unary:
+            return grpc.unary_unary_rpc_method_handler(
+                guard(handler.unary_unary, False, False),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.unary_stream:
+            return grpc.unary_stream_rpc_method_handler(
+                guard(handler.unary_stream, False, True),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.stream_unary:
+            return grpc.stream_unary_rpc_method_handler(
+                guard(handler.stream_unary, True, False),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return grpc.stream_stream_rpc_method_handler(
+            guard(handler.stream_stream, True, True),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+    class SentinelClientInterceptor(
+        grpc.UnaryUnaryClientInterceptor, grpc.UnaryStreamClientInterceptor
+    ):
+        """Guard outbound calls; a block raises ``BlockException`` to the
+        caller before any network I/O (the reference fails the call with
+        UNAVAILABLE — raising keeps the local API uniform)."""
+
+        def intercept_unary_unary(self, continuation, client_call_details, request):
+            with _entry(client_call_details.method, EntryType.OUT) as e:
+                call = continuation(client_call_details, request)
+                if call.exception() is not None:
+                    e.trace(call.exception())
+                return call
+
+        def intercept_unary_stream(self, continuation, client_call_details, request):
+            with _entry(client_call_details.method, EntryType.OUT):
+                return continuation(client_call_details, request)
+
+else:  # pragma: no cover
+
+    class SentinelServerInterceptor:  # type: ignore[no-redef]
+        def __init__(self, *a, **k):
+            _require_grpc()
+
+    class SentinelClientInterceptor:  # type: ignore[no-redef]
+        def __init__(self, *a, **k):
+            _require_grpc()

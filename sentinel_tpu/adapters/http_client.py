@@ -1,0 +1,76 @@
+"""Outbound HTTP-call guards for ``requests`` and ``httpx``.
+
+Analog of ``sentinel-okhttp-adapter`` / ``sentinel-apache-httpclient-adapter``:
+the outbound URL (normalized to ``METHOD:scheme://host/path``) is an OUT-type
+resource; blocks raise ``BlockException`` before any connection is made;
+HTTP errors are traced. Gated on the respective client library.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+from urllib.parse import urlsplit
+
+from sentinel_tpu.local import BlockException, EntryType  # noqa: F401 (re-export)
+from sentinel_tpu.local.sph import entry as _entry
+
+
+def default_resource(method: str, url: str) -> str:
+    parts = urlsplit(url)
+    return f"{method.upper()}:{parts.scheme}://{parts.netloc}{parts.path}"
+
+
+def guarded_call(fn: Callable, method: str, url: str,
+                 resource_extractor: Callable = default_resource, **kwargs):
+    """Framework-neutral core: guard ``fn(**kwargs)`` as an outbound call."""
+    with _entry(resource_extractor(method, url), EntryType.OUT) as e:
+        try:
+            return fn(**kwargs)
+        except BaseException as err:
+            e.trace(err)
+            raise
+
+
+# -- requests ---------------------------------------------------------------
+
+def guarded_requests_session(
+    session=None, resource_extractor: Callable = default_resource
+):
+    """Wrap a ``requests.Session`` so every request is guarded."""
+    import requests
+
+    session = session or requests.Session()
+    inner = session.request
+
+    def request(method, url, *args, **kwargs):
+        with _entry(resource_extractor(method, url), EntryType.OUT) as e:
+            resp = inner(method, url, *args, **kwargs)
+            if resp.status_code >= 500:
+                e.trace(RuntimeError(f"HTTP {resp.status_code}"))
+            return resp
+
+    session.request = request
+    return session
+
+
+# -- httpx ------------------------------------------------------------------
+
+class SentinelHttpxTransport:
+    """``httpx`` custom transport wrapper: ``httpx.Client(transport=...)``."""
+
+    def __init__(self, inner=None, resource_extractor: Callable = default_resource):
+        import httpx
+
+        self._inner = inner or httpx.HTTPTransport()
+        self._extract = resource_extractor
+
+    def handle_request(self, request):
+        resource = self._extract(request.method, str(request.url))
+        with _entry(resource, EntryType.OUT) as e:
+            response = self._inner.handle_request(request)
+            if response.status_code >= 500:
+                e.trace(RuntimeError(f"HTTP {response.status_code}"))
+            return response
+
+    def close(self):
+        self._inner.close()

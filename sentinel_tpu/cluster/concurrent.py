@@ -137,7 +137,7 @@ class ConcurrencyManager:
                 TokenStatus.OK, token_id, max(0, level - held - acquire)
             )
 
-    def release(self, token_id: int, now_ms: Optional[int] = None) -> TokenStatus:
+    def release(self, token_id: int) -> TokenStatus:
         """``ConcurrentClusterFlowChecker.releaseConcurrentToken``: idempotent —
         a token already released (or expired by the sweeper) reports
         ALREADY_RELEASE rather than double-decrementing."""
@@ -149,18 +149,23 @@ class ConcurrencyManager:
             return TokenStatus.RELEASE_OK
 
     # -- expiry (RegularExpireStrategy analog) --------------------------------
-    def expire(self, now_ms: Optional[int] = None, limit: int = 10_000) -> int:
-        """Sweep up to ``limit`` expired tokens; returns the number reclaimed."""
+    def expire(self, now_ms: Optional[int] = None,
+               limit: Optional[int] = None) -> int:
+        """Sweep expired tokens; returns the number reclaimed. ``limit``
+        bounds entries *inspected* (hot-path callers); the background task
+        passes None for a full scan — issue order only clusters expired
+        tokens at the front per rule, so short-TTL tokens stuck behind a
+        long-TTL rule's live permits need the unbounded sweep."""
         now = _clock.now_ms() if now_ms is None else int(now_ms)
         with self._lock:
-            return self._sweep_locked(now, limit)
+            return self._sweep_locked(
+                now, len(self._tokens) if limit is None else limit
+            )
 
     def _sweep_locked(self, now: int, limit: int) -> int:
         # `limit` bounds entries *inspected*, not reclaimed, so an acquire-path
         # sweep is O(limit) even when nothing is expired (50k live permits must
-        # not put a full-dict scan inside the hot-path critical section);
-        # tokens are in issue order, so expired ones cluster at the front and
-        # the background ExpiryTask's larger budget finishes the long tail
+        # not put a full-dict scan inside the hot-path critical section)
         expired = []
         for inspected, (token_id, node) in enumerate(self._tokens.items()):
             if inspected >= limit:
@@ -198,8 +203,13 @@ class ExpiryTask:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            if thread.is_alive():
+                # still draining a long sweep: leave the stop event set so it
+                # exits at its next wait; a re-start would duplicate sweepers
+                return
             self._thread = None
         self._stop.clear()
 

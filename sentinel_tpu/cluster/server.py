@@ -74,6 +74,12 @@ class TokenServer:
             self._thread.join(timeout=5)
             self._thread = None
         self._started.clear()
+        # symmetric with the warmup hook in start(): release the service's
+        # background resources (concurrent-mode expiry sweeper). Embedded
+        # users who keep the service alive re-arm it on the next rule load.
+        close = getattr(self.service, "close", None)
+        if close is not None:
+            close()
 
     def _run_loop(self) -> None:
         loop = asyncio.new_event_loop()
@@ -204,37 +210,39 @@ class TokenServer:
                 else:
                     r = flow_results[k]
                     results[i] = (int(r.status), r.remaining, r.wait_ms, 0)
-        for i, (req, _) in enumerate(batch):
-            if req.msg_type == P.MsgType.PARAM_FLOW:
-                try:
+        async def run_one(i: int, req) -> None:
+            # overlapped thread hops: the service locks still serialize the
+            # critical sections, but responses aren't head-of-line blocked
+            try:
+                if req.msg_type == P.MsgType.PARAM_FLOW:
                     r = await asyncio.to_thread(
                         self.service.request_params_token,
                         req.flow_id, req.count, req.param_hashes,
                     )
                     results[i] = (int(r.status), r.remaining, r.wait_ms, 0)
-                except Exception:
-                    record_log.exception("param token request failed")
-                    results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
-            elif req.msg_type == P.MsgType.CONCURRENT_ACQUIRE:
-                try:
+                elif req.msg_type == P.MsgType.CONCURRENT_ACQUIRE:
                     r = await asyncio.to_thread(
                         self.service.request_concurrent_token,
                         req.flow_id, req.count, req.prioritized,
                     )
                     results[i] = (int(r.status), r.remaining, r.wait_ms, r.token_id)
-                except Exception:
-                    record_log.exception("concurrent acquire failed")
-                    results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
-            elif req.msg_type == P.MsgType.CONCURRENT_RELEASE:
-                try:
+                elif req.msg_type == P.MsgType.CONCURRENT_RELEASE:
                     # flow_id slot carries the token id (protocol docstring)
                     r = await asyncio.to_thread(
                         self.service.release_concurrent_token, req.flow_id
                     )
                     results[i] = (int(r.status), 0, 0, 0)
-                except Exception:
-                    record_log.exception("concurrent release failed")
-                    results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
+            except Exception:
+                record_log.exception("%s request failed", req.msg_type.name)
+                results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
+
+        host_side = [
+            run_one(i, req)
+            for i, (req, _) in enumerate(batch)
+            if req.msg_type != P.MsgType.FLOW
+        ]
+        if host_side:
+            await asyncio.gather(*host_side)
 
         writers_to_drain = set()
         for i, (req, writer) in enumerate(batch):

@@ -217,6 +217,11 @@ class ParamFlowRuleManager:
         return cls._rules.get(resource, [])
 
     @classmethod
+    def all_rules(cls) -> Dict[str, List[ParamFlowRule]]:
+        with cls._lock:
+            return {res: [r for r, _ in lst] for res, lst in cls._rules.items()}
+
+    @classmethod
     def register_property(cls, prop) -> None:
         prop.listen(lambda rules: cls.load_rules(rules or []))
 

@@ -55,7 +55,7 @@ _RULE_TYPES = {
     ),
     "paramFlow": (
         lambda: conv.param_flow_rules_to_json(
-            [r for lst in ParamFlowRuleManager._rules.values() for r, _ in lst]
+            [r for lst in ParamFlowRuleManager.all_rules().values() for r in lst]
         ),
         conv.param_flow_rules_from_json,
         ParamFlowRuleManager.load_rules,

@@ -1,0 +1,431 @@
+"""Adapter tests (L7): decorator, WSGI, ASGI, gateway, gRPC, HTTP clients.
+
+Mirrors the reference's per-adapter strategy (SURVEY.md §4): each adapter is
+driven through its framework's own test harness idiom — raw WSGI callables,
+an asyncio-driven ASGI app, grpc's in-process server — with rules loaded via
+the ordinary managers and verdicts asserted at the framework boundary.
+"""
+
+import asyncio
+
+import pytest
+
+import sentinel_tpu.local as sentinel
+from sentinel_tpu.adapters import (
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayRuleManager,
+    MatchStrategy,
+    ParseStrategy,
+    SentinelAsgiMiddleware,
+    SentinelWsgiMiddleware,
+    sentinel_resource,
+)
+from sentinel_tpu.adapters.gateway import ABSENT, NOT_MATCH, DictRequestAdapter
+from sentinel_tpu.local import BlockException, FlowRule, FlowRuleManager
+
+
+@pytest.fixture(autouse=True)
+def clean(manual_clock):
+    sentinel.reset_for_tests()
+    GatewayRuleManager.reset_for_tests()
+    yield manual_clock
+    GatewayRuleManager.reset_for_tests()
+    sentinel.reset_for_tests()
+
+
+class TestDecorator:
+    def test_guards_and_blocks(self, manual_clock):
+        calls = []
+
+        @sentinel_resource("deco_res")
+        def fn(x):
+            calls.append(x)
+            return x * 2
+
+        FlowRuleManager.load_rules([FlowRule(resource="deco_res", count=2)])
+        assert fn(1) == 2 and fn(2) == 4
+        with pytest.raises(BlockException):
+            fn(3)
+        assert calls == [1, 2]
+
+    def test_block_handler(self, manual_clock):
+        @sentinel_resource("deco_bh", block_handler=lambda x, ex: f"blocked:{x}")
+        def fn(x):
+            return x
+
+        FlowRuleManager.load_rules([FlowRule(resource="deco_bh", count=1)])
+        assert fn("a") == "a"
+        assert fn("b") == "blocked:b"
+
+    def test_fallback_on_error_and_trace(self, manual_clock):
+        @sentinel_resource("deco_fb", fallback=lambda ex: "fell back")
+        def fn():
+            raise ValueError("boom")
+
+        assert fn() == "fell back"
+        from sentinel_tpu.local.chain import cluster_node_map
+
+        node = cluster_node_map()["deco_fb"]
+        assert node.exception_qps(manual_clock.now_ms()) > 0
+
+    def test_fallback_used_for_block_when_no_block_handler(self, manual_clock):
+        @sentinel_resource("deco_fb2", fallback=lambda ex: "fb")
+        def fn():
+            return "ok"
+
+        FlowRuleManager.load_rules([FlowRule(resource="deco_fb2", count=1)])
+        assert fn() == "ok"
+        assert fn() == "fb"
+
+    def test_ignored_exceptions_not_traced(self, manual_clock):
+        @sentinel_resource("deco_ig", exceptions_to_ignore=(KeyError,))
+        def fn():
+            raise KeyError("skip")
+
+        with pytest.raises(KeyError):
+            fn()
+        from sentinel_tpu.local.chain import cluster_node_map
+
+        node = cluster_node_map()["deco_ig"]
+        assert node.exception_qps(manual_clock.now_ms()) == 0
+
+    def test_default_resource_name(self, manual_clock):
+        @sentinel_resource()
+        def some_fn():
+            return 1
+
+        some_fn()
+        from sentinel_tpu.local.chain import cluster_node_map
+
+        assert any("some_fn" in name for name in cluster_node_map())
+
+    def test_async_function(self, manual_clock):
+        @sentinel_resource("deco_async", block_handler=lambda ex: "blocked")
+        async def fn():
+            return "ok"
+
+        FlowRuleManager.load_rules([FlowRule(resource="deco_async", count=1)])
+        assert asyncio.run(fn()) == "ok"
+        assert asyncio.run(fn()) == "blocked"
+
+    def test_args_as_params_feed_hot_param_rules(self, manual_clock):
+        from sentinel_tpu.local import ParamFlowRule, ParamFlowRuleManager
+
+        @sentinel_resource("deco_param", args_as_params=True,
+                           block_handler=lambda uid, ex: "limited")
+        def fn(uid):
+            return "ok"
+
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="deco_param", param_idx=0, count=1)]
+        )
+        assert fn("alice") == "ok"
+        assert fn("alice") == "limited"  # per-value limit hit
+        assert fn("bob") == "ok"  # other value unaffected
+
+
+def _wsgi_app(environ, start_response):
+    start_response("200 OK", [("Content-Type", "text/plain")])
+    return [b"hello"]
+
+
+def _call_wsgi(app, path="/", method="GET", remote="1.2.3.4"):
+    status_headers = {}
+
+    def start_response(status, headers):
+        status_headers["status"] = status
+        status_headers["headers"] = headers
+
+    environ = {"REQUEST_METHOD": method, "PATH_INFO": path, "REMOTE_ADDR": remote}
+    body = b"".join(app(environ, start_response))
+    return status_headers["status"], body
+
+
+class TestWsgi:
+    def test_pass_and_block(self, manual_clock):
+        app = SentinelWsgiMiddleware(_wsgi_app)
+        FlowRuleManager.load_rules([FlowRule(resource="GET:/api", count=2)])
+        for _ in range(2):
+            status, body = _call_wsgi(app, "/api")
+            assert status.startswith("200") and body == b"hello"
+        status, body = _call_wsgi(app, "/api")
+        assert status.startswith("429") and b"Sentinel" in body
+        # other path unaffected
+        status, _ = _call_wsgi(app, "/other")
+        assert status.startswith("200")
+
+    def test_custom_block_handler(self, manual_clock):
+        def on_block(environ, start_response, e):
+            start_response("503 Service Unavailable", [])
+            return [b"custom"]
+
+        app = SentinelWsgiMiddleware(_wsgi_app, block_handler=on_block)
+        FlowRuleManager.load_rules([FlowRule(resource="GET:/x", count=0)])
+        status, body = _call_wsgi(app, "/x")
+        assert status.startswith("503") and body == b"custom"
+
+    def test_skip_unnamed_resources(self, manual_clock):
+        app = SentinelWsgiMiddleware(
+            _wsgi_app, resource_extractor=lambda env: ""
+        )
+        FlowRuleManager.load_rules([FlowRule(resource="GET:/", count=0)])
+        status, _ = _call_wsgi(app, "/")
+        assert status.startswith("200")  # unguarded
+
+    def test_total_entry(self, manual_clock):
+        from sentinel_tpu.adapters.wsgi import TOTAL_RESOURCE
+
+        app = SentinelWsgiMiddleware(_wsgi_app, with_total=True)
+        FlowRuleManager.load_rules([FlowRule(resource=TOTAL_RESOURCE, count=1)])
+        assert _call_wsgi(app, "/a")[0].startswith("200")
+        assert _call_wsgi(app, "/b")[0].startswith("429")  # umbrella cap
+
+    def test_error_traced(self, manual_clock):
+        def bad_app(environ, start_response):
+            raise RuntimeError("boom")
+
+        app = SentinelWsgiMiddleware(bad_app)
+        with pytest.raises(RuntimeError):
+            _call_wsgi(app, "/err")
+        from sentinel_tpu.local.chain import cluster_node_map
+
+        node = cluster_node_map()["GET:/err"]
+        assert node.exception_qps(manual_clock.now_ms()) > 0
+
+
+async def _asgi_app(scope, receive, send):
+    await send({"type": "http.response.start", "status": 200, "headers": []})
+    await send({"type": "http.response.body", "body": b"hello"})
+
+
+def _call_asgi(app, path="/", method="GET"):
+    sent = []
+
+    async def run():
+        scope = {"type": "http", "method": method, "path": path,
+                 "client": ("9.9.9.9", 1234)}
+
+        async def receive():
+            return {"type": "http.request"}
+
+        async def send(msg):
+            sent.append(msg)
+
+        await app(scope, receive, send)
+
+    asyncio.run(run())
+    status = next(m["status"] for m in sent if m["type"] == "http.response.start")
+    body = b"".join(m.get("body", b"") for m in sent if m["type"] == "http.response.body")
+    return status, body
+
+
+class TestAsgi:
+    def test_pass_and_block(self, manual_clock):
+        app = SentinelAsgiMiddleware(_asgi_app)
+        FlowRuleManager.load_rules([FlowRule(resource="GET:/api", count=1)])
+        assert _call_asgi(app, "/api") == (200, b"hello")
+        status, body = _call_asgi(app, "/api")
+        assert status == 429 and b"Sentinel" in body
+
+    def test_non_http_passthrough(self, manual_clock):
+        ran = []
+
+        async def ws_app(scope, receive, send):
+            ran.append(scope["type"])
+
+        app = SentinelAsgiMiddleware(ws_app)
+
+        async def run():
+            await app({"type": "websocket"}, None, None)
+
+        asyncio.run(run())
+        assert ran == ["websocket"]
+
+    def test_concurrent_tasks_have_isolated_contexts(self, manual_clock):
+        """Two interleaving tasks must not corrupt each other's entry stack
+        (the reference needs AsyncEntry for this; contextvars gives it)."""
+        app = SentinelAsgiMiddleware(_asgi_app)
+        order = []
+
+        async def slow_app(scope, receive, send):
+            order.append(f"in:{scope['path']}")
+            await asyncio.sleep(0.01)
+            order.append(f"out:{scope['path']}")
+            await send({"type": "http.response.start", "status": 200, "headers": []})
+            await send({"type": "http.response.body", "body": b"x"})
+
+        app = SentinelAsgiMiddleware(slow_app)
+
+        async def call(path):
+            sent = []
+
+            async def send(msg):
+                sent.append(msg)
+
+            await app({"type": "http", "method": "GET", "path": path,
+                       "client": None}, None, send)
+            return sent
+
+        async def run():
+            return await asyncio.gather(call("/a"), call("/b"))
+
+        r = asyncio.run(run())
+        assert all(any(m.get("status") == 200 for m in sent) for sent in r)
+        assert order == ["in:/a", "in:/b", "out:/a", "out:/b"]  # interleaved
+
+
+class TestGateway:
+    def test_route_limit_per_client_ip(self, manual_clock):
+        GatewayRuleManager.load_rules(
+            [
+                GatewayFlowRule(
+                    resource="route_a", count=2,
+                    param_item=GatewayParamFlowItem(ParseStrategy.CLIENT_IP),
+                )
+            ]
+        )
+        req1 = DictRequestAdapter(ip="10.0.0.1")
+        req2 = DictRequestAdapter(ip="10.0.0.2")
+        for _ in range(2):
+            with GatewayRuleManager.entry("route_a", req1):
+                pass
+        with pytest.raises(BlockException):
+            with GatewayRuleManager.entry("route_a", req1):
+                pass
+        # different IP gets its own bucket
+        with GatewayRuleManager.entry("route_a", req2):
+            pass
+
+    def test_rule_without_param_item_acts_as_plain_limit(self, manual_clock):
+        GatewayRuleManager.load_rules(
+            [GatewayFlowRule(resource="route_b", count=1)]
+        )
+        with GatewayRuleManager.entry("route_b", DictRequestAdapter()):
+            pass
+        with pytest.raises(BlockException):
+            with GatewayRuleManager.entry("route_b", DictRequestAdapter()):
+                pass
+
+    def test_header_with_pattern_matching(self, manual_clock):
+        GatewayRuleManager.load_rules(
+            [
+                GatewayFlowRule(
+                    resource="route_c", count=1,
+                    param_item=GatewayParamFlowItem(
+                        ParseStrategy.HEADER, field_name="X-Tier",
+                        pattern="gold", match_strategy=MatchStrategy.EXACT,
+                    ),
+                )
+            ]
+        )
+        gold = DictRequestAdapter(headers={"X-Tier": "gold"})
+        bronze = DictRequestAdapter(headers={"X-Tier": "bronze"})
+        args = GatewayRuleManager.parse("route_c", gold)
+        assert args == ("gold",)
+        assert GatewayRuleManager.parse("route_c", bronze) == (NOT_MATCH,)
+        assert GatewayRuleManager.parse(
+            "route_c", DictRequestAdapter()
+        ) == (ABSENT,)
+
+    def test_multiple_rules_align_param_indexes(self, manual_clock):
+        GatewayRuleManager.load_rules(
+            [
+                GatewayFlowRule(
+                    resource="route_d", count=10,
+                    param_item=GatewayParamFlowItem(ParseStrategy.CLIENT_IP),
+                ),
+                GatewayFlowRule(
+                    resource="route_d", count=5,
+                    param_item=GatewayParamFlowItem(
+                        ParseStrategy.URL_PARAM, field_name="user"
+                    ),
+                ),
+            ]
+        )
+        req = DictRequestAdapter(ip="1.1.1.1", params={"user": "u7"})
+        assert GatewayRuleManager.parse("route_d", req) == ("1.1.1.1", "u7")
+
+    def test_gateway_load_preserves_foreign_param_rules(self, manual_clock):
+        from sentinel_tpu.local import ParamFlowRule, ParamFlowRuleManager
+
+        ParamFlowRuleManager.load_rules(
+            [ParamFlowRule(resource="user_res", param_idx=0, count=3)]
+        )
+        GatewayRuleManager.load_rules(
+            [GatewayFlowRule(resource="route_e", count=1)]
+        )
+        assert "user_res" in ParamFlowRuleManager.all_rules()
+        assert "route_e" in ParamFlowRuleManager.all_rules()
+
+
+class TestGrpc:
+    def test_server_interceptor_blocks(self, manual_clock):
+        grpc = pytest.importorskip("grpc")
+        from concurrent import futures
+
+        from sentinel_tpu.adapters.grpc_interceptor import (
+            SentinelServerInterceptor,
+        )
+
+        method = "/test.Svc/Do"
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                if details.method == method:
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: req + b"!"
+                    )
+                return None
+
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=2),
+            interceptors=[SentinelServerInterceptor()],
+        )
+        server.add_generic_rpc_handlers([Handler()])
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        try:
+            FlowRuleManager.load_rules([FlowRule(resource=method, count=1)])
+            channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+            stub = channel.unary_unary(method)
+            assert stub(b"hi", timeout=5) == b"hi!"
+            with pytest.raises(grpc.RpcError) as exc_info:
+                stub(b"hi", timeout=5)
+            assert exc_info.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            channel.close()
+        finally:
+            server.stop(0)
+
+
+class TestHttpClient:
+    def test_httpx_transport_guard(self, manual_clock):
+        httpx = pytest.importorskip("httpx")
+        from sentinel_tpu.adapters.http_client import SentinelHttpxTransport
+
+        calls = []
+
+        def app(request):
+            calls.append(str(request.url))
+            return httpx.Response(200, text="ok")
+
+        transport = SentinelHttpxTransport(inner=httpx.MockTransport(app))
+        client = httpx.Client(transport=transport)
+        FlowRuleManager.load_rules(
+            [FlowRule(resource="GET:http://svc/api", count=1)]
+        )
+        assert client.get("http://svc/api").status_code == 200
+        with pytest.raises(BlockException):
+            client.get("http://svc/api")
+        assert len(calls) == 1  # second call never reached the network
+
+    def test_requests_session_guard(self, manual_clock):
+        pytest.importorskip("requests")
+        from sentinel_tpu.adapters.http_client import guarded_requests_session
+
+        session = guarded_requests_session()
+        FlowRuleManager.load_rules(
+            [FlowRule(resource="GET:http://127.0.0.1:1/x", count=0)]
+        )
+        with pytest.raises(BlockException):
+            session.request("GET", "http://127.0.0.1:1/x")

@@ -111,6 +111,24 @@ class TestExpiry:
         assert m.expire(now_ms=T0 + 100) == 1  # only flow 2's token expired
         assert m.now_calls(1) == 1 and m.now_calls(2) == 0
 
+    def test_full_scan_reclaims_behind_long_ttl_wall(self):
+        # expired short-TTL tokens sitting behind >limit live long-TTL tokens
+        # must still be reclaimed by the unbounded background sweep
+        m = ConcurrencyManager()
+        m.load_rules(
+            [
+                ConcurrentFlowRule(1, 500, resource_timeout_ms=3_600_000),
+                ConcurrentFlowRule(2, 5, resource_timeout_ms=100),
+            ]
+        )
+        for _ in range(200):  # long-TTL wall issued first
+            m.acquire(1, now_ms=T0)
+        for _ in range(5):
+            m.acquire(2, now_ms=T0)
+        assert m.expire(now_ms=T0 + 200, limit=64) == 0  # bounded scan misses
+        assert m.expire(now_ms=T0 + 200) == 5  # full scan reclaims
+        assert m.now_calls(2) == 0 and m.now_calls(1) == 200
+
     def test_expiry_task_lifecycle(self, mgr):
         task = ExpiryTask(mgr, interval_s=0.01)
         task.start()

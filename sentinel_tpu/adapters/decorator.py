@@ -60,6 +60,10 @@ def sentinel_resource(
 
         if inspect.iscoroutinefunction(fn):
 
+            async def _maybe_await(value):
+                # handlers may themselves be async — await their result
+                return await value if inspect.isawaitable(value) else value
+
             @functools.wraps(fn)
             async def async_wrapper(*args, **kwargs):
                 try:
@@ -68,13 +72,13 @@ def sentinel_resource(
                         args=tuple(args) if args_as_params else (),
                     )
                 except BlockException as be:
-                    return on_block(be, args, kwargs)
+                    return await _maybe_await(on_block(be, args, kwargs))
                 try:
                     return await fn(*args, **kwargs)
                 except BaseException as err:
                     if not isinstance(err, exceptions_to_ignore):
                         e.trace(err)
-                    return on_error(err, args, kwargs)
+                    return await _maybe_await(on_error(err, args, kwargs))
                 finally:
                     e.exit()
 

@@ -200,15 +200,15 @@ class GatewayRuleManager:
                     )
                 )
         with cls._lock:
+            # the gateway owns every resource it EVER named: rules generated
+            # for resources dropped from the new set must be unloaded too,
+            # not preserved as if they were user-defined param rules
+            gateway_owned = set(cls._rules) | set(grouped)
             cls._rules = grouped
-            # gateway-generated param rules replace the previous gateway set;
-            # they share ParamFlowRuleManager with user rules only in the
-            # reference's dedicated-slot design — here the gateway owns the
-            # resources it names, which load_rules replaces wholesale
             existing = [
                 r
                 for res, lst in ParamFlowRuleManager.all_rules().items()
-                if res not in grouped
+                if res not in gateway_owned
                 for r in lst
             ]
             ParamFlowRuleManager.load_rules(existing + param_rules)

@@ -103,18 +103,36 @@ if grpc is not None:
     ):
         """Guard outbound calls; a block raises ``BlockException`` to the
         caller before any network I/O (the reference fails the call with
-        UNAVAILABLE — raising keeps the local API uniform)."""
+        UNAVAILABLE — raising keeps the local API uniform). The entry stays
+        open until the RPC completes (done callback), so future-style calls
+        remain async and RT/error stats cover the real call duration."""
+
+        def _intercept(self, continuation, client_call_details, request):
+            e = _entry(client_call_details.method, EntryType.OUT)
+            try:
+                call = continuation(client_call_details, request)
+            except BaseException as err:
+                e.trace(err)
+                e.exit()
+                raise
+
+            def on_done(completed):
+                try:
+                    exc = completed.exception()
+                except BaseException:
+                    exc = None  # cancelled
+                if exc is not None:
+                    e.trace(exc)
+                e.exit()
+
+            call.add_done_callback(on_done)
+            return call
 
         def intercept_unary_unary(self, continuation, client_call_details, request):
-            with _entry(client_call_details.method, EntryType.OUT) as e:
-                call = continuation(client_call_details, request)
-                if call.exception() is not None:
-                    e.trace(call.exception())
-                return call
+            return self._intercept(continuation, client_call_details, request)
 
         def intercept_unary_stream(self, continuation, client_call_details, request):
-            with _entry(client_call_details.method, EntryType.OUT):
-                return continuation(client_call_details, request)
+            return self._intercept(continuation, client_call_details, request)
 
 else:  # pragma: no cover
 

@@ -58,12 +58,20 @@ class SentinelWsgiMiddleware:
         _ctx.enter(name=f"wsgi_context:{resource}", origin=origin)
         total = None
         entry = None
+
+        def finish():
+            if entry is not None:
+                entry.exit()
+            if total is not None:
+                total.exit()
+            _ctx.exit()
+
         try:
+            if self.with_total:
+                total = _entry(TOTAL_RESOURCE, EntryType.IN)
+            entry = _entry(resource, EntryType.IN)
+        except BlockException as e:
             try:
-                if self.with_total:
-                    total = _entry(TOTAL_RESOURCE, EntryType.IN)
-                entry = _entry(resource, EntryType.IN)
-            except BlockException as e:
                 if self.block_handler is not None:
                     return self.block_handler(environ, start_response, e)
                 start_response(
@@ -72,14 +80,47 @@ class SentinelWsgiMiddleware:
                      ("Content-Length", str(len(DEFAULT_BLOCK_BODY)))],
                 )
                 return [DEFAULT_BLOCK_BODY]
-            try:
-                return self.app(environ, start_response)
-            except BaseException as err:
-                entry.trace(err)
-                raise
+            finally:
+                finish()
+        try:
+            body = self.app(environ, start_response)
+        except BaseException as err:
+            entry.trace(err)
+            finish()
+            raise
+        # exit only after the body is consumed: streaming responses hold the
+        # entry open for their full duration, so THREAD-grade rules see the
+        # real concurrency, RT covers iteration, and iteration-time errors
+        # are traced (PEP 3333 guarantees close() is called)
+        return _GuardedBody(body, entry, finish)
+
+
+class _GuardedBody:
+    """Response-body wrapper that completes the entry on close/exhaustion."""
+
+    def __init__(self, body: Iterable[bytes], entry, finish: Callable):
+        self._body = body
+        self._entry = entry
+        self._finish = finish
+        self._done = False
+
+    def __iter__(self):
+        try:
+            for chunk in self._body:
+                yield chunk
+        except BaseException as err:
+            self._entry.trace(err)
+            raise
         finally:
-            if entry is not None:
-                entry.exit()
-            if total is not None:
-                total.exit()
-            _ctx.exit()
+            self.close()
+
+    def close(self):
+        if self._done:
+            return
+        self._done = True
+        try:
+            close = getattr(self._body, "close", None)
+            if close is not None:
+                close()
+        finally:
+            self._finish()

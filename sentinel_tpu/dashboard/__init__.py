@@ -1,0 +1,27 @@
+"""Dashboard: the out-of-process console (``sentinel-dashboard`` analog).
+
+Pull-based, like the reference (SURVEY.md §1 L8): apps POST heartbeats to
+``/registry/machine``; the ``MetricFetcher`` polls each healthy machine's
+``/metric`` command endpoint and aggregates into an in-memory repository
+(5-minute retention, ``InMemoryMetricsRepository.java:40-63``); rule CRUD is
+proxied to the app's command center via ``ApiClient``
+(``SentinelApiClient.java:93,384,416``). The web UI is one embedded HTML page
+over the REST API (the reference ships an AngularJS app; the console's value
+is the API, not the framework it renders with).
+"""
+
+from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
+from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository, MetricEntry
+from sentinel_tpu.dashboard.api_client import ApiClient
+from sentinel_tpu.dashboard.fetcher import MetricFetcher
+from sentinel_tpu.dashboard.server import DashboardServer
+
+__all__ = [
+    "AppManagement",
+    "MachineInfo",
+    "InMemoryMetricsRepository",
+    "MetricEntry",
+    "ApiClient",
+    "MetricFetcher",
+    "DashboardServer",
+]

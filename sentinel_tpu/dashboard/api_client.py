@@ -1,0 +1,82 @@
+"""HTTP client to app command centers (``client/SentinelApiClient.java:93``).
+
+Fetches metric log lines and rules from, and pushes rules to, a machine's
+command center (the embedded HTTP server every guarded app runs).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import List, Optional
+
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.dashboard.discovery import MachineInfo
+from sentinel_tpu.metrics.log import MetricNode
+
+
+class ApiClient:
+    def __init__(self, timeout_s: float = 3.0):
+        self.timeout_s = timeout_s
+
+    def _get(self, machine: MachineInfo, command: str, params: dict) -> Optional[str]:
+        query = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
+        url = f"http://{machine.ip}:{machine.port}/{command}?{query}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as rsp:
+                return rsp.read().decode()
+        except Exception as e:
+            record_log.warning("command %s on %s failed: %s", command, machine.key, e)
+            return None
+
+    def _post(self, machine: MachineInfo, command: str, params: dict,
+              body: str) -> Optional[str]:
+        query = urllib.parse.urlencode(params)
+        url = f"http://{machine.ip}:{machine.port}/{command}?{query}"
+        try:
+            req = urllib.request.Request(
+                url, data=body.encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as rsp:
+                return rsp.read().decode()
+        except Exception as e:
+            record_log.warning("command %s on %s failed: %s", command, machine.key, e)
+            return None
+
+    # -- metrics (MetricFetcher's transport) --------------------------------
+    def fetch_metrics(
+        self, machine: MachineInfo, start_ms: int, end_ms: int
+    ) -> List[MetricNode]:
+        text = self._get(
+            machine, "metric", {"startTime": start_ms, "endTime": end_ms}
+        )
+        if not text:
+            return []
+        nodes = []
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            try:
+                nodes.append(MetricNode.from_line(line))
+            except (ValueError, IndexError):
+                continue
+        return nodes
+
+    # -- rules (SentinelApiClient.fetchRules / setRulesAsync) ---------------
+    def fetch_rules(self, machine: MachineInfo, rule_type: str) -> Optional[list]:
+        text = self._get(machine, "getRules", {"type": rule_type})
+        if text is None:
+            return None
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError:
+            record_log.warning("bad rules payload from %s", machine.key)
+            return None
+
+    def push_rules(self, machine: MachineInfo, rule_type: str, rules: list) -> bool:
+        rsp = self._post(
+            machine, "setRules", {"type": rule_type}, json.dumps(rules)
+        )
+        return rsp is not None and "success" in rsp

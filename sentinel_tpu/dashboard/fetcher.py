@@ -1,0 +1,101 @@
+"""Scheduled metric pull from every healthy machine.
+
+Analog of ``metric/MetricFetcher.java:70-210``: for each app, poll each
+healthy machine's ``/metric`` command for the window since the last fetch,
+sum the per-machine lines by (resource, second), and store into the
+repository. The reference trails real time by a few seconds so machines have
+flushed their metric logs; same here (``FETCH_DELAY_MS``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.dashboard.api_client import ApiClient
+from sentinel_tpu.dashboard.discovery import AppManagement
+from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository, MetricEntry
+
+FETCH_DELAY_MS = 2_000  # let apps flush their 1s aggregation first
+MAX_WINDOW_MS = 60_000  # don't backfill more than a minute on catch-up
+
+
+class MetricFetcher:
+    def __init__(
+        self,
+        apps: AppManagement,
+        repository: InMemoryMetricsRepository,
+        client: Optional[ApiClient] = None,
+        interval_s: float = 1.0,
+    ):
+        self.apps = apps
+        self.repository = repository
+        self.client = client or ApiClient()
+        self.interval_s = interval_s
+        self._last_fetch: Dict[str, int] = {}  # app → end of last window
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def fetch_once(self, app: str) -> int:
+        """Pull one window for ``app``; returns the number of entries stored."""
+        now = _clock.now_ms()
+        end = now - FETCH_DELAY_MS
+        start = self._last_fetch.get(app, end - 5_000)
+        if end <= start:
+            return 0
+        start = max(start, end - MAX_WINDOW_MS)
+        # aggregate by (resource, second) across machines (MetricFetcher
+        # dedupes identical lines and sums across the cluster)
+        agg: Dict[tuple, MetricEntry] = {}
+        for machine in self.apps.healthy_machines(app):
+            for node in self.client.fetch_metrics(machine, start, end):
+                key = (node.resource, node.timestamp_ms)
+                entry = agg.get(key)
+                if entry is None:
+                    agg[key] = MetricEntry(
+                        app=app,
+                        resource=node.resource,
+                        timestamp_ms=node.timestamp_ms,
+                        pass_qps=node.pass_qps,
+                        block_qps=node.block_qps,
+                        success_qps=node.success_qps,
+                        exception_qps=node.exception_qps,
+                        rt=node.rt,
+                    )
+                else:
+                    entry.pass_qps += node.pass_qps
+                    entry.block_qps += node.block_qps
+                    entry.success_qps += node.success_qps
+                    entry.exception_qps += node.exception_qps
+                    entry.rt = max(entry.rt, node.rt)
+        self.repository.save_all(list(agg.values()))
+        self._last_fetch[app] = end
+        return len(agg)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            for app in self.apps.apps():
+                try:
+                    self.fetch_once(app)
+                except Exception:
+                    record_log.exception("metric fetch for %s failed", app)
+
+    def start(self) -> "MetricFetcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="sentinel-metric-fetcher"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            if thread.is_alive():
+                return
+            self._thread = None
+        self._stop.clear()

@@ -1,0 +1,89 @@
+"""In-memory metric store with bounded retention.
+
+Analog of ``repository/metric/InMemoryMetricsRepository.java:40-63``
+(5-minute in-memory window, per app+resource).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from sentinel_tpu.core import clock as _clock
+
+RETENTION_MS = 5 * 60 * 1000  # InMemoryMetricsRepository.java:42
+
+
+@dataclass
+class MetricEntry:
+    app: str
+    resource: str
+    timestamp_ms: int
+    pass_qps: float = 0.0
+    block_qps: float = 0.0
+    success_qps: float = 0.0
+    exception_qps: float = 0.0
+    rt: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "app": self.app,
+            "resource": self.resource,
+            "timestamp": self.timestamp_ms,
+            "passQps": self.pass_qps,
+            "blockQps": self.block_qps,
+            "successQps": self.success_qps,
+            "exceptionQps": self.exception_qps,
+            "rt": self.rt,
+        }
+
+
+class InMemoryMetricsRepository:
+    def __init__(self, retention_ms: int = RETENTION_MS):
+        self._lock = threading.Lock()
+        self.retention_ms = retention_ms
+        # (app, resource) → {timestamp → MetricEntry}
+        self._store: Dict[Tuple[str, str], Dict[int, MetricEntry]] = {}
+
+    def save(self, entry: MetricEntry) -> None:
+        with self._lock:
+            series = self._store.setdefault((entry.app, entry.resource), {})
+            series[entry.timestamp_ms] = entry
+            self._evict_locked(series)
+
+    def save_all(self, entries: List[MetricEntry]) -> None:
+        for e in entries:
+            self.save(e)
+
+    def _evict_locked(self, series: Dict[int, MetricEntry]) -> None:
+        horizon = _clock.now_ms() - self.retention_ms
+        for ts in [t for t in series if t < horizon]:
+            del series[ts]
+
+    def query(
+        self, app: str, resource: str, start_ms: int, end_ms: int
+    ) -> List[MetricEntry]:
+        with self._lock:
+            series = self._store.get((app, resource), {})
+            return sorted(
+                (e for ts, e in series.items() if start_ms <= ts <= end_ms),
+                key=lambda e: e.timestamp_ms,
+            )
+
+    def resources_of_app(self, app: str) -> List[str]:
+        """Resources sorted by recent pass+block volume (the reference sorts
+        the sidebar by last-minute QPS)."""
+        now = _clock.now_ms()
+        with self._lock:
+            volume: Dict[str, float] = {}
+            for (a, resource), series in self._store.items():
+                if a != app:
+                    continue
+                v = sum(
+                    e.pass_qps + e.block_qps
+                    for ts, e in series.items()
+                    if ts >= now - 60_000
+                )
+                volume[resource] = v
+            return sorted(volume, key=lambda r: (-volume[r], r))

@@ -1,0 +1,214 @@
+"""Dashboard HTTP server: heartbeat sink + REST API + minimal console page.
+
+Analog of the Spring Boot side of ``sentinel-dashboard``:
+``MachineRegistryController`` (``/registry/machine``), metric queries over
+the in-memory repository, and rule CRUD proxied to app command centers
+(``FlowControllerV1`` + ``SentinelApiClient``). Runs on the stdlib
+threading HTTP server — the console is an ops tool, not a hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.dashboard.api_client import ApiClient
+from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
+from sentinel_tpu.dashboard.fetcher import MetricFetcher
+from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+
+RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow")
+
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>sentinel-tpu console</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;min-width:40rem}
+ th,td{border:1px solid #ccc;padding:.35rem .6rem;text-align:left;font-size:.9rem}
+ th{background:#f5f5f5} .dead{color:#b00} .ok{color:#070}
+ code{background:#f0f0f0;padding:0 .3rem}
+</style></head><body>
+<h1>sentinel-tpu console</h1>
+<div id="apps"></div>
+<script>
+// resource names and machine fields are attacker-influenced (a resource is
+// often a raw request path) — build rows with textContent only, never
+// string-interpolated HTML
+function row(table, cells, tag){
+  const tr = document.createElement('tr');
+  for (const c of cells){
+    const td = document.createElement(tag || 'td');
+    if (c && c.cls) { td.textContent = c.text; td.className = c.cls; }
+    else td.textContent = c;
+    tr.appendChild(td);
+  }
+  table.appendChild(tr);
+}
+async function refresh(){
+  const apps = await (await fetch('apps')).json();
+  const root = document.getElementById('apps');
+  root.innerHTML = '';
+  for (const app of apps){
+    const h = document.createElement('h2'); h.textContent = app.name; root.appendChild(h);
+    const mt = document.createElement('table');
+    row(mt, ['machine', 'version', 'status'], 'th');
+    for (const m of app.machines)
+      row(mt, [`${m.ip}:${m.port}`, m.version,
+               {text: m.healthy?'healthy':'dead', cls: m.healthy?'ok':'dead'}]);
+    root.appendChild(mt);
+    const res = await (await fetch('resources?app='+encodeURIComponent(app.name))).json();
+    const rt = document.createElement('table');
+    row(rt, ['resource', 'pass qps', 'block qps', 'rt ms'], 'th');
+    const now = Date.now();
+    for (const r of res){
+      const ms = await (await fetch(`metric?app=${encodeURIComponent(app.name)}` +
+        `&identity=${encodeURIComponent(r)}&startTime=${now-15000}&endTime=${now}`)).json();
+      const last = ms[ms.length-1] || {};
+      row(rt, [r, last.passQps??'', last.blockQps??'', last.rt??'']);
+    }
+    root.appendChild(rt);
+  }
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+class DashboardServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        fetch_interval_s: float = 1.0,
+    ):
+        self.apps = AppManagement()
+        self.repository = InMemoryMetricsRepository()
+        self.client = ApiClient()
+        self.fetcher = MetricFetcher(
+            self.apps, self.repository, self.client, fetch_interval_s
+        )
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling ----------------------------------------------------
+    def _route(self, method: str, path: str, params: dict, body: str):
+        if method == "POST" and path == "registry/machine":
+            data = json.loads(body) if body else dict(params)
+            machine = MachineInfo(
+                app=str(data.get("app", "")),
+                ip=str(data.get("ip", "")),
+                port=int(data.get("port", 0)),
+                hostname=str(data.get("hostname", "")),
+                version=str(data.get("version", "")),
+                last_heartbeat_ms=_clock.now_ms(),
+            )
+            self.apps.register(machine)
+            return {"code": 0, "msg": "success"}
+        if path == "apps":
+            return [
+                {
+                    "name": app,
+                    "machines": [m.to_dict() for m in self.apps.machines(app)],
+                }
+                for app in self.apps.apps()
+            ]
+        if path == "resources":
+            return self.repository.resources_of_app(params.get("app", ""))
+        if path == "metric":
+            entries = self.repository.query(
+                params.get("app", ""),
+                params.get("identity", ""),
+                int(params.get("startTime", 0)),
+                int(params.get("endTime", 2**62)),
+            )
+            return [e.to_dict() for e in entries]
+        if path == "rules":
+            app = params.get("app", "")
+            rule_type = params.get("type", "flow")
+            if rule_type not in RULE_TYPES:
+                return {"error": f"unknown rule type {rule_type}"}
+            machines = self.apps.healthy_machines(app)
+            if not machines:
+                return {"error": f"no healthy machine for app {app}"}
+            if method == "POST":
+                rules = json.loads(body)
+                pushed = sum(
+                    self.client.push_rules(m, rule_type, rules) for m in machines
+                )
+                return {"pushed": pushed, "machines": len(machines)}
+            return self.client.fetch_rules(machines[0], rule_type)
+        if path in ("", "index.html"):
+            return _INDEX_HTML
+        return None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "DashboardServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "SentinelTPUDashboard"
+
+            def _dispatch(self, method: str, body: str) -> None:
+                parsed = urlparse(self.path)
+                params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                try:
+                    result = outer._route(
+                        method, parsed.path.strip("/"), params, body
+                    )
+                except Exception as e:
+                    record_log.exception("dashboard request failed")
+                    self._reply(500, json.dumps({"error": str(e)}))
+                    return
+                if result is None:
+                    self._reply(404, json.dumps({"error": "not found"}))
+                elif isinstance(result, str):
+                    self._reply(200, result, "text/html; charset=utf-8")
+                else:
+                    self._reply(200, json.dumps(result))
+
+            def _reply(self, code, text, ctype="application/json; charset=utf-8"):
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET", "")
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length).decode() if length else ""
+                self._dispatch("POST", body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="sentinel-dashboard",
+        )
+        self._thread.start()
+        self.fetcher.start()
+        record_log.info("dashboard on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        self.fetcher.stop()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

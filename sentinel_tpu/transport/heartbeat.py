@@ -26,9 +26,15 @@ class HeartbeatSender:
         dashboard_addrs: Optional[List[str]] = None,
         command_port: Optional[int] = None,
         interval_ms: Optional[int] = None,
+        client_ip: Optional[str] = None,
     ):
         raw = SentinelConfig.get("csp.sentinel.dashboard.server") or ""
         self.addrs = dashboard_addrs or [a for a in raw.split(",") if a]
+        # csp.sentinel.heartbeat.client.ip (TransportConfig): pin the
+        # advertised IP when the auto-detected one isn't routable
+        self.client_ip = client_ip or SentinelConfig.get(
+            "csp.sentinel.heartbeat.client.ip"
+        )
         # keys keep the reference's names (TransportConfig.java:35-41)
         self.command_port = command_port or SentinelConfig.get_int(
             "csp.sentinel.api.port", 8719
@@ -45,7 +51,7 @@ class HeartbeatSender:
                 "app": SentinelConfig.app_name(),
                 "app_type": SentinelConfig.get_int("csp.sentinel.app.type", 0),
                 "hostname": socket.gethostname(),
-                "ip": _local_ip(),
+                "ip": self.client_ip or _local_ip(),
                 "port": self.command_port,
                 "version": f"sentinel-tpu/{sentinel_tpu.__version__}",
                 "timestamp": _clock.now_ms(),

@@ -109,6 +109,18 @@ class TestDecorator:
         assert asyncio.run(fn()) == "ok"
         assert asyncio.run(fn()) == "blocked"
 
+    def test_async_handlers_are_awaited(self, manual_clock):
+        async def on_block(ex):
+            return "async-blocked"
+
+        @sentinel_resource("deco_async_bh", block_handler=on_block)
+        async def fn():
+            return "ok"
+
+        FlowRuleManager.load_rules([FlowRule(resource="deco_async_bh", count=1)])
+        assert asyncio.run(fn()) == "ok"
+        assert asyncio.run(fn()) == "async-blocked"  # result, not a coroutine
+
     def test_args_as_params_feed_hot_param_rules(self, manual_clock):
         from sentinel_tpu.local import ParamFlowRule, ParamFlowRuleManager
 
@@ -192,6 +204,56 @@ class TestWsgi:
 
         node = cluster_node_map()["GET:/err"]
         assert node.exception_qps(manual_clock.now_ms()) > 0
+
+    def test_streaming_body_holds_entry_open(self, manual_clock):
+        """THREAD concurrency and RT must span body iteration, not just the
+        app call (streaming responses)."""
+        from sentinel_tpu.local.chain import cluster_node_map
+
+        observed = []
+
+        def streaming_app(environ, start_response):
+            start_response("200 OK", [])
+
+            def gen():
+                observed.append(cluster_node_map()["GET:/stream"].cur_thread_num)
+                yield b"chunk"
+
+            return gen()
+
+        app = SentinelWsgiMiddleware(streaming_app)
+        status_headers = {}
+        body = app(
+            {"REQUEST_METHOD": "GET", "PATH_INFO": "/stream", "REMOTE_ADDR": ""},
+            lambda s, h: status_headers.update(status=s),
+        )
+        chunks = list(body)  # consume — entry held open during iteration
+        assert chunks == [b"chunk"]
+        assert observed == [1]  # concurrency visible mid-stream
+        assert cluster_node_map()["GET:/stream"].cur_thread_num == 0  # released
+
+    def test_streaming_iteration_error_traced(self, manual_clock):
+        from sentinel_tpu.local.chain import cluster_node_map
+
+        def streaming_app(environ, start_response):
+            start_response("200 OK", [])
+
+            def gen():
+                yield b"x"
+                raise RuntimeError("mid-stream")
+
+            return gen()
+
+        app = SentinelWsgiMiddleware(streaming_app)
+        body = app(
+            {"REQUEST_METHOD": "GET", "PATH_INFO": "/stream2", "REMOTE_ADDR": ""},
+            lambda s, h: None,
+        )
+        with pytest.raises(RuntimeError):
+            list(body)
+        node = cluster_node_map()["GET:/stream2"]
+        assert node.exception_qps(manual_clock.now_ms()) > 0
+        assert node.cur_thread_num == 0
 
 
 async def _asgi_app(scope, receive, send):
@@ -345,6 +407,16 @@ class TestGateway:
         )
         req = DictRequestAdapter(ip="1.1.1.1", params={"user": "u7"})
         assert GatewayRuleManager.parse("route_d", req) == ("1.1.1.1", "u7")
+
+    def test_removed_gateway_rules_are_unloaded(self, manual_clock):
+        from sentinel_tpu.local import ParamFlowRuleManager
+
+        GatewayRuleManager.load_rules(
+            [GatewayFlowRule(resource="route_gone", count=1)]
+        )
+        assert "route_gone" in ParamFlowRuleManager.all_rules()
+        GatewayRuleManager.load_rules([])
+        assert "route_gone" not in ParamFlowRuleManager.all_rules()
 
     def test_gateway_load_preserves_foreign_param_rules(self, manual_clock):
         from sentinel_tpu.local import ParamFlowRule, ParamFlowRuleManager

@@ -1,0 +1,222 @@
+"""Dashboard tests.
+
+Repository/discovery units with a fake clock (reference: 23 dashboard test
+files covering entities and repositories), plus a full pull-pipeline
+integration: guarded app with command center + heartbeat → dashboard
+registry → fetcher → repository → REST queries (the reference never
+integration-tests this loop; the TPU build does)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import sentinel_tpu.local as sentinel
+from sentinel_tpu.dashboard import (
+    AppManagement,
+    DashboardServer,
+    InMemoryMetricsRepository,
+    MachineInfo,
+    MetricEntry,
+    MetricFetcher,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    sentinel.reset_for_tests()
+    yield
+    sentinel.reset_for_tests()
+
+
+class TestDiscovery:
+    def test_register_and_health(self, manual_clock):
+        apps = AppManagement()
+        apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=8719))
+        assert apps.apps() == ["svc"]
+        assert len(apps.healthy_machines("svc")) == 1
+        manual_clock.sleep(40_000)  # heartbeat stale
+        assert apps.healthy_machines("svc") == []
+        assert len(apps.machines("svc")) == 1  # still listed, marked dead
+        assert apps.machines("svc")[0].to_dict()["healthy"] is False
+
+    def test_reregister_updates_heartbeat(self, manual_clock):
+        apps = AppManagement()
+        apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=8719))
+        manual_clock.sleep(40_000)
+        apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=8719))
+        assert len(apps.healthy_machines("svc")) == 1
+        assert len(apps.machines("svc")) == 1  # same key, no duplicate
+
+    def test_invalid_machine_rejected(self):
+        apps = AppManagement()
+        with pytest.raises(ValueError):
+            apps.register(MachineInfo(app="", ip="1.2.3.4", port=1))
+
+
+class TestRepository:
+    def test_save_query_and_retention(self, manual_clock):
+        repo = InMemoryMetricsRepository()
+        t0 = manual_clock.now_ms()
+        repo.save(MetricEntry("svc", "res", t0, pass_qps=10))
+        manual_clock.sleep(6 * 60 * 1000)  # beyond 5-min retention
+        repo.save(MetricEntry("svc", "res", manual_clock.now_ms(), pass_qps=20))
+        entries = repo.query("svc", "res", 0, 2**61)
+        assert [e.pass_qps for e in entries] == [20]  # old entry evicted
+
+    def test_resources_sorted_by_volume(self, manual_clock):
+        repo = InMemoryMetricsRepository()
+        now = manual_clock.now_ms()
+        repo.save(MetricEntry("svc", "cold", now, pass_qps=1))
+        repo.save(MetricEntry("svc", "hot", now, pass_qps=100))
+        repo.save(MetricEntry("other_app", "x", now, pass_qps=999))
+        assert repo.resources_of_app("svc") == ["hot", "cold"]
+
+
+class TestFetcher:
+    def test_aggregates_across_machines(self, manual_clock, monkeypatch):
+        from sentinel_tpu.metrics.log import MetricNode
+
+        apps = AppManagement()
+        repo = InMemoryMetricsRepository()
+        fetcher = MetricFetcher(apps, repo)
+        apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=1))
+        apps.register(MachineInfo(app="svc", ip="10.0.0.2", port=1))
+        ts = manual_clock.now_ms() // 1000 * 1000 - 3000
+
+        def fake_fetch(machine, start, end):
+            return [MetricNode(timestamp_ms=ts, resource="res", pass_qps=5,
+                               block_qps=1, rt=2.0)]
+
+        monkeypatch.setattr(fetcher.client, "fetch_metrics", fake_fetch)
+        stored = fetcher.fetch_once("svc")
+        assert stored == 1
+        entry = repo.query("svc", "res", 0, 2**61)[0]
+        assert entry.pass_qps == 10  # summed across the two machines
+        assert entry.block_qps == 2
+
+    def test_window_advances(self, manual_clock, monkeypatch):
+        apps = AppManagement()
+        repo = InMemoryMetricsRepository()
+        fetcher = MetricFetcher(apps, repo)
+        apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=1))
+        windows = []
+
+        def fake_fetch(machine, start, end):
+            windows.append((start, end))
+            return []
+
+        monkeypatch.setattr(fetcher.client, "fetch_metrics", fake_fetch)
+        fetcher.fetch_once("svc")
+        manual_clock.sleep(1000)
+        fetcher.fetch_once("svc")
+        assert windows[1][0] == windows[0][1]  # contiguous, no gap/overlap
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/{path}", timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+class TestEndToEnd:
+    def test_full_pull_pipeline(self):
+        """app (command center + metric log + heartbeat) → dashboard."""
+        import time
+
+        from sentinel_tpu.local import FlowRule, FlowRuleManager
+        from sentinel_tpu.metrics.log import MetricTimer, MetricWriter
+        from sentinel_tpu.transport.command import CommandCenter
+        from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+        import tempfile
+
+        dash = DashboardServer(port=0, fetch_interval_s=0.2).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        with tempfile.TemporaryDirectory() as tmp:
+            # point the app's metric log (writer and /metric command) at tmp
+            import sentinel_tpu.metrics.log as mlog
+
+            orig = mlog.default_metric_dir
+            mlog.default_metric_dir = lambda: tmp
+            timer = MetricTimer(MetricWriter(base_dir=tmp), interval_s=0.2)
+            try:
+                FlowRuleManager.load_rules([FlowRule(resource="e2e_res", count=1000)])
+                hb = HeartbeatSender(
+                    dashboard_addrs=[f"127.0.0.1:{dash.port}"],
+                    command_port=cc.port, interval_ms=200,
+                    client_ip="127.0.0.1",
+                )
+                assert hb.send_once()
+                timer.start()
+                # generate traffic across ~2 aggregation seconds
+                deadline = time.time() + 2.5
+                while time.time() < deadline:
+                    with sentinel.entry("e2e_res"):
+                        pass
+                    time.sleep(0.01)
+                # dashboard registered the machine
+                apps = _get(dash.port, "apps")
+                names = [a["name"] for a in apps]
+                assert any(a["machines"] for a in apps)
+                # fetcher pulled metrics for the guarded resource
+                found = []
+                for _ in range(30):
+                    app_name = names[0]
+                    res = _get(dash.port, f"resources?app={app_name}")
+                    if "e2e_res" in res:
+                        found = _get(
+                            dash.port,
+                            f"metric?app={app_name}&identity=e2e_res"
+                            f"&startTime=0&endTime={2**61}",
+                        )
+                        if found:
+                            break
+                    time.sleep(0.2)
+                assert found, "dashboard never received e2e_res metrics"
+                assert sum(e["passQps"] for e in found) > 0
+            finally:
+                mlog.default_metric_dir = orig
+                timer.stop()
+                cc.stop()
+                dash.stop()
+
+    def test_rule_push_proxied_to_app(self):
+        from sentinel_tpu.local import FlowRuleManager
+        from sentinel_tpu.transport.command import CommandCenter
+
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            # register the app machine by hand (no heartbeat thread needed)
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            body = json.dumps([{"resource": "pushed_res", "count": 7}]).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dash.port}/rules?app=svc&type=flow",
+                data=body, headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                out = json.loads(r.read().decode())
+            assert out["pushed"] == 1
+            rules = FlowRuleManager.all_rules()
+            assert any(r.resource == "pushed_res" and r.count == 7 for r in rules)
+            # and fetch back through the dashboard proxy
+            fetched = _get(dash.port, "rules?app=svc&type=flow")
+            assert any(r["resource"] == "pushed_res" for r in fetched)
+        finally:
+            cc.stop()
+            dash.stop()
+
+    def test_console_page_served(self):
+        dash = DashboardServer(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/", timeout=5
+            ) as r:
+                html = r.read().decode()
+            assert "sentinel-tpu console" in html
+        finally:
+            dash.stop()

@@ -1,0 +1,375 @@
+// Native host runtime for sentinel-tpu: the per-call hot paths that the
+// reference implements with JVM concurrency primitives (LongAdder arrays,
+// CAS window loops — LeapArray.java:116-160, RateLimiterController.java:46-91,
+// ParamFlowChecker.java:127-190) re-expressed as lock-free C++.
+//
+// The Python host layer uses these through ctypes (sentinel_tpu/native/).
+// Semantics are kept bit-identical with the numpy fallbacks in
+// sentinel_tpu/local/stat.py: same ring math, same mask-on-read deprecation,
+// so either backend can serve the local (non-cluster) decision path. The
+// device engine (JAX/Pallas) remains the source of truth for batched and
+// cluster decisions.
+//
+// Concurrency model: counters are atomic doubles (CAS add); bucket reset
+// takes a per-bucket spinlock, mirroring the reference's single
+// ReentrantLock-guarded reset arm (LeapArray.java:53). Readers never block:
+// a bucket whose start is stale is simply excluded by the validity mask,
+// exactly like isWindowDeprecated().
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+#if defined(_WIN32)
+#define SN_EXPORT extern "C" __declspec(dllexport)
+#else
+#define SN_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace {
+
+constexpr int64_t NEVER = -(int64_t(1) << 60);
+
+inline void atomic_add_double(std::atomic<double> &cell, double n) {
+  double old = cell.load(std::memory_order_relaxed);
+  while (!cell.compare_exchange_weak(old, old + n, std::memory_order_relaxed)) {
+  }
+}
+
+struct SpinLock {
+  std::atomic_flag flag = ATOMIC_FLAG_INIT;
+  void lock() {
+    while (flag.test_and_set(std::memory_order_acquire)) {
+    }
+  }
+  void unlock() { flag.clear(std::memory_order_release); }
+};
+
+// ---------------------------------------------------------------------------
+// Sliding window (HostWindow / LeapArray analog)
+// ---------------------------------------------------------------------------
+
+struct Window {
+  int32_t bucket_ms;
+  int32_t n_buckets;
+  int32_t n_channels;
+  int64_t interval_ms;
+  std::atomic<int64_t> *starts;  // [n_buckets]
+  SpinLock *reset_locks;         // [n_buckets]
+  std::atomic<double> *counts;   // [n_buckets * n_channels]
+
+  Window(int32_t bms, int32_t nb, int32_t nc)
+      : bucket_ms(bms), n_buckets(nb), n_channels(nc),
+        interval_ms(int64_t(bms) * nb) {
+    starts = new std::atomic<int64_t>[nb];
+    reset_locks = new SpinLock[nb];
+    counts = new std::atomic<double>[size_t(nb) * nc];
+    for (int32_t b = 0; b < nb; b++) starts[b].store(NEVER);
+    for (size_t i = 0; i < size_t(nb) * nc; i++) counts[i].store(0.0);
+  }
+  ~Window() {
+    delete[] starts;
+    delete[] reset_locks;
+    delete[] counts;
+  }
+
+  inline int32_t idx_of(int64_t t) const {
+    return int32_t((t / bucket_ms) % n_buckets);
+  }
+  inline int64_t start_of(int64_t t) const { return t - t % bucket_ms; }
+
+  // Occupy the ring slot for window-start `ws` at slot `idx`, zeroing it if a
+  // different window holds it (reset arm of LeapArray.currentWindow).
+  void occupy(int32_t idx, int64_t ws) {
+    if (starts[idx].load(std::memory_order_acquire) == ws) return;
+    reset_locks[idx].lock();
+    if (starts[idx].load(std::memory_order_relaxed) != ws) {
+      for (int32_t c = 0; c < n_channels; c++)
+        counts[size_t(idx) * n_channels + c].store(0.0,
+                                                   std::memory_order_relaxed);
+      starts[idx].store(ws, std::memory_order_release);
+    }
+    reset_locks[idx].unlock();
+  }
+
+  void add(int64_t now, int32_t chan, double n) {
+    int32_t idx = idx_of(now);
+    occupy(idx, start_of(now));
+    atomic_add_double(counts[size_t(idx) * n_channels + chan], n);
+  }
+
+  inline bool valid(int64_t now, int32_t b) const {
+    int64_t age = now - starts[b].load(std::memory_order_acquire);
+    return age >= 0 && age < interval_ms;
+  }
+
+  double sum(int64_t now, int32_t chan) const {
+    double total = 0.0;
+    for (int32_t b = 0; b < n_buckets; b++)
+      if (valid(now, b))
+        total += counts[size_t(b) * n_channels + chan].load(
+            std::memory_order_relaxed);
+    return total;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Token bucket array (ParamFlowChecker.passDefaultLocalCheck analog)
+// ---------------------------------------------------------------------------
+
+struct TokenBuckets {
+  int32_t n_slots;
+  std::atomic<double> *tokens;         // remaining tokens per slot
+  std::atomic<int64_t> *last_fill_ms;  // last refill time per slot
+  SpinLock *locks;
+
+  explicit TokenBuckets(int32_t n) : n_slots(n) {
+    tokens = new std::atomic<double>[n];
+    last_fill_ms = new std::atomic<int64_t>[n];
+    locks = new SpinLock[n];
+    for (int32_t i = 0; i < n; i++) {
+      tokens[i].store(-1.0);  // -1 → uninitialized (first acquire fills)
+      last_fill_ms[i].store(NEVER);
+    }
+  }
+  ~TokenBuckets() {
+    delete[] tokens;
+    delete[] last_fill_ms;
+    delete[] locks;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Leaky-bucket pacer array (RateLimiterController.latestPassedTime analog)
+// ---------------------------------------------------------------------------
+
+struct Pacers {
+  int32_t n_slots;
+  std::atomic<int64_t> *latest_passed;  // µs-scaled ms like the reference? ms.
+
+  explicit Pacers(int32_t n) : n_slots(n) {
+    latest_passed = new std::atomic<int64_t>[n];
+    for (int32_t i = 0; i < n; i++) latest_passed[i].store(NEVER);
+  }
+  ~Pacers() { delete[] latest_passed; }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+SN_EXPORT void *sn_window_create(int32_t bucket_ms, int32_t n_buckets,
+                                 int32_t n_channels) {
+  return new (std::nothrow) Window(bucket_ms, n_buckets, n_channels);
+}
+
+SN_EXPORT void sn_window_destroy(void *w) { delete static_cast<Window *>(w); }
+
+SN_EXPORT void sn_window_add(void *w, int64_t now, int32_t chan, double n) {
+  static_cast<Window *>(w)->add(now, chan, n);
+}
+
+SN_EXPORT double sn_window_sum(void *w, int64_t now, int32_t chan) {
+  return static_cast<Window *>(w)->sum(now, chan);
+}
+
+// Per-channel valid sums in one pass (metric-log snapshot path).
+SN_EXPORT void sn_window_snapshot(void *wp, int64_t now, double *out) {
+  Window *w = static_cast<Window *>(wp);
+  for (int32_t c = 0; c < w->n_channels; c++) out[c] = 0.0;
+  for (int32_t b = 0; b < w->n_buckets; b++)
+    if (w->valid(now, b))
+      for (int32_t c = 0; c < w->n_channels; c++)
+        out[c] += w->counts[size_t(b) * w->n_channels + c].load(
+            std::memory_order_relaxed);
+}
+
+// Count in the bucket one bucket-length before the current one
+// (ArrayMetric.previousWindowPass shape, used by warm-up).
+SN_EXPORT double sn_window_prev_bucket(void *wp, int64_t now, int32_t chan) {
+  Window *w = static_cast<Window *>(wp);
+  int64_t prev_start = w->start_of(now) - w->bucket_ms;
+  // floor-mod: prev_start can be negative near the engine epoch
+  int32_t idx =
+      int32_t(((prev_start / w->bucket_ms) % w->n_buckets + w->n_buckets) %
+              w->n_buckets);
+  if (w->starts[idx].load(std::memory_order_acquire) == prev_start)
+    return w->counts[size_t(idx) * w->n_channels + chan].load(
+        std::memory_order_relaxed);
+  return 0.0;
+}
+
+// min over valid buckets of counts[num]/counts[den] where counts[den] > 0
+// (StatisticNode.min_rt shape: rt / success).
+SN_EXPORT double sn_window_min_ratio(void *wp, int64_t now, int32_t num_chan,
+                                     int32_t den_chan) {
+  Window *w = static_cast<Window *>(wp);
+  double best = -1.0;
+  for (int32_t b = 0; b < w->n_buckets; b++) {
+    if (!w->valid(now, b)) continue;
+    double den = w->counts[size_t(b) * w->n_channels + den_chan].load(
+        std::memory_order_relaxed);
+    if (den <= 0) continue;
+    double r = w->counts[size_t(b) * w->n_channels + num_chan].load(
+                   std::memory_order_relaxed) /
+               den;
+    if (best < 0 || r < best) best = r;
+  }
+  return best < 0 ? 0.0 : best;
+}
+
+SN_EXPORT int64_t sn_window_start_at(void *wp, int32_t b) {
+  return static_cast<Window *>(wp)->starts[b].load(std::memory_order_acquire);
+}
+
+SN_EXPORT double sn_window_count_at(void *wp, int32_t b, int32_t chan) {
+  Window *w = static_cast<Window *>(wp);
+  return w->counts[size_t(b) * w->n_channels + chan].load(
+      std::memory_order_relaxed);
+}
+
+// --- future (occupy/borrow) semantics on a 1+ channel window ---------------
+
+// Add into the bucket holding `future_time` (FutureBucketLeapArray.addWaiting).
+SN_EXPORT void sn_window_add_future(void *wp, int64_t future_time, int32_t chan,
+                                    double n) {
+  static_cast<Window *>(wp)->add(future_time, chan, n);
+}
+
+// Sum of buckets strictly in the future within one interval (currentWaiting).
+SN_EXPORT double sn_window_future_waiting(void *wp, int64_t now, int32_t chan) {
+  Window *w = static_cast<Window *>(wp);
+  double total = 0.0;
+  for (int32_t b = 0; b < w->n_buckets; b++) {
+    int64_t ahead = w->starts[b].load(std::memory_order_acquire) - now;
+    if (ahead > 0 && ahead <= w->interval_ms)
+      total += w->counts[size_t(b) * w->n_channels + chan].load(
+          std::memory_order_relaxed);
+  }
+  return total;
+}
+
+// Drain the current bucket if its window has arrived (matured borrows).
+SN_EXPORT double sn_window_take_matured(void *wp, int64_t now, int32_t chan) {
+  Window *w = static_cast<Window *>(wp);
+  int64_t cur_start = w->start_of(now);
+  int32_t idx = w->idx_of(cur_start);
+  if (w->starts[idx].load(std::memory_order_acquire) != cur_start) return 0.0;
+  std::atomic<double> &cell = w->counts[size_t(idx) * w->n_channels + chan];
+  double old = cell.load(std::memory_order_relaxed);
+  while (old != 0.0 &&
+         !cell.compare_exchange_weak(old, 0.0, std::memory_order_relaxed)) {
+  }
+  return old;
+}
+
+// --- token buckets ---------------------------------------------------------
+
+SN_EXPORT void *sn_tb_create(int32_t n_slots) {
+  return new (std::nothrow) TokenBuckets(n_slots);
+}
+
+SN_EXPORT void sn_tb_destroy(void *t) {
+  delete static_cast<TokenBuckets *>(t);
+}
+
+SN_EXPORT void sn_tb_reset(void *tp, int32_t slot) {
+  TokenBuckets *t = static_cast<TokenBuckets *>(tp);
+  t->tokens[slot].store(-1.0, std::memory_order_relaxed);
+  t->last_fill_ms[slot].store(NEVER, std::memory_order_relaxed);
+}
+
+// Token-bucket admission with burst (ParamFlowChecker.java:127-190): refill
+// `elapsed * count / interval` tokens capped at count + burst, then consume.
+// Returns 1 = pass, 0 = block.
+SN_EXPORT int32_t sn_tb_try_acquire(void *tp, int32_t slot, int64_t now,
+                                    int32_t acquire, double count,
+                                    double burst, int64_t interval_ms) {
+  TokenBuckets *t = static_cast<TokenBuckets *>(tp);
+  double cap = count + burst;
+  t->locks[slot].lock();
+  double tok = t->tokens[slot].load(std::memory_order_relaxed);
+  int64_t last = t->last_fill_ms[slot].load(std::memory_order_relaxed);
+  if (tok < 0 || last == NEVER) {
+    // first sight of this slot: full bucket; an oversized acquire empties it
+    // and blocks (ParamFlowChecker first-fill arm)
+    t->last_fill_ms[slot].store(now, std::memory_order_relaxed);
+    if (cap < double(acquire)) {
+      t->tokens[slot].store(0.0, std::memory_order_relaxed);
+      t->locks[slot].unlock();
+      return 0;
+    }
+    t->tokens[slot].store(cap - double(acquire), std::memory_order_relaxed);
+    t->locks[slot].unlock();
+    return 1;
+  }
+  if (now > last) {
+    double refill = double(now - last) * count / double(interval_ms);
+    if (refill > 0) {
+      tok = tok + refill > cap ? cap : tok + refill;
+      last = now;
+    }
+  }
+  int32_t ok = 0;
+  if (tok >= double(acquire)) {
+    tok -= double(acquire);
+    ok = 1;
+  }
+  t->tokens[slot].store(tok, std::memory_order_relaxed);
+  t->last_fill_ms[slot].store(last, std::memory_order_relaxed);
+  t->locks[slot].unlock();
+  return ok;
+}
+
+// --- leaky-bucket pacers ---------------------------------------------------
+
+SN_EXPORT void *sn_pacer_create(int32_t n_slots) {
+  return new (std::nothrow) Pacers(n_slots);
+}
+
+SN_EXPORT void sn_pacer_destroy(void *p) { delete static_cast<Pacers *>(p); }
+
+SN_EXPORT void sn_pacer_reset(void *pp, int32_t slot) {
+  static_cast<Pacers *>(pp)->latest_passed[slot].store(
+      NEVER, std::memory_order_relaxed);
+}
+
+// Uniform-pacing admission (RateLimiterController.java:46-91): cost of
+// `acquire` tokens is `acquire / count * 1000` ms after the latest passed
+// time. Returns the ms the caller must sleep (0 = immediate), or -1 = block
+// (expected wait exceeds max_queue_ms). CAS keeps concurrent callers strictly
+// serialized on the shared latest_passed timeline.
+SN_EXPORT int64_t sn_pacer_try_pass(void *pp, int32_t slot, int64_t now,
+                                    int32_t acquire, double count_per_sec,
+                                    int64_t max_queue_ms) {
+  if (count_per_sec <= 0) return -1;
+  Pacers *p = static_cast<Pacers *>(pp);
+  int64_t cost = int64_t(double(acquire) / count_per_sec * 1000.0 + 0.5);
+  std::atomic<int64_t> &latest = p->latest_passed[slot];
+  for (;;) {
+    int64_t prev = latest.load(std::memory_order_acquire);
+    if (prev == NEVER) {  // first request on this slot passes immediately
+      if (latest.compare_exchange_weak(prev, now, std::memory_order_acq_rel))
+        return 0;
+      continue;
+    }
+    int64_t expected = prev + cost;
+    if (expected <= now) {
+      if (latest.compare_exchange_weak(prev, now, std::memory_order_acq_rel))
+        return 0;
+      continue;
+    }
+    int64_t wait = expected - now;
+    if (wait > max_queue_ms) return -1;
+    if (latest.compare_exchange_weak(prev, expected,
+                                     std::memory_order_acq_rel)) {
+      // re-check like the reference: a racing sleeper may have pushed the
+      // queue past the budget between load and CAS — the CAS serializes, so
+      // wait computed from our own CAS'd value is authoritative.
+      return wait;
+    }
+  }
+}

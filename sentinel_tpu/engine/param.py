@@ -52,6 +52,9 @@ class ParamConfig(NamedTuple):
     width: int = 2048
     bucket_ms: int = 500
     n_buckets: int = 2  # 1s sliding window like the local second-level
+    # "jax" = pure-XLA path below; "pallas" = ops/cms_pallas.py kernel
+    # (interpret mode off-TPU); "auto" = pallas on TPU, jax elsewhere.
+    impl: str = "jax"
 
     @property
     def interval_ms(self) -> int:
@@ -76,8 +79,85 @@ def make_param_state(config: ParamConfig) -> ParamState:
     )
 
 
-@partial(jax.jit, static_argnames=("config",))
 def param_decide(
+    config: ParamConfig,
+    state: ParamState,
+    rule_slot: jax.Array,
+    idx: jax.Array,
+    acquire: jax.Array,
+    threshold: jax.Array,
+    valid: jax.Array,
+    now: jax.Array,
+) -> Tuple[ParamState, jax.Array, jax.Array]:
+    """Dispatch on ``config.impl`` — see :func:`_param_decide_jax`."""
+    impl = config.impl
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = (
+            "pallas" if on_tpu and rule_slot.shape[0] <= _pallas_max_batch() else "jax"
+        )
+    if impl == "pallas":
+        return _param_decide_pallas(
+            config, state, rule_slot, idx, acquire, threshold, valid, now
+        )
+    if impl != "jax":
+        raise ValueError(f"unknown param impl {impl!r}; use 'jax'|'pallas'|'auto'")
+    return _param_decide_jax(
+        config, state, rule_slot, idx, acquire, threshold, valid, now
+    )
+
+
+def _pallas_max_batch() -> int:
+    from sentinel_tpu.ops.cms_pallas import MAX_BATCH
+
+    return MAX_BATCH
+
+
+@partial(jax.jit, static_argnames=("config",))
+def _param_decide_pallas(
+    config: ParamConfig,
+    state: ParamState,
+    rule_slot: jax.Array,
+    idx: jax.Array,
+    acquire: jax.Array,
+    threshold: jax.Array,
+    valid: jax.Array,
+    now: jax.Array,
+) -> Tuple[ParamState, jax.Array, jax.Array]:
+    """Same contract as :func:`_param_decide_jax`, via the VMEM-resident
+    one-hot-matmul kernel (``ops/cms_pallas.py``). The kernel's plane-major
+    layout ``[B*D, P, W]`` is converted at the boundary."""
+    from sentinel_tpu.ops.cms_pallas import cms_decide_update_pallas
+
+    P, B, D, W = (
+        config.max_param_rules,
+        config.n_buckets,
+        config.depth,
+        config.width,
+    )
+    planes = jnp.transpose(state.counts, (1, 2, 0, 3)).reshape(B * D, P, W)
+    planes, starts, admit, est = cms_decide_update_pallas(
+        planes,
+        state.starts,
+        rule_slot,
+        idx,
+        acquire,
+        threshold,
+        valid,
+        now,
+        P=P,
+        B=B,
+        D=D,
+        W=W,
+        bucket_ms=config.bucket_ms,
+        interpret=jax.default_backend() != "tpu",
+    )
+    counts = jnp.transpose(planes.reshape(B, D, P, W), (2, 0, 1, 3))
+    return ParamState(starts=starts, counts=counts), admit, est
+
+
+@partial(jax.jit, static_argnames=("config",))
+def _param_decide_jax(
     config: ParamConfig,
     state: ParamState,
     rule_slot: jax.Array,  # [N] int32, -1 → no rule

@@ -1,7 +1,7 @@
 """Exclusive segment-prefix-sum over batch order — shared by the flow and
 param kernels (the in-batch "earlier same-key contributions" primitive).
 
-Two implementations (measured on a v5e chip: the [N, N] masked matmul is
+Three implementations (measured on a v5e chip: the [N, N] masked matmul is
 nearly free on the MXU up to N≈8k, sorts win beyond and avoid the [N, N]
 materialization):
 
@@ -9,6 +9,9 @@ materialization):
 - ``sort``: stable argsort + cumsum + per-segment rebase; stable sort
   preserves batch order within a segment, which greedy-admission semantics
   require.
+- ``pallas``: the tiled kernel in ``ops/prefix_pallas.py`` — same math as
+  ``matmul`` but the [N, N] mask is built tile-by-tile in VMEM and never
+  touches HBM (interpret mode off-TPU).
 
 Contributions are float32 (exact for counts < 2^24).
 """
@@ -26,8 +29,20 @@ def segment_prefix_builder(keys: jax.Array, impl: str = "auto"):
     n = keys.shape[0]
     if impl == "auto":
         impl = "matmul" if n <= 8192 else "sort"
-    if impl not in ("matmul", "sort"):
-        raise ValueError(f"unknown prefix_impl {impl!r}; use 'auto'|'matmul'|'sort'")
+    if impl not in ("matmul", "sort", "pallas"):
+        raise ValueError(
+            f"unknown prefix_impl {impl!r}; use 'auto'|'matmul'|'sort'|'pallas'"
+        )
+
+    if impl == "pallas":
+        from sentinel_tpu.ops.prefix_pallas import segment_prefix_pallas
+
+        interpret = jax.default_backend() != "tpu"
+
+        def prefix_pallas(contrib: jax.Array) -> jax.Array:
+            return segment_prefix_pallas(keys, contrib, interpret=interpret)
+
+        return prefix_pallas
 
     if impl == "matmul":
         i = jnp.arange(n)

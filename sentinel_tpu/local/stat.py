@@ -11,10 +11,17 @@ local-mode rates).
 The device engine is the source of truth for batched/cluster decisions; this
 module exists so a single ``entry()`` call costs microseconds, not a device
 round-trip. Parity between the two is enforced by tests.
+
+When the native C++ runtime is built (``native/``, loaded via
+``sentinel_tpu.native``), windows are backed by its lock-free atomics instead
+of numpy — same semantics (parity-tested in ``tests/test_native.py``), no GIL
+hold during window ops. Set ``SENTINEL_TPU_NATIVE=0`` to force the numpy
+backend.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, Optional
 
@@ -43,14 +50,18 @@ class HostWindow:
     Not thread-safe by itself — callers hold the owning node's lock.
     """
 
-    __slots__ = ("bucket_ms", "n_buckets", "interval_ms", "starts", "counts")
+    __slots__ = (
+        "bucket_ms", "n_buckets", "n_channels", "interval_ms", "starts",
+        "counts",
+    )
 
-    def __init__(self, bucket_ms: int, n_buckets: int):
+    def __init__(self, bucket_ms: int, n_buckets: int, n_channels: int = N_CHAN):
         self.bucket_ms = bucket_ms
         self.n_buckets = n_buckets
+        self.n_channels = n_channels
         self.interval_ms = bucket_ms * n_buckets
         self.starts = np.full(n_buckets, NEVER, dtype=np.int64)
-        self.counts = np.zeros((n_buckets, N_CHAN), dtype=np.float64)
+        self.counts = np.zeros((n_buckets, n_channels), dtype=np.float64)
 
     def _roll(self, now: int) -> int:
         idx = (now // self.bucket_ms) % self.n_buckets
@@ -87,13 +98,27 @@ class HostWindow:
         """Minimum average-RT across valid buckets (``MetricBucket.minRt``
         tracks per-bucket min; we approximate with per-bucket rt/success —
         documented drift, same monotonic use in BBR check)."""
+        return self.min_ratio(now, RT, SUCCESS)
+
+    def min_ratio(self, now: int, num_chan: int, den_chan: int) -> float:
         valid = self._valid(now)
-        succ = self.counts[valid, SUCCESS]
-        rt = self.counts[valid, RT]
-        mask = succ > 0
+        den = self.counts[valid, den_chan]
+        num = self.counts[valid, num_chan]
+        mask = den > 0
         if not mask.any():
             return 0.0
-        return float((rt[mask] / succ[mask]).min())
+        return float((num[mask] / den[mask]).min())
+
+    def snapshot(self, now: int) -> list:
+        """Per-channel valid sums in one pass (metric-log path)."""
+        valid = self._valid(now)
+        return [float(x) for x in self.counts[valid].sum(axis=0)]
+
+    def start_at(self, b: int) -> int:
+        return int(self.starts[b])
+
+    def count_at(self, b: int, chan: int) -> float:
+        return float(self.counts[b, chan])
 
 
 class FutureWindow:
@@ -132,6 +157,58 @@ class FutureWindow:
         return 0.0
 
 
+class _NativeFutureWindow:
+    """FutureWindow API over a 1-channel native window."""
+
+    __slots__ = ("_w", "bucket_ms", "n_buckets", "interval_ms")
+
+    def __init__(self, native_window):
+        self._w = native_window
+        self.bucket_ms = native_window.bucket_ms
+        self.n_buckets = native_window.n_buckets
+        self.interval_ms = native_window.interval_ms
+
+    def add(self, future_time: int, n: float) -> None:
+        self._w.add_future(future_time, n)
+
+    def waiting(self, now: int) -> float:
+        return self._w.future_waiting(now)
+
+    def take_matured(self, now: int) -> float:
+        return self._w.take_matured(now)
+
+
+def _native_enabled() -> bool:
+    if os.environ.get("SENTINEL_TPU_NATIVE", "") == "0":
+        return False
+    try:
+        from sentinel_tpu.native import available
+
+        return available()
+    except Exception:
+        return False
+
+
+_NATIVE = _native_enabled()
+
+
+def make_window(bucket_ms: int, n_buckets: int, n_channels: int = N_CHAN):
+    """Window factory: native C++ backend when built, numpy otherwise."""
+    if _NATIVE:
+        from sentinel_tpu.native import NativeWindow
+
+        return NativeWindow(bucket_ms, n_buckets, n_channels)
+    return HostWindow(bucket_ms, n_buckets, n_channels)
+
+
+def make_future_window(bucket_ms: int, n_buckets: int):
+    if _NATIVE:
+        from sentinel_tpu.native import NativeWindow
+
+        return _NativeFutureWindow(NativeWindow(bucket_ms, n_buckets, 1))
+    return FutureWindow(bucket_ms, n_buckets)
+
+
 DEFAULT_OCCUPY_TIMEOUT_MS = 500  # OccupyTimeoutProperty default
 
 
@@ -144,9 +221,9 @@ class StatisticNode:
 
     def __init__(self, sec_buckets: int = 2, sec_interval_ms: int = 1000):
         self._lock = threading.RLock()
-        self.sec = HostWindow(sec_interval_ms // sec_buckets, sec_buckets)
-        self.minute = HostWindow(1000, 60)
-        self.future = FutureWindow(self.sec.bucket_ms, sec_buckets)
+        self.sec = make_window(sec_interval_ms // sec_buckets, sec_buckets)
+        self.minute = make_window(1000, 60)
+        self.future = make_future_window(self.sec.bucket_ms, sec_buckets)
         self.cur_thread_num = 0
 
     # -- write path ---------------------------------------------------------
@@ -248,7 +325,7 @@ class StatisticNode:
     def min_rt(self, now: Optional[int] = None) -> float:
         now = self._now(now)
         with self._lock:
-            return self.sec.min_rt(now)
+            return self.sec.min_ratio(now, RT, SUCCESS)
 
     def previous_pass_qps(self, now: Optional[int] = None) -> float:
         now = self._now(now)
@@ -290,7 +367,7 @@ class StatisticNode:
                 for b in range(self.sec.n_buckets):
                     s = self.starts_at(b)
                     if s != NEVER and 0 <= now - s < interval and s <= horizon:
-                        expired += self.sec.counts[b, PASS]
+                        expired += self.sec.count_at(b, PASS)
                 cur_pass = self.sec.sum(now, PASS)
                 occupied = self.future.waiting(now)
                 if cur_pass - expired + occupied + acquire <= threshold:
@@ -299,7 +376,7 @@ class StatisticNode:
             return DEFAULT_OCCUPY_TIMEOUT_MS + 1
 
     def starts_at(self, b: int) -> int:
-        return int(self.sec.starts[b])
+        return int(self.sec.start_at(b))
 
 
 class DefaultNode(StatisticNode):

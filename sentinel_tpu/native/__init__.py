@@ -1,0 +1,27 @@
+"""ctypes bindings for the native host runtime (``native/src/``).
+
+The native library re-implements the host-side per-call hot paths — sliding
+windows, token buckets, leaky-bucket pacers — as lock-free C++ (the analog
+of the reference's LongAdder/CAS machinery; see
+``native/src/sentinel_native.cpp``). It is optional: every consumer has a
+pure-Python/numpy fallback with identical semantics, enforced by parity
+tests (``tests/test_native.py``).
+
+Build with ``make -C native`` or ``python -m sentinel_tpu.native.build``.
+"""
+
+from sentinel_tpu.native.lib import (
+    NativePacerArray,
+    NativeTokenBuckets,
+    NativeWindow,
+    available,
+    load,
+)
+
+__all__ = [
+    "available",
+    "load",
+    "NativeWindow",
+    "NativeTokenBuckets",
+    "NativePacerArray",
+]

@@ -1,0 +1,44 @@
+"""Build the native runtime: ``python -m sentinel_tpu.native.build``.
+
+Compiles ``native/src/sentinel_native.cpp`` into
+``sentinel_tpu/native/_sentinel_native.so`` with the ambient C++ compiler.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+SOURCE = os.path.join(_REPO, "native", "src", "sentinel_native.cpp")
+OUTPUT = os.path.join(_HERE, "_sentinel_native.so")
+
+
+def build(verbose: bool = True) -> str:
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found (need g++ or c++ on PATH)")
+    cmd = [
+        cxx,
+        "-O3",
+        "-std=c++17",
+        "-fPIC",
+        "-Wall",
+        "-Wextra",
+        "-shared",
+        "-pthread",
+        "-o",
+        OUTPUT,
+        SOURCE,
+    ]
+    if verbose:
+        print("+", " ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True)
+    return OUTPUT
+
+
+if __name__ == "__main__":
+    print(build())

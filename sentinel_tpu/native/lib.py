@@ -1,0 +1,222 @@
+"""ctypes loader + thin object wrappers over the native C API.
+
+ctypes releases the GIL around every call, so under free-threaded Python the
+native windows scale across threads the way the reference's LongAdders do —
+the Python fallbacks serialize on the owning node's lock instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_sentinel_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    P, I32, I64, F64 = (
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.c_double,
+    )
+    sig = {
+        "sn_window_create": ([I32, I32, I32], P),
+        "sn_window_destroy": ([P], None),
+        "sn_window_add": ([P, I64, I32, F64], None),
+        "sn_window_sum": ([P, I64, I32], F64),
+        "sn_window_snapshot": ([P, I64, ctypes.POINTER(F64)], None),
+        "sn_window_prev_bucket": ([P, I64, I32], F64),
+        "sn_window_min_ratio": ([P, I64, I32, I32], F64),
+        "sn_window_start_at": ([P, I32], I64),
+        "sn_window_count_at": ([P, I32, I32], F64),
+        "sn_window_add_future": ([P, I64, I32, F64], None),
+        "sn_window_future_waiting": ([P, I64, I32], F64),
+        "sn_window_take_matured": ([P, I64, I32], F64),
+        "sn_tb_create": ([I32], P),
+        "sn_tb_destroy": ([P], None),
+        "sn_tb_reset": ([P, I32], None),
+        "sn_tb_try_acquire": ([P, I32, I64, I32, F64, F64, I64], I32),
+        "sn_pacer_create": ([I32], P),
+        "sn_pacer_destroy": ([P], None),
+        "sn_pacer_reset": ([P, I32], None),
+        "sn_pacer_try_pass": ([P, I32, I64, I32, F64, I64], I64),
+    }
+    for name, (argtypes, restype) in sig.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (once) the native library; None if not built or unloadable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            _load_failed = True
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(_SO_PATH))
+        except OSError:
+            _load_failed = True
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeWindow:
+    """Sliding window backed by the native lib — drop-in for
+    ``local.stat.HostWindow`` plus the future/occupy ops."""
+
+    __slots__ = ("_lib", "_h", "bucket_ms", "n_buckets", "n_channels",
+                 "interval_ms")
+
+    def __init__(self, bucket_ms: int, n_buckets: int, n_channels: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        self._lib = lib
+        self._h = lib.sn_window_create(bucket_ms, n_buckets, n_channels)
+        if not self._h:
+            raise MemoryError("sn_window_create failed")
+        self.bucket_ms = bucket_ms
+        self.n_buckets = n_buckets
+        self.n_channels = n_channels
+        self.interval_ms = bucket_ms * n_buckets
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.sn_window_destroy(h)
+            self._h = None
+
+    def add(self, now: int, chan: int, n: float = 1.0) -> None:
+        self._lib.sn_window_add(self._h, now, chan, n)
+
+    def sum(self, now: int, chan: int) -> float:
+        return self._lib.sn_window_sum(self._h, now, chan)
+
+    def qps(self, now: int, chan: int) -> float:
+        return self.sum(now, chan) * 1000.0 / self.interval_ms
+
+    def snapshot(self, now: int) -> list:
+        out = (ctypes.c_double * self.n_channels)()
+        self._lib.sn_window_snapshot(self._h, now, out)
+        return list(out)
+
+    def previous_bucket(self, now: int, chan: int) -> float:
+        return self._lib.sn_window_prev_bucket(self._h, now, chan)
+
+    def min_ratio(self, now: int, num_chan: int, den_chan: int) -> float:
+        return self._lib.sn_window_min_ratio(self._h, now, num_chan, den_chan)
+
+    def start_at(self, b: int) -> int:
+        return self._lib.sn_window_start_at(self._h, b)
+
+    def count_at(self, b: int, chan: int) -> float:
+        return self._lib.sn_window_count_at(self._h, b, chan)
+
+    # future/occupy ops (FutureWindow analog; use a dedicated instance)
+    def add_future(self, future_time: int, n: float, chan: int = 0) -> None:
+        self._lib.sn_window_add_future(self._h, future_time, chan, n)
+
+    def future_waiting(self, now: int, chan: int = 0) -> float:
+        return self._lib.sn_window_future_waiting(self._h, now, chan)
+
+    def take_matured(self, now: int, chan: int = 0) -> float:
+        return self._lib.sn_window_take_matured(self._h, now, chan)
+
+
+class NativeTokenBuckets:
+    """Array of token buckets (hot-param local QPS mode)."""
+
+    __slots__ = ("_lib", "_h", "n_slots")
+
+    def __init__(self, n_slots: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        self._lib = lib
+        self._h = lib.sn_tb_create(n_slots)
+        if not self._h:
+            raise MemoryError("sn_tb_create failed")
+        self.n_slots = n_slots
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.sn_tb_destroy(h)
+            self._h = None
+
+    def reset(self, slot: int) -> None:
+        self._lib.sn_tb_reset(self._h, slot)
+
+    def try_acquire(
+        self,
+        slot: int,
+        now: int,
+        acquire: int,
+        count: float,
+        burst: float,
+        interval_ms: int,
+    ) -> bool:
+        return bool(
+            self._lib.sn_tb_try_acquire(
+                self._h, slot, now, acquire, count, burst, interval_ms
+            )
+        )
+
+
+class NativePacerArray:
+    """Array of leaky-bucket pacers (RateLimiter behavior)."""
+
+    __slots__ = ("_lib", "_h", "n_slots")
+
+    def __init__(self, n_slots: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        self._lib = lib
+        self._h = lib.sn_pacer_create(n_slots)
+        if not self._h:
+            raise MemoryError("sn_pacer_create failed")
+        self.n_slots = n_slots
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.sn_pacer_destroy(h)
+            self._h = None
+
+    def reset(self, slot: int) -> None:
+        self._lib.sn_pacer_reset(self._h, slot)
+
+    def try_pass(
+        self,
+        slot: int,
+        now: int,
+        acquire: int,
+        count_per_sec: float,
+        max_queue_ms: int,
+    ) -> int:
+        """wait-ms to sleep (0 = immediate) or -1 = block."""
+        return int(
+            self._lib.sn_pacer_try_pass(
+                self._h, slot, now, acquire, count_per_sec, max_queue_ms
+            )
+        )

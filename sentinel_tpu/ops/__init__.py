@@ -1,0 +1,21 @@
+"""Pallas TPU kernels for the engine's hot ops.
+
+These are the "native" compute components of the framework (SURVEY.md §2:
+the reference is 100% Java, so its JVM-concurrency hot paths — LongAdder
+arrays, CAS window loops — map to device kernels here, not to C/C++):
+
+- :mod:`sentinel_tpu.ops.prefix_pallas` — tiled in-batch segment prefix sums
+  (the admission primitive) that never materializes the [N, N] mask in HBM.
+- :mod:`sentinel_tpu.ops.cms_pallas` — the count-min-sketch decide+update
+  kernel: whole sketch resident in VMEM, gathers/scatters expressed as
+  one-hot MXU matmuls.
+
+Every kernel has a pure-jax reference implementation elsewhere in the tree
+(`engine/prefix.py`, `engine/param.py`); the kernels are selected on TPU
+backends and fall back to interpret mode in tests.
+"""
+
+from sentinel_tpu.ops.prefix_pallas import segment_prefix_pallas
+from sentinel_tpu.ops.cms_pallas import cms_decide_update_pallas
+
+__all__ = ["segment_prefix_pallas", "cms_decide_update_pallas"]

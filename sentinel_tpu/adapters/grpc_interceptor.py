@@ -17,6 +17,7 @@ except ImportError:  # pragma: no cover - grpcio baked into this image
 
 from sentinel_tpu.local import BlockException, EntryType
 from sentinel_tpu.local import context as _ctx
+from sentinel_tpu.local.sph import async_entry as _async_entry
 from sentinel_tpu.local.sph import entry as _entry
 
 BLOCK_MSG = "Blocked by Sentinel (flow limiting)"
@@ -103,12 +104,14 @@ if grpc is not None:
     ):
         """Guard outbound calls; a block raises ``BlockException`` to the
         caller before any network I/O (the reference fails the call with
-        UNAVAILABLE — raising keeps the local API uniform). The entry stays
-        open until the RPC completes (done callback), so future-style calls
-        remain async and RT/error stats cover the real call duration."""
+        UNAVAILABLE — raising keeps the local API uniform). The guard is a
+        detached ``async_entry``: the done-callback may fire on a channel
+        thread, out of order with other in-flight RPCs from the same caller,
+        without corrupting the caller's entry stack — RT/error stats still
+        cover the real call duration."""
 
         def _intercept(self, continuation, client_call_details, request):
-            e = _entry(client_call_details.method, EntryType.OUT)
+            e = _async_entry(client_call_details.method, EntryType.OUT)
             try:
                 call = continuation(client_call_details, request)
             except BaseException as err:

@@ -1,0 +1,104 @@
+"""Shared embedded-HTTP-server scaffolding.
+
+Both control-plane surfaces — the command center (``transport/command.py``)
+and the dashboard (``dashboard/server.py``) — are tiny threaded HTTP
+services; this module owns the one copy of the handler/lifecycle plumbing
+(stdlib ``ThreadingHTTPServer``, port-0 resolution, quiet logging).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from sentinel_tpu.core.log import record_log
+
+# (status code, body text, content type)
+Response = Tuple[int, str, str]
+
+# (method, path-without-leading-slash, query params, body) -> Response
+Router = Callable[[str, str, dict, str], Response]
+
+MAX_BODY_BYTES = 4 * 1024 * 1024  # rule payloads are small; cap abuse
+
+
+def json_response(code: int, text: str) -> Response:
+    return (code, text, "application/json; charset=utf-8")
+
+
+def html_response(code: int, text: str) -> Response:
+    return (code, text, "text/html; charset=utf-8")
+
+
+class HttpService:
+    """A routed, threaded HTTP server with start/stop lifecycle."""
+
+    def __init__(self, router: Router, host: str, port: int, name: str):
+        self.router = router
+        self.host = host
+        self.port = port
+        self.name = name
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HttpService":
+        router = self.router
+        name = self.name
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "SentinelTPU"
+
+            def _dispatch(self, method: str, body: str) -> None:
+                parsed = urlparse(self.path)
+                params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                try:
+                    code, text, ctype = router(
+                        method, parsed.path.strip("/"), params, body
+                    )
+                except Exception as e:
+                    record_log.exception("%s request failed", name)
+                    code, text, ctype = json_response(
+                        500, json.dumps({"error": str(e)})
+                    )
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET", "")
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length") or 0)
+                if length > MAX_BODY_BYTES:
+                    self.send_response(413)
+                    self.end_headers()
+                    return
+                body = self.rfile.read(length).decode() if length else ""
+                self._dispatch("POST", body)
+
+            def log_message(self, fmt, *args):  # record_log has the failures
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name=self.name
+        )
+        self._thread.start()
+        record_log.info("%s on %s:%d", self.name, self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -48,10 +48,15 @@ class ApiClient:
     # -- metrics (MetricFetcher's transport) --------------------------------
     def fetch_metrics(
         self, machine: MachineInfo, start_ms: int, end_ms: int
-    ) -> List[MetricNode]:
+    ) -> Optional[List[MetricNode]]:
+        """Metric lines for the window, or ``None`` on transport failure —
+        the fetcher must not advance a machine's window past data it never
+        received."""
         text = self._get(
             machine, "metric", {"startTime": start_ms, "endTime": end_ms}
         )
+        if text is None:
+            return None
         if not text:
             return []
         nodes = []

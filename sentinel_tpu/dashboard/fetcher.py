@@ -34,45 +34,51 @@ class MetricFetcher:
         self.repository = repository
         self.client = client or ApiClient()
         self.interval_s = interval_s
-        self._last_fetch: Dict[str, int] = {}  # app → end of last window
+        # (app, machine-key) → end of that machine's last successful window.
+        # Per-machine windows: one machine timing out must not advance the
+        # others' (or its own) window past data not yet pulled.
+        self._last_fetch: Dict[tuple, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def fetch_once(self, app: str) -> int:
-        """Pull one window for ``app``; returns the number of entries stored."""
+        """Pull each machine's pending window for ``app``; returns the number
+        of entries merged into the repository.
+
+        Cross-machine sums by (resource, second) happen in the repository
+        (``save_all(..., merge=True)``): each machine's lines are fetched
+        exactly once, so merge-adds are safe even when machines are on
+        different catch-up windows.
+        """
         now = _clock.now_ms()
         end = now - FETCH_DELAY_MS
-        start = self._last_fetch.get(app, end - 5_000)
-        if end <= start:
-            return 0
-        start = max(start, end - MAX_WINDOW_MS)
-        # aggregate by (resource, second) across machines (MetricFetcher
-        # dedupes identical lines and sums across the cluster)
-        agg: Dict[tuple, MetricEntry] = {}
+        stored = 0
         for machine in self.apps.healthy_machines(app):
-            for node in self.client.fetch_metrics(machine, start, end):
-                key = (node.resource, node.timestamp_ms)
-                entry = agg.get(key)
-                if entry is None:
-                    agg[key] = MetricEntry(
-                        app=app,
-                        resource=node.resource,
-                        timestamp_ms=node.timestamp_ms,
-                        pass_qps=node.pass_qps,
-                        block_qps=node.block_qps,
-                        success_qps=node.success_qps,
-                        exception_qps=node.exception_qps,
-                        rt=node.rt,
-                    )
-                else:
-                    entry.pass_qps += node.pass_qps
-                    entry.block_qps += node.block_qps
-                    entry.success_qps += node.success_qps
-                    entry.exception_qps += node.exception_qps
-                    entry.rt = max(entry.rt, node.rt)
-        self.repository.save_all(list(agg.values()))
-        self._last_fetch[app] = end
-        return len(agg)
+            key = (app, machine.key)
+            start = self._last_fetch.get(key, end - 5_000)
+            if end <= start:
+                continue
+            start = max(start, end - MAX_WINDOW_MS)
+            nodes = self.client.fetch_metrics(machine, start, end)
+            if nodes is None:
+                continue  # transport failure: retry the same window next tick
+            entries = [
+                MetricEntry(
+                    app=app,
+                    resource=node.resource,
+                    timestamp_ms=node.timestamp_ms,
+                    pass_qps=node.pass_qps,
+                    block_qps=node.block_qps,
+                    success_qps=node.success_qps,
+                    exception_qps=node.exception_qps,
+                    rt=node.rt,
+                )
+                for node in nodes
+            ]
+            self.repository.save_all(entries, merge=True)
+            self._last_fetch[key] = end
+            stored += len(entries)
+        return stored
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):

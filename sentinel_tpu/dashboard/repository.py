@@ -45,25 +45,47 @@ class InMemoryMetricsRepository:
         self.retention_ms = retention_ms
         # (app, resource) → {timestamp → MetricEntry}
         self._store: Dict[Tuple[str, str], Dict[int, MetricEntry]] = {}
+        self._last_sweep_ms = 0
 
-    def save(self, entry: MetricEntry) -> None:
+    def save(self, entry: MetricEntry, merge: bool = False) -> None:
         with self._lock:
             series = self._store.setdefault((entry.app, entry.resource), {})
-            series[entry.timestamp_ms] = entry
-            self._evict_locked(series)
+            existing = series.get(entry.timestamp_ms) if merge else None
+            if existing is not None:
+                existing.pass_qps += entry.pass_qps
+                existing.block_qps += entry.block_qps
+                existing.success_qps += entry.success_qps
+                existing.exception_qps += entry.exception_qps
+                existing.rt = max(existing.rt, entry.rt)
+            else:
+                series[entry.timestamp_ms] = entry
+            self._sweep_locked()
 
-    def save_all(self, entries: List[MetricEntry]) -> None:
+    def save_all(self, entries: List[MetricEntry], merge: bool = False) -> None:
         for e in entries:
-            self.save(e)
+            self.save(e, merge=merge)
 
-    def _evict_locked(self, series: Dict[int, MetricEntry]) -> None:
-        horizon = _clock.now_ms() - self.retention_ms
-        for ts in [t for t in series if t < horizon]:
-            del series[ts]
+    def _sweep_locked(self) -> None:
+        """Evict past-retention entries across *all* series (at most once per
+        second): idle series must age out too, or per-URL resource cardinality
+        grows the store without bound."""
+        now = _clock.now_ms()
+        if now - self._last_sweep_ms < 1_000:
+            return
+        self._last_sweep_ms = now
+        horizon = now - self.retention_ms
+        for key in list(self._store):
+            series = self._store[key]
+            for ts in [t for t in series if t < horizon]:
+                del series[ts]
+            if not series:
+                del self._store[key]
 
     def query(
         self, app: str, resource: str, start_ms: int, end_ms: int
     ) -> List[MetricEntry]:
+        horizon = _clock.now_ms() - self.retention_ms
+        start_ms = max(start_ms, horizon)  # never serve past-retention data
         with self._lock:
             series = self._store.get((app, resource), {})
             return sorted(
@@ -73,12 +95,13 @@ class InMemoryMetricsRepository:
 
     def resources_of_app(self, app: str) -> List[str]:
         """Resources sorted by recent pass+block volume (the reference sorts
-        the sidebar by last-minute QPS)."""
+        the sidebar by last-minute QPS); past-retention series are excluded."""
         now = _clock.now_ms()
+        horizon = now - self.retention_ms
         with self._lock:
             volume: Dict[str, float] = {}
             for (a, resource), series in self._store.items():
-                if a != app:
+                if a != app or not any(t >= horizon for t in series):
                     continue
                 v = sum(
                     e.pass_qps + e.block_qps

@@ -10,13 +10,14 @@ threading HTTP server — the console is an ops tool, not a hot path.
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-from urllib.parse import parse_qs, urlparse
 
 from sentinel_tpu.core import clock as _clock
-from sentinel_tpu.core.log import record_log
+from sentinel_tpu.core.httpd import (
+    HttpService,
+    Response,
+    html_response,
+    json_response,
+)
 from sentinel_tpu.dashboard.api_client import ApiClient
 from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
 from sentinel_tpu.dashboard.fetcher import MetricFetcher
@@ -92,12 +93,27 @@ class DashboardServer:
         self.fetcher = MetricFetcher(
             self.apps, self.repository, self.client, fetch_interval_s
         )
-        self.host = host
-        self.port = port
-        self._server: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._service = HttpService(
+            self._respond, host, port, name="sentinel-dashboard"
+        )
+
+    @property
+    def host(self) -> str:
+        return self._service.host
+
+    @property
+    def port(self) -> int:
+        return self._service.port
 
     # -- request handling ----------------------------------------------------
+    def _respond(self, method: str, path: str, params: dict, body: str) -> Response:
+        result = self._route(method, path, params, body)
+        if result is None:
+            return json_response(404, json.dumps({"error": "not found"}))
+        if isinstance(result, str):
+            return html_response(200, result)
+        return json_response(200, json.dumps(result))
+
     def _route(self, method: str, path: str, params: dict, body: str):
         if method == "POST" and path == "registry/machine":
             data = json.loads(body) if body else dict(params)
@@ -150,65 +166,10 @@ class DashboardServer:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "DashboardServer":
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            server_version = "SentinelTPUDashboard"
-
-            def _dispatch(self, method: str, body: str) -> None:
-                parsed = urlparse(self.path)
-                params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-                try:
-                    result = outer._route(
-                        method, parsed.path.strip("/"), params, body
-                    )
-                except Exception as e:
-                    record_log.exception("dashboard request failed")
-                    self._reply(500, json.dumps({"error": str(e)}))
-                    return
-                if result is None:
-                    self._reply(404, json.dumps({"error": "not found"}))
-                elif isinstance(result, str):
-                    self._reply(200, result, "text/html; charset=utf-8")
-                else:
-                    self._reply(200, json.dumps(result))
-
-            def _reply(self, code, text, ctype="application/json; charset=utf-8"):
-                data = text.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def do_GET(self):  # noqa: N802
-                self._dispatch("GET", "")
-
-            def do_POST(self):  # noqa: N802
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length).decode() if length else ""
-                self._dispatch("POST", body)
-
-            def log_message(self, fmt, *args):
-                pass
-
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="sentinel-dashboard",
-        )
-        self._thread.start()
+        self._service.start()
         self.fetcher.start()
-        record_log.info("dashboard on %s:%d", self.host, self.port)
         return self
 
     def stop(self) -> None:
         self.fetcher.stop()
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        self._service.stop()

@@ -94,7 +94,14 @@ class Entry:
         ctx.cur_entry = self.parent
         if self.parent is not None:
             self.parent.child = None
-        if ctx.cur_entry is None and not isinstance(ctx, NullContext):
+        # Clear the ambient context only when this thread/task actually holds
+        # it — a detached (async) entry may complete on a foreign thread whose
+        # own context must not be torn down.
+        if (
+            ctx.cur_entry is None
+            and not isinstance(ctx, NullContext)
+            and ctx_mod.get_context() is ctx
+        ):
             ctx_mod.exit()
 
     # -- context manager -----------------------------------------------------
@@ -185,6 +192,41 @@ def entry(
             fallback()
     """
     return _sph.entry(name, entry_type, count, args, prioritized)
+
+
+def async_entry(
+    name: str,
+    entry_type: EntryType = EntryType.OUT,
+    count: int = 1,
+    args: tuple = (),
+    prioritized: bool = False,
+) -> Entry:
+    """Guard an operation whose completion happens elsewhere — another
+    thread, a done-callback, or a different asyncio task
+    (``SphU.asyncEntry`` / ``AsyncEntry.java`` analog).
+
+    The verdict is taken against the caller's context as usual, then the
+    entry is detached into a private context snapshot: the caller's entry
+    stack is restored immediately, and ``exit()``/``trace()`` may be called
+    from any thread without corrupting concurrent entries. Statistics
+    (RT, concurrency, exceptions) still cover the real operation duration.
+    """
+    e = _sph.entry(name, entry_type, count, args, prioritized)
+    ctx = e.context
+    if isinstance(ctx, NullContext):
+        return e
+    async_ctx = Context(ctx.name, ctx.entrance_node, ctx.origin)
+    async_ctx.async_mode = True
+    async_ctx.cur_entry = e
+    # pop from the caller's stack (AsyncEntry.cleanCurrentEntryInLocal)
+    ctx.cur_entry = e.parent
+    if e.parent is not None:
+        e.parent.child = None
+    e.parent = None
+    e.context = async_ctx
+    # the caller's context is left in place (AsyncEntry.cleanCurrentEntryInLocal
+    # only pops the entry) — a later sync entry's exit clears an empty one
+    return e
 
 
 def try_entry(name: str, entry_type: EntryType = EntryType.OUT, count: int = 1,

@@ -10,11 +10,9 @@ registry, so extensions add endpoints exactly like the reference's SPI.
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
-from urllib.parse import parse_qs, urlparse
 
+from sentinel_tpu.core.httpd import HttpService, Response, json_response
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.core.registry import registry
 
@@ -45,49 +43,20 @@ def list_commands() -> Dict[str, str]:
     return {name: desc for name, (desc, _) in _commands.items()}
 
 
-class _Handler(BaseHTTPRequestHandler):
-    server_version = "SentinelTPU"
-
-    def _dispatch(self, body: str) -> None:
-        parsed = urlparse(self.path)
-        name = parsed.path.strip("/")
-        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-        if name == "api":
-            self._reply(200, json.dumps(list_commands()))
-            return
-        handler = get_command(name)
-        if handler is None:
-            self._reply(404, f"Unknown command `{name}`; see /api")
-            return
-        try:
-            result = handler(params, body)
-        except Exception as e:
-            record_log.exception("command %s failed", name)
-            self._reply(500, f"command failed: {e}")
-            return
-        if isinstance(result, (dict, list)):
-            self._reply(200, json.dumps(result))
-        else:
-            self._reply(200, str(result))
-
-    def _reply(self, code: int, text: str) -> None:
-        data = text.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json; charset=utf-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def do_GET(self):  # noqa: N802
-        self._dispatch("")
-
-    def do_POST(self):  # noqa: N802
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length).decode() if length else ""
-        self._dispatch(body)
-
-    def log_message(self, fmt, *args):  # quiet; record_log has the failures
-        pass
+def _route(method: str, name: str, params: Dict[str, str], body: str) -> Response:
+    if name == "api":
+        return json_response(200, json.dumps(list_commands()))
+    handler = get_command(name)
+    if handler is None:
+        return json_response(404, f"Unknown command `{name}`; see /api")
+    try:
+        result = handler(params, body)
+    except Exception as e:
+        record_log.exception("command %s failed", name)
+        return json_response(500, f"command failed: {e}")
+    if isinstance(result, (dict, list)):
+        return json_response(200, json.dumps(result))
+    return json_response(200, str(result))
 
 
 class CommandCenter:
@@ -97,32 +66,27 @@ class CommandCenter:
         # (csp.sentinel.api.port.binding, the reference's key for this)
         from sentinel_tpu.core.config import SentinelConfig
 
-        self.host = host or SentinelConfig.get(
+        host = host or SentinelConfig.get(
             "csp.sentinel.api.port.binding"
         ) or "127.0.0.1"
-        self.port = port
-        self._server: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._service = HttpService(
+            _route, host, port, name="sentinel-command-center"
+        )
+
+    @property
+    def host(self) -> str:
+        return self._service.host
+
+    @property
+    def port(self) -> int:
+        return self._service.port
 
     def start(self) -> "CommandCenter":
         # make sure the default handlers are registered
         from sentinel_tpu.transport import handlers  # noqa: F401
 
-        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="sentinel-command-center",
-        )
-        self._thread.start()
-        record_log.info("command center on %s:%d", self.host, self.port)
+        self._service.start()
         return self
 
     def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-            self._thread = None
+        self._service.stop()

@@ -1,0 +1,73 @@
+"""Detached (async) entries: out-of-order and cross-thread completion.
+
+reference: ``SphU.asyncEntry`` / ``AsyncEntry.java`` — the guard verdict is
+taken synchronously, completion happens elsewhere.
+"""
+
+import threading
+
+import pytest
+
+from sentinel_tpu.local import context as ctx_mod
+from sentinel_tpu.local.chain import get_cluster_node, reset_cluster_nodes_for_tests
+from sentinel_tpu.local.flow import FlowRuleManager
+from sentinel_tpu.local.sph import async_entry, entry, sph
+
+
+@pytest.fixture(autouse=True)
+def clean(manual_clock):
+    manual_clock.set_ms(10_000)
+    yield
+    FlowRuleManager.reset_for_tests()
+    reset_cluster_nodes_for_tests()
+    sph().reset_for_tests()
+    ctx_mod.reset_for_tests()
+
+
+class TestAsyncEntry:
+    def test_out_of_order_completion_keeps_stats_straight(self, manual_clock):
+        a = async_entry("rpc-a")
+        b = async_entry("rpc-b")
+        # caller's stack is clean: a plain sync entry nests normally
+        with entry("sync-work"):
+            pass
+        node_a = get_cluster_node("rpc-a")
+        node_b = get_cluster_node("rpc-b")
+        assert node_a.cur_thread_num == 1 and node_b.cur_thread_num == 1
+        manual_clock.sleep(50)
+        a.exit()  # A completes first — B must stay live
+        assert node_a.cur_thread_num == 0
+        assert node_b.cur_thread_num == 1
+        assert not b._exited
+        manual_clock.sleep(100)
+        b.exit()
+        assert node_b.cur_thread_num == 0
+        # RT covers each call's real duration
+        assert node_a.avg_rt() == pytest.approx(50.0)
+        assert node_b.avg_rt() == pytest.approx(150.0)
+
+    def test_foreign_thread_completion_preserves_caller_context(self):
+        ctx_mod.enter("caller_ctx")
+        e = async_entry("bg-op")
+        marker = {}
+
+        def completer():
+            ctx_mod.enter("worker_ctx")
+            e.exit()
+            # the worker's own context must survive the foreign exit
+            marker["worker_ctx"] = ctx_mod.get_context().name
+            ctx_mod.exit()
+
+        t = threading.Thread(target=completer)
+        t.start()
+        t.join()
+        assert marker["worker_ctx"] == "worker_ctx"
+        assert ctx_mod.get_context().name == "caller_ctx"
+        ctx_mod.exit()
+
+    def test_error_traced_on_late_completion(self):
+        e = async_entry("failing-rpc")
+        e.trace(RuntimeError("downstream died"))
+        e.exit()
+        node = get_cluster_node("failing-rpc")
+        assert node.exception_qps() > 0

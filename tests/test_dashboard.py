@@ -90,10 +90,52 @@ class TestFetcher:
 
         monkeypatch.setattr(fetcher.client, "fetch_metrics", fake_fetch)
         stored = fetcher.fetch_once("svc")
-        assert stored == 1
+        assert stored == 2  # one line per machine, merged in the repository
         entry = repo.query("svc", "res", 0, 2**61)[0]
         assert entry.pass_qps == 10  # summed across the two machines
         assert entry.block_qps == 2
+
+    def test_failed_machine_retries_same_window(self, manual_clock, monkeypatch):
+        """A machine whose fetch fails must not have its window advanced —
+        the data is re-requested next tick (per-machine last-fetch)."""
+        from sentinel_tpu.metrics.log import MetricNode
+
+        apps = AppManagement()
+        repo = InMemoryMetricsRepository()
+        fetcher = MetricFetcher(apps, repo)
+        apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=1))
+        apps.register(MachineInfo(app="svc", ip="10.0.0.2", port=1))
+        ts = manual_clock.now_ms() // 1000 * 1000 - 3000
+        fail_m2 = True
+
+        def fake_fetch(machine, start, end):
+            if machine.ip == "10.0.0.2" and fail_m2:
+                return None  # transport failure
+            if start <= ts <= end:
+                return [MetricNode(timestamp_ms=ts, resource="res", pass_qps=5)]
+            return []
+
+        monkeypatch.setattr(fetcher.client, "fetch_metrics", fake_fetch)
+        fetcher.fetch_once("svc")
+        assert repo.query("svc", "res", 0, 2**61)[0].pass_qps == 5
+        manual_clock.sleep(500)
+        fail_m2 = False
+        fetcher.fetch_once("svc")  # m2 catches up over its original window
+        assert repo.query("svc", "res", 0, 2**61)[0].pass_qps == 10
+
+    def test_idle_series_evicted(self, manual_clock):
+        """Series that stop receiving traffic age out of the store (and the
+        sidebar) instead of leaking forever."""
+        from sentinel_tpu.dashboard.repository import MetricEntry
+
+        repo = InMemoryMetricsRepository(retention_ms=10_000)
+        manual_clock.set_ms(1_000)
+        repo.save(MetricEntry("svc", "dead-url", 1_000, pass_qps=5))
+        manual_clock.set_ms(30_000)
+        assert repo.query("svc", "dead-url", 0, 2**61) == []  # past retention
+        repo.save(MetricEntry("svc", "live", 30_000, pass_qps=1))
+        assert ("svc", "dead-url") not in repo._store  # swept on save
+        assert repo.resources_of_app("svc") == ["live"]
 
     def test_window_advances(self, manual_clock, monkeypatch):
         apps = AppManagement()

@@ -53,10 +53,22 @@ class MetricFetcher:
         now = _clock.now_ms()
         end = now - FETCH_DELAY_MS
         stored = 0
-        for machine in self.apps.healthy_machines(app):
+        healthy = self.apps.healthy_machines(app)
+        # prune cursors for machine incarnations that left discovery entirely
+        # (pods restarting on ephemeral ports would otherwise leak one key
+        # per incarnation); still-registered-but-dead machines keep theirs
+        registered = {(app, m.key) for m in self.apps.machines(app)}
+        for key in [
+            k for k in self._last_fetch if k[0] == app and k not in registered
+        ]:
+            del self._last_fetch[key]
+        for machine in healthy:
             key = (app, machine.key)
-            start = self._last_fetch.get(key, end - 5_000)
-            if end <= start:
+            # MetricSearcher windows are inclusive on both ends, so the next
+            # window starts one ms after the last — a second-aligned line at
+            # exactly the boundary must not be fetched (and merge-summed) twice
+            start = self._last_fetch.get(key, end - 5_000 - 1) + 1
+            if end < start:
                 continue
             start = max(start, end - MAX_WINDOW_MS)
             nodes = self.client.fetch_metrics(machine, start, end)

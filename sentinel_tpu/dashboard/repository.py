@@ -7,7 +7,7 @@ Analog of ``repository/metric/InMemoryMetricsRepository.java:40-63``
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Tuple
 
 from sentinel_tpu.core import clock as _clock
@@ -88,8 +88,14 @@ class InMemoryMetricsRepository:
         start_ms = max(start_ms, horizon)  # never serve past-retention data
         with self._lock:
             series = self._store.get((app, resource), {})
+            # copies: merge-saves mutate stored entries in place, and readers
+            # serialize outside the lock
             return sorted(
-                (e for ts, e in series.items() if start_ms <= ts <= end_ms),
+                (
+                    replace(e)
+                    for ts, e in series.items()
+                    if start_ms <= ts <= end_ms
+                ),
                 key=lambda e: e.timestamp_ms,
             )
 

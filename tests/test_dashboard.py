@@ -152,7 +152,10 @@ class TestFetcher:
         fetcher.fetch_once("svc")
         manual_clock.sleep(1000)
         fetcher.fetch_once("svc")
-        assert windows[1][0] == windows[0][1]  # contiguous, no gap/overlap
+        # contiguous with no overlap: search windows are inclusive both ends,
+        # so the next must start 1ms after the last ended (a second-aligned
+        # line at the boundary would otherwise merge-sum twice)
+        assert windows[1][0] == windows[0][1] + 1
 
 
 def _get(port: int, path: str):

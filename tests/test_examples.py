@@ -1,0 +1,47 @@
+"""Smoke-run the deterministic (manual-clock) examples as subprocesses —
+they are user-facing documentation and must keep working."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_EXAMPLES = [
+    "warm_up.py",
+    "circuit_breaker.py",
+    "param_flow.py",
+    "system_guard.py",
+    "async_entry_demo.py",
+]
+
+
+@pytest.mark.parametrize("script", FAST_EXAMPLES)
+def test_example_runs(script):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip(), "example produced no output"
+
+
+def test_warm_up_shows_ramp():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "warm_up.py")],
+        capture_output=True, text=True, timeout=120, env=env,
+    ).stdout
+    rates = [
+        int(line.split("admissible=")[1].split("/")[0])
+        for line in out.splitlines()
+        if "admissible=" in line
+    ]
+    assert rates[0] < 40 and rates[-1] == 100  # cold → warm
+    assert rates == sorted(rates)  # monotone ramp

@@ -62,7 +62,9 @@ def main() -> None:
         def body(carry, xs):
             st, now = carry
             batch = xs
-            st, verdicts = _decide_core(config, st, table, batch, now)
+            st, verdicts = _decide_core(
+                config, st, table, batch, now, grouped=True, uniform=True
+            )
             return (st, now + 1), verdicts.status
 
         (state, _), statuses = jax.lax.scan(
@@ -72,10 +74,14 @@ def main() -> None:
 
     step = jax.jit(chained, donate_argnums=(0,))
 
+    # the serving path: the host batcher groups same-flow requests (numpy
+    # stable sort, off the device critical path) and flags the uniform
+    # acquire=1 common case — decide() then takes its exact closed-form
+    # admission with no device sort (see token_service.request_batch)
     rng = np.random.default_rng(0)
     batches = []
     for _ in range(chain):
-        slots = rng.integers(0, n_flows, size=config.batch_size).tolist()
+        slots = np.sort(rng.integers(0, n_flows, size=config.batch_size)).tolist()
         batches.append(make_batch(config, slots))
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
 

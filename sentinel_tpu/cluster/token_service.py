@@ -209,7 +209,11 @@ class DefaultTokenService(TokenService):
         with self._lock:
             now = self._engine_now()
             batch = make_batch(self.config, [-1])
-            decide(self.config, self._state, self._table, batch, jnp.int32(now))
+            # compile both serving variants (uniform acquire and mixed)
+            decide(self.config, self._state, self._table, batch, jnp.int32(now),
+                   grouped=True, uniform=True)
+            decide(self.config, self._state, self._table, batch, jnp.int32(now),
+                   grouped=True, uniform=False)
             idx = hash_indices(
                 np.zeros(1, np.int64), self.param_config.depth, self.param_config.width
             )
@@ -239,23 +243,34 @@ class DefaultTokenService(TokenService):
                 out.extend(self.request_batch(requests[i : i + cap]))
             return out
         with self._lock:
-            slots = [self._index.lookup(f) for f, _, _ in requests]
+            slots = np.asarray(
+                [self._index.lookup(f) for f, _, _ in requests], np.int32
+            )
+            acquires = np.asarray([a for _, a, _ in requests], np.int32)
+            prios = np.asarray([p for _, _, p in requests], bool)
+            # serving fast path: group same-flow requests contiguously
+            # (stable, so greedy admission order within a flow is arrival
+            # order) and detect the uniform-acquire common case — together
+            # they skip the device argsort and the iterative admission
+            # refinement (see decide()'s grouped/uniform flags)
+            order = np.argsort(slots, kind="stable")
+            uniform = bool(acquires.min() == acquires.max())
             batch = make_batch(
-                self.config,
-                slots,
-                [a for _, a, _ in requests],
-                [p for _, _, p in requests],
+                self.config, slots[order], acquires[order], prios[order]
             )
             now = self._engine_now()
             self._state, verdicts = decide(
-                self.config, self._state, self._table, batch, jnp.int32(now)
+                self.config, self._state, self._table, batch, jnp.int32(now),
+                grouped=True, uniform=uniform,
             )
         status = np.asarray(verdicts.status)
         remaining = np.asarray(verdicts.remaining)
         wait = np.asarray(verdicts.wait_ms)
+        inv = np.empty_like(order)
+        inv[order] = np.arange(n)
         return [
-            TokenResult(TokenStatus(int(status[i])), int(remaining[i]), int(wait[i]))
-            for i in range(n)
+            TokenResult(TokenStatus(int(status[j])), int(remaining[j]), int(wait[j]))
+            for j in (int(inv[i]) for i in range(n))
         ]
 
     def load_param_rules(self, rules: List[ClusterParamFlowRule]) -> None:

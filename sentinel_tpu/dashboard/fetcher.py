@@ -92,9 +92,20 @@ class MetricFetcher:
             stored += len(entries)
         return stored
 
+    def prune_dead_apps(self, live_apps) -> None:
+        """Drop cursors of apps that left discovery entirely — fetch_once
+        prunes per-machine cursors within a live app, but never visits a
+        vanished app, so ephemeral per-deploy app names would otherwise leak
+        one cursor set each."""
+        live = set(live_apps)
+        for key in [k for k in self._last_fetch if k[0] not in live]:
+            del self._last_fetch[key]
+
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
-            for app in self.apps.apps():
+            live_apps = self.apps.apps()
+            self.prune_dead_apps(live_apps)
+            for app in live_apps:
                 try:
                     self.fetch_once(app)
                 except Exception:

@@ -26,9 +26,11 @@ class EngineConfig(NamedTuple):
     # the admission mask is a subset of the sequential-greedy set; decide()
     # rejects even values)
     admission_refine_iters: int = 3
-    # segment-prefix implementation: "matmul" ([N,N] masked matmuls — MXU
-    # eats these for free up to N≈8k), "sort" (argsort+cumsum, O(N log N),
-    # wins beyond), or "auto" (matmul for batch_size <= 8192)
+    # segment-prefix implementation for the flow axis: "matmul" ([N,N]
+    # masked matmuls — cheap on the MXU for small N), "sort" (one argsort
+    # per batch + blocked-matmul cumsums, wins beyond ~2k), or "auto"
+    # (matmul ≤ 2048, sort above). Grouped host batches bypass this and use
+    # the sort-free "grouped" impl (see decide()'s grouped flag).
     prefix_impl: str = "auto"
 
     @property

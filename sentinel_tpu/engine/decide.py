@@ -99,6 +99,7 @@ def make_batch(
 
 
 from sentinel_tpu.engine.prefix import segment_prefix_builder as _segment_prefix_builder
+from sentinel_tpu.ops.scan_mm import blocked_cumsum as _blocked_cumsum
 
 
 def _decide_core(
@@ -108,6 +109,8 @@ def _decide_core(
     batch: RequestBatch,
     now: jax.Array,
     axis_name: Optional[str] = None,
+    grouped: bool = False,
+    uniform: bool = False,
 ) -> tuple:
     """The decision pipeline, single-shard or mesh-sharded.
 
@@ -119,6 +122,18 @@ def _decide_core(
     and updated identically on every device (its inputs are all global), so
     no collective is needed for its state. These are tiny ``[N]``-sized
     collectives riding ICI — the flow tensors themselves never move.
+
+    Serving fast-path flags (static — the host batcher picks the compiled
+    variant per batch):
+
+    - ``grouped``: the batcher placed same-flow requests contiguously (e.g.
+      sorted by slot; padding rows at the end are fine). Skips the device
+      argsort in the segment-prefix builder.
+    - ``uniform``: all live requests acquire the same token count (the
+      overwhelmingly common acquire=1 traffic). Greedy admission then has
+      the closed form ``admit = rank < floor((threshold - passed)/acquire)``
+      — ONE prefix pass, exact (the iterative refinement is only needed for
+      mixed acquire sizes, where greedy admission is not associative).
     """
     spec = flow_spec(config)
     now = jnp.asarray(now, jnp.int32)
@@ -149,9 +164,16 @@ def _decide_core(
     #    — computed identically on every device from global inputs
     # ------------------------------------------------------------------
     ns_id = psum(jnp.where(owned, rules.namespace_id[safe_slot], 0))
-    ns_already = W.window_sum(spec, state.ns, now, 0)[ns_id].astype(jnp.float32)
-    ns_prefix = _segment_prefix_builder(ns_id, config.prefix_impl)(
-        live.astype(jnp.float32)
+    ns_already = W.window_sum_at(spec, state.ns, now, 0, ns_id).astype(jnp.float32)
+    # the namespace key space is small and static — sort-free one-hot prefix;
+    # the one-hot matrix is reused below for the guard-counter matvec update
+    live_f = live.astype(jnp.float32)
+    ns_oh = (
+        ns_id[:, None] == jnp.arange(config.max_namespaces)[None, :]
+    ).astype(jnp.float32)
+    ns_incl = _blocked_cumsum(ns_oh * live_f[:, None])
+    ns_prefix = (
+        jnp.take_along_axis(ns_incl, ns_id[:, None], axis=1)[:, 0] - live_f
     )
     ns_budget = rules.ns_max_qps[ns_id] * (spec.interval_ms / 1000.0)
     ns_ok = (ns_already + ns_prefix + 1.0) <= ns_budget
@@ -177,107 +199,154 @@ def _decide_core(
     # 3. prefix-sum admission (odd refinement count ⇒ ⊆ sequential-exact)
     # ------------------------------------------------------------------
     passed = (
-        W.window_sum(spec, state.flow, now, ClusterEvent.PASS)
-        + W.window_sum(spec, state.occupy, now, 0)  # matured borrows
-    ).astype(jnp.float32)[safe_slot]
-    flow_prefix = _segment_prefix_builder(safe_slot, config.prefix_impl)
-
-    admit = active
-    iters = config.admission_refine_iters
-    if iters % 2 == 0:
+        W.window_sum_at(spec, state.flow, now, ClusterEvent.PASS, safe_slot)
+        + W.window_sum_at(spec, state.occupy, now, 0, safe_slot)  # matured borrows
+    ).astype(jnp.float32)
+    if config.prefix_impl == "grouped":
+        # "grouped" is only sound when the host batcher sorted the batch —
+        # that guarantee arrives via decide()'s grouped flag, never via
+        # config (on an interleaved batch it would silently drop earlier
+        # same-flow contributions and break the no-overshoot guarantee)
         raise ValueError(
-            "admission_refine_iters must be odd: an odd iteration count makes "
-            "the final admission mask a subset of the greedy-exact set "
-            "(no-overshoot guarantee)"
+            "prefix_impl='grouped' is not a config value; pass grouped=True "
+            "to decide() from a batcher that groups same-flow requests"
         )
-    for _ in range(iters):
-        contrib = jnp.where(admit, acquire_f, 0.0)
-        prefix = flow_prefix(contrib)  # tokens of earlier admitted same-flow reqs
-        admit = active & (passed + prefix + acquire_f <= threshold)
+    flow_prefix = _segment_prefix_builder(
+        safe_slot, "grouped" if grouped else config.prefix_impl
+    )
 
-    contrib = jnp.where(admit, acquire_f, 0.0)
-    admitted_prefix = flow_prefix(contrib)
+    if uniform:
+        # closed-form greedy admission: with one acquire size `a` per batch,
+        # the admitted set of each flow is exactly its first
+        # floor((threshold - passed)/a) active requests
+        a = jnp.max(jnp.where(live, batch.acquire, 0)).astype(jnp.float32)
+        a_safe = jnp.maximum(a, 1.0)
+        rank = flow_prefix(active.astype(jnp.float32))
+        admit = active & (passed + rank * a + a <= threshold)
+        quota = jnp.floor(jnp.maximum(threshold - passed, 0.0) / a_safe)
+        admitted_prefix = jnp.minimum(rank, quota) * a
+    else:
+        admit = active
+        iters = config.admission_refine_iters
+        if iters % 2 == 0:
+            raise ValueError(
+                "admission_refine_iters must be odd: an odd iteration count "
+                "makes the final admission mask a subset of the "
+                "sequential-greedy set (no-overshoot guarantee)"
+            )
+        for _ in range(iters):
+            contrib = jnp.where(admit, acquire_f, 0.0)
+            prefix = flow_prefix(contrib)  # earlier admitted same-flow tokens
+            admit = active & (passed + prefix + acquire_f <= threshold)
+        admitted_prefix = flow_prefix(jnp.where(admit, acquire_f, 0.0))
 
     # ------------------------------------------------------------------
     # 4. priority occupy of the next window (ClusterFlowChecker.java:84-97)
+    #    — the whole occupy path (reads, prefix, future-window write) is
+    #    gated on "any prioritized request in the batch", which is a global
+    #    property of the replicated batch and therefore a mesh-uniform
+    #    predicate (safe around the pmax inside add_future)
     # ------------------------------------------------------------------
     blocked = active & ~admit
     wait_next = spec.bucket_ms - (now % spec.bucket_ms)
-    next_start = now + wait_next
-    # currently-valid PASS tokens that will have expired by the next window
-    horizon = next_start - spec.interval_ms
-    cur_valid = W.valid_mask(spec, state.flow, now)
-    expiring_mask = cur_valid & (state.flow.starts <= horizon)
-    expiring = jnp.sum(
-        state.flow.counts[:, :, ClusterEvent.PASS]
-        * expiring_mask[None, :].astype(state.flow.counts.dtype),
-        axis=1,
-    ).astype(jnp.float32)[safe_slot]
-    waiting = W.future_sum(spec, state.occupy, now, 0).astype(jnp.float32)[safe_slot]
-
+    any_prio = jnp.any(batch.prioritized & batch.valid)
     try_occupy = blocked & batch.prioritized
-    occ_contrib = jnp.where(try_occupy, acquire_f, 0.0)
-    occ_prefix = flow_prefix(occ_contrib)  # conservative: all triers contribute
-    # admitted_prefix: tokens admitted earlier in THIS batch land in the
-    # current bucket, which is still valid at the next window — without this
-    # term a borrow could overcommit the window the batch just filled
-    can_occupy = try_occupy & (
-        passed - expiring + admitted_prefix + waiting + occ_prefix + acquire_f
-        <= config.max_occupy_ratio * threshold
+
+    def occupy_check(_):
+        next_start = now + wait_next
+        # currently-valid PASS tokens that will have expired by the next window
+        horizon = next_start - spec.interval_ms
+        cur_valid = W.valid_mask(spec, state.flow, now)
+        expiring_mask = cur_valid & (state.flow.starts <= horizon)
+        pass_rows = state.flow.counts[safe_slot, :, ClusterEvent.PASS]  # [N, B]
+        expiring = jnp.sum(
+            pass_rows * expiring_mask[None, :].astype(pass_rows.dtype), axis=1
+        ).astype(jnp.float32)
+        waiting = W.future_sum_at(spec, state.occupy, now, 0, safe_slot).astype(
+            jnp.float32
+        )
+        occ_contrib = jnp.where(try_occupy, acquire_f, 0.0)
+        occ_prefix = flow_prefix(occ_contrib)  # conservative: all triers count
+        # admitted_prefix: tokens admitted earlier in THIS batch land in the
+        # current bucket, which is still valid at the next window — without
+        # this term a borrow could overcommit the window the batch just filled
+        return try_occupy & (
+            passed - expiring + admitted_prefix + waiting + occ_prefix
+            + acquire_f
+            <= config.max_occupy_ratio * threshold
+        )
+
+    can_occupy = jax.lax.cond(
+        any_prio, occupy_check, lambda _: jnp.zeros((N,), bool), None
     )
     hard_block = blocked & ~can_occupy
 
     # ------------------------------------------------------------------
-    # 5. window updates — ONE roll + ONE fused scatter for all five flow
-    #    event channels (separate add_events calls would each re-roll and
-    #    re-materialize the [F, B, E] tensor; fusing keeps HBM traffic to
-    #    a single read-modify-write)
+    # 5. window updates: one scatter per static event channel (the layout
+    #    measured fastest on v5e — see add_event_rows), with the rare
+    #    OCCUPIED_PASS channel cond-gated. Rows whose masks are false
+    #    contribute zeros (scatter targets stay in range, so no drops
+    #    needed).
     # ------------------------------------------------------------------
-    ones_n = jnp.ones((N,), jnp.int32)
+    admit_i = admit.astype(jnp.int32)
+    hard_i = hard_block.astype(jnp.int32)
     ev = ClusterEvent
-    flow_slots5 = jnp.concatenate([safe_slot] * 5)
-    flow_chans5 = jnp.concatenate(
+    row_updates = jnp.stack(
         [
-            jnp.full((N,), int(c), jnp.int32)
-            for c in (ev.PASS, ev.PASS_REQUEST, ev.BLOCK, ev.BLOCK_REQUEST,
-                      ev.OCCUPIED_PASS)
-        ]
+            batch.acquire * admit_i,  # PASS
+            admit_i,  # PASS_REQUEST
+            batch.acquire * hard_i,  # BLOCK
+            hard_i,  # BLOCK_REQUEST
+        ],
+        axis=1,
     )
-    flow_vals5 = jnp.concatenate(
-        [batch.acquire, ones_n, batch.acquire, ones_n, batch.acquire]
+    flow_ws = W.add_event_rows(
+        spec, state.flow, now, safe_slot, row_updates,
+        channels=(ev.PASS, ev.PASS_REQUEST, ev.BLOCK, ev.BLOCK_REQUEST),
     )
     # OCCUPIED_PASS marks prioritized requests admitted normally (the
     # reference's OK branch adds OCCUPIED_PASS when prioritized; the occupy
-    # path records only the future-window WAITING, which is `occupy_ws` below)
-    flow_valid5 = jnp.concatenate(
-        [admit, admit, hard_block, hard_block, admit & batch.prioritized]
+    # path records only the future-window WAITING, which is `occupy_ws`
+    # below). Prioritized traffic is rare, so this scatter is cond-gated on
+    # the same mesh-uniform predicate as the occupy path.
+    idx_cur, _ = W.bucket_index(spec, now)
+    flow_counts = jax.lax.cond(
+        any_prio,
+        lambda c: c.at[safe_slot, idx_cur, int(ev.OCCUPIED_PASS)].add(
+            batch.acquire * (admit & batch.prioritized).astype(jnp.int32),
+            mode="drop",
+        ),
+        lambda c: c,
+        flow_ws.counts,
     )
-    flow_ws = W.add_events(
-        spec, state.flow, now, flow_slots5, flow_chans5, flow_vals5,
-        valid=flow_valid5,
-    )
+    flow_ws = flow_ws._replace(counts=flow_counts)
     # pmax over the mesh axis keeps the replicated occupy.starts identical on
     # every device even when only the owner shard sees a borrow (each shard
     # then also zeroes its own stale counts column for the reset slot)
-    occupy_ws = W.add_future(
-        spec, state.occupy, now,
-        wait_ms=jnp.full((N,), wait_next, jnp.int32),
-        resource_ids=safe_slot,
-        channel_ids=jnp.zeros((N,), jnp.int32),
-        values=batch.acquire,
-        valid=can_occupy,
-        combine_desired=pmax,
+    occupy_ws = jax.lax.cond(
+        any_prio,
+        lambda occ: W.add_future(
+            spec, occ, now,
+            wait_ms=jnp.full((N,), wait_next, jnp.int32),
+            resource_ids=safe_slot,
+            channel_ids=jnp.zeros((N,), jnp.int32),
+            values=batch.acquire,
+            valid=can_occupy,
+            combine_desired=pmax,
+        ),
+        lambda occ: occ,
+        state.occupy,
     )
     # namespace guard counts every ns-admitted request (the guard counts
     # arrivals, not flow verdicts — GlobalRequestLimiter adds on tryPass);
-    # the mask is global, so the replicated ns window stays consistent
-    ns_ws = W.add_events(
-        spec, state.ns, now,
-        ns_id,
-        jnp.zeros((N,), jnp.int32),
-        jnp.ones((N,), jnp.int32),
-        valid=ns_admitted,
+    # the mask is global, so the replicated ns window stays consistent. The
+    # per-namespace deltas come from the one-hot matvec (dense [NS] add),
+    # not a scatter.
+    ns_deltas = jnp.einsum(
+        "nk,n->k", ns_oh, ns_admitted.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,  # exact integer counts
     )
+    ns_ws = W.add_column(spec, state.ns, now, ns_deltas)
 
     # ------------------------------------------------------------------
     # 6. verdicts — owner emits status+1, psum stitches shards together
@@ -320,13 +389,23 @@ def _decide_core(
     return new_state, verdicts
 
 
-@partial(jax.jit, static_argnames=("config",))
+@partial(jax.jit, static_argnames=("config", "grouped", "uniform"))
 def decide(
     config: EngineConfig,
     state: EngineState,
     rules: RuleTable,
     batch: RequestBatch,
     now: jax.Array,
+    grouped: bool = False,
+    uniform: bool = False,
 ) -> tuple:
-    """``(state, rules, batch, now) -> (state', verdicts)`` — single shard."""
-    return _decide_core(config, state, rules, batch, now, axis_name=None)
+    """``(state, rules, batch, now) -> (state', verdicts)`` — single shard.
+
+    ``grouped``/``uniform`` are the serving fast-path flags (see
+    :func:`_decide_core`); the host batcher sets them per batch when its
+    layout guarantees hold, selecting one of four compiled variants.
+    """
+    return _decide_core(
+        config, state, rules, batch, now, axis_name=None,
+        grouped=grouped, uniform=uniform,
+    )

@@ -1,0 +1,78 @@
+"""Cumulative sums via blocked lower-triangular matmuls.
+
+XLA lowers 1-D ``cumsum``/``cummax`` on TPU to a log-depth sequence of
+lane-crossing shifted adds; at N=16k that costs ~0.3ms of device time —
+orders of magnitude more than the arithmetic warrants, and the single
+largest cost in the decision kernel's segment-prefix sums. The MXU gives
+the same result essentially for free: reshape ``[N] -> [R, C]``, multiply
+each row block by a ``[C, C]`` lower-triangular ones matrix (one batched
+matmul), then add exclusive block offsets computed by a tiny ``[R, R]``
+triangular matmul over the block totals. Two matmuls, no scans.
+
+Exact for integer-valued float32 inputs with totals < 2^24 (window counts
+are ints and far smaller).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# TPU matmuls default to bf16 passes; these cumsums carry integer counts
+# whose exactness the admission math relies on, so force full f32.
+_EXACT = jax.lax.Precision.HIGHEST
+
+
+def blocked_cumsum(x, block: int = 128):
+    """Inclusive cumsum along axis 0 of ``[N]`` or ``[N, K]`` float32 ``x``."""
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[:, None]
+    n, k = x.shape
+    x = x.astype(jnp.float32)
+    r = -(-n // block)
+    pad = r * block - n
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, k), jnp.float32)], axis=0)
+    xb = x.reshape(r, block, k)
+    i = jnp.arange(block)
+    tri = (i[:, None] >= i[None, :]).astype(jnp.float32)  # inclusive [C, C]
+    within = jnp.einsum(
+        "dc,rck->rdk", tri, xb, precision=_EXACT
+    )  # per-block inclusive sums
+    totals = within[:, -1, :]  # [r, k]
+    j = jnp.arange(r)
+    tri_r = (j[:, None] > j[None, :]).astype(jnp.float32)  # exclusive [R, R]
+    offsets = jnp.matmul(tri_r, totals, precision=_EXACT)  # [r, k]
+    out = (within + offsets[:, None, :]).reshape(r * block, k)[:n]
+    return out[:, 0] if squeeze else out
+
+
+def blocked_cummax(x, block: int = 128):
+    """Inclusive running max along axis 0 of ``[N]`` float32 ``x``.
+
+    Same blocking idea as :func:`blocked_cumsum` — max isn't linear so the
+    within-block pass is a masked reduce over a ``[R, C, C]`` broadcast
+    instead of a matmul, but that is still a vector op, not a scan.
+    """
+    n = x.shape[0]
+    x = x.astype(jnp.float32)
+    r = -(-n // block)
+    pad = r * block - n
+    neg = jnp.float32(-(2.0**30))
+    if pad:
+        x = jnp.concatenate([x, jnp.full((pad,), neg, jnp.float32)])
+    xb = x.reshape(r, block)
+    i = jnp.arange(block)
+    keep = i[:, None] >= i[None, :]  # inclusive [C, C]
+    within = jnp.max(
+        jnp.where(keep[None, :, :], xb[:, None, :], neg), axis=2
+    )  # [r, C]
+    totals = within[:, -1]  # [r]
+    j = jnp.arange(r)
+    keep_r = j[:, None] > j[None, :]  # exclusive [R, R]
+    offsets = jnp.max(
+        jnp.where(keep_r, totals[None, :], neg), axis=1
+    )  # [r]
+    out = jnp.maximum(within, offsets[:, None]).reshape(r * block)[:n]
+    return out

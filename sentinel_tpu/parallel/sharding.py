@@ -77,7 +77,13 @@ def shard_rules(rules: RuleTable, mesh: Mesh, axis: str = "flows") -> RuleTable:
     )
 
 
-def make_sharded_decide(config: EngineConfig, mesh: Mesh, axis: str = "flows"):
+def make_sharded_decide(
+    config: EngineConfig,
+    mesh: Mesh,
+    axis: str = "flows",
+    grouped: bool = False,
+    uniform: bool = False,
+):
     """Build the jitted multi-chip step.
 
     ``config.max_flows`` must divide evenly by the mesh size; each shard owns
@@ -92,7 +98,10 @@ def make_sharded_decide(config: EngineConfig, mesh: Mesh, axis: str = "flows"):
         )
 
     def step(state, rules, batch, now):
-        return _decide_core(config, state, rules, batch, now, axis_name=axis)
+        return _decide_core(
+            config, state, rules, batch, now, axis_name=axis,
+            grouped=grouped, uniform=uniform,
+        )
 
     mapped = shard_map(
         step,

@@ -123,6 +123,27 @@ class TestFetcher:
         fetcher.fetch_once("svc")  # m2 catches up over its original window
         assert repo.query("svc", "res", 0, 2**61)[0].pass_qps == 10
 
+    def test_dead_app_cursors_pruned(self, manual_clock, monkeypatch):
+        from sentinel_tpu.metrics.log import MetricNode
+
+        apps = AppManagement()
+        repo = InMemoryMetricsRepository()
+        fetcher = MetricFetcher(apps, repo)
+        apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=1))
+        ts = manual_clock.now_ms() // 1000 * 1000 - 3000
+        monkeypatch.setattr(
+            fetcher.client, "fetch_metrics",
+            lambda machine, start, end: [
+                MetricNode(timestamp_ms=ts, resource="res", pass_qps=1)
+            ],
+        )
+        fetcher.fetch_once("svc")
+        assert any(k[0] == "svc" for k in fetcher._last_fetch)
+        # the app disappears from discovery entirely: the loop-side prune
+        # must drop its cursors (fetch_once never visits it again)
+        fetcher.prune_dead_apps([])
+        assert not fetcher._last_fetch
+
     def test_idle_series_evicted(self, manual_clock):
         """Series that stop receiving traffic age out of the store (and the
         sidebar) instead of leaking forever."""

@@ -218,6 +218,123 @@ class TestSequentialOracle:
             assert [bool(got[j]) for j in idxs] == want
 
 
+class TestServingFastPaths:
+    """grouped/uniform variants must agree with the general path (and the
+    greedy oracle) on batches satisfying their preconditions."""
+
+    def _greedy(self, threshold, acquires):
+        used, out = 0, []
+        for a in acquires:
+            ok = used + a <= threshold
+            out.append(ok)
+            used += a if ok else 0
+        return out
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_grouped_uniform_matches_general(self, seed):
+        rng = np.random.default_rng(400 + seed)
+        rules = [ClusterFlowRule(flow_id=i, count=float(rng.integers(1, 9)), mode=G)
+                 for i in range(5)]
+        table, index = build_rule_table(CFG, rules)
+        flows = np.sort(rng.integers(0, 5, size=24)).tolist()  # grouped
+        slots = [index.lookup(f) for f in flows]
+        batch = make_batch(CFG, slots)
+        s0 = make_state(CFG)
+        _, v_gen = decide(CFG, s0, table, batch, jnp.int32(50_000))
+        s1, v_fast = decide(
+            CFG, s0, table, batch, jnp.int32(50_000), grouped=True, uniform=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v_gen.status), np.asarray(v_fast.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v_gen.remaining), np.asarray(v_fast.remaining)
+        )
+        # and against the oracle per flow
+        got = np.asarray(v_fast.status) == TokenStatus.OK
+        for i, rule in enumerate(rules):
+            idxs = [j for j, f in enumerate(flows) if f == i]
+            assert [bool(got[j]) for j in idxs] == self._greedy(
+                rule.count, [1] * len(idxs)
+            )
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_grouped_uniform_larger_acquire(self, seed):
+        rng = np.random.default_rng(500 + seed)
+        thr = float(rng.integers(5, 30))
+        table, index = build_rule_table(CFG, [ClusterFlowRule(flow_id=3, count=thr)])
+        a = int(rng.integers(2, 5))
+        n = int(rng.integers(3, 20))
+        slot = index.lookup(3)
+        batch = make_batch(CFG, [slot] * n, [a] * n)
+        _, v = decide(
+            CFG, make_state(CFG), table, batch, jnp.int32(50_000),
+            grouped=True, uniform=True,
+        )
+        got = (np.asarray(v.status)[:n] == TokenStatus.OK).tolist()
+        assert got == self._greedy(thr, [a] * n)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_grouped_mixed_never_overshoots(self, seed):
+        rng = np.random.default_rng(600 + seed)
+        thr = float(rng.integers(5, 40))
+        table, index = build_rule_table(CFG, [ClusterFlowRule(flow_id=9, count=thr)])
+        n = int(rng.integers(5, 32))
+        acquires = rng.integers(1, 6, size=n).tolist()
+        slot = index.lookup(9)
+        batch = make_batch(CFG, [slot] * n, acquires)
+        _, v = decide(
+            CFG, make_state(CFG), table, batch, jnp.int32(50_000),
+            grouped=True, uniform=False,
+        )
+        got = (np.asarray(v.status)[:n] == TokenStatus.OK).tolist()
+        admitted = sum(a for a, g in zip(acquires, got) if g)
+        assert admitted <= thr
+        want = self._greedy(thr, acquires)
+        assert all(not g or w for g, w in zip(got, want))
+
+    def test_grouped_priority_occupy(self):
+        # SHOULD_WAIT still works through the cond-gated occupy path: fill
+        # the window, then ask again with priority just before those tokens
+        # expire — the borrow lands in the next window
+        table, index = build_rule_table(CFG, [ClusterFlowRule(flow_id=1, count=4.0)])
+        slot = index.lookup(1)
+        state = make_state(CFG)
+        state, v0 = decide(
+            CFG, state, table, make_batch(CFG, [slot] * 4),
+            jnp.int32(50_000), grouped=True, uniform=True,
+        )
+        assert (np.asarray(v0.status)[:4] == TokenStatus.OK).all()
+        batch = make_batch(CFG, [slot] * 2, [1] * 2, [True] * 2)
+        state, v = decide(
+            CFG, state, table, batch, jnp.int32(50_950), grouped=True, uniform=True
+        )
+        st = np.asarray(v.status)[:2]
+        assert (st == TokenStatus.SHOULD_WAIT).sum() > 0
+        assert np.asarray(v.wait_ms)[:2][st == TokenStatus.SHOULD_WAIT].min() > 0
+
+    def test_grouped_rejected_as_config_value(self):
+        cfg = EngineConfig(
+            max_flows=16, max_namespaces=4, batch_size=8, prefix_impl="grouped"
+        )
+        table, index = build_rule_table(cfg, [ClusterFlowRule(flow_id=1, count=4.0)])
+        batch = make_batch(cfg, [index.lookup(1)])
+        with pytest.raises(ValueError, match="grouped"):
+            decide(cfg, make_state(cfg), table, batch, jnp.int32(1_000))
+
+    def test_no_rule_and_padding_unchanged(self):
+        table, index = build_rule_table(CFG, [ClusterFlowRule(flow_id=1, count=4.0)])
+        batch = make_batch(CFG, [-1, index.lookup(1)])
+        _, v = decide(
+            CFG, make_state(CFG), table, batch, jnp.int32(50_000),
+            grouped=True, uniform=True,
+        )
+        st = np.asarray(v.status)
+        assert st[0] == TokenStatus.NO_RULE_EXISTS
+        assert st[1] == TokenStatus.OK
+        assert (st[2:] == TokenStatus.FAIL).all()
+
+
 class TestReviewRegressions:
     def test_occupy_cannot_overcommit_window_filled_by_same_batch(self):
         # regression: 3 normal admits fill count=3; a prioritized 4th in the

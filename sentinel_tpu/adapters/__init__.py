@@ -16,6 +16,9 @@ enter context → ``entry`` → proceed → trace on error → ``exit``.
   (``sentinel-okhttp/apache-httpclient-adapter`` analog; gated).
 - ``gateway``: param-based gateway flow rules + request parser
   (``sentinel-api-gateway-adapter-common`` analog).
+- ``aiohttp_middleware``: aiohttp server middleware (gated on ``aiohttp``).
+- ``tornado_handler``: Tornado ``RequestHandler`` mixin (gated on
+  ``tornado``).
 """
 
 from sentinel_tpu.adapters.decorator import sentinel_resource

@@ -16,6 +16,17 @@ from sentinel_tpu.datasource.file import (
     FileRefreshableDataSource,
     FileWritableDataSource,
 )
+from sentinel_tpu.datasource.push_base import WatchingDataSource
+from sentinel_tpu.datasource.consul import ConsulDataSource
+from sentinel_tpu.datasource.etcd import EtcdDataSource
+from sentinel_tpu.datasource.nacos import NacosDataSource
+from sentinel_tpu.datasource.apollo import ApolloDataSource
+from sentinel_tpu.datasource.eureka import EurekaDataSource
+from sentinel_tpu.datasource.redis import RedisClient, RedisDataSource
+from sentinel_tpu.datasource.spring_cloud_config import (
+    SpringCloudConfigDataSource,
+)
+from sentinel_tpu.datasource.zookeeper import ZookeeperDataSource
 from sentinel_tpu.datasource.converters import (
     flow_rules_from_json,
     flow_rules_to_json,
@@ -37,6 +48,16 @@ __all__ = [
     "WritableDataSourceRegistry",
     "FileRefreshableDataSource",
     "FileWritableDataSource",
+    "WatchingDataSource",
+    "ConsulDataSource",
+    "EtcdDataSource",
+    "NacosDataSource",
+    "ApolloDataSource",
+    "EurekaDataSource",
+    "RedisClient",
+    "RedisDataSource",
+    "SpringCloudConfigDataSource",
+    "ZookeeperDataSource",
     "flow_rules_from_json",
     "flow_rules_to_json",
     "degrade_rules_from_json",

@@ -181,6 +181,8 @@ class StatisticSlot(ProcessorSlot):
     fire checks first; count pass/block/rt afterwards based on the outcome."""
 
     def entry(self, context, resource, node, count, prioritized, args):
+        from sentinel_tpu.metrics import extension as _ext
+
         try:
             self.fire_entry(context, resource, node, count, prioritized, args)
         except PriorityWaitException:
@@ -192,6 +194,7 @@ class StatisticSlot(ProcessorSlot):
                 context.cur_entry.origin_node.increase_thread()
             if resource.entry_type == EntryType.IN:
                 _entry_node().increase_thread()
+            _ext.on_thread_inc(resource.name, args)
         except BlockException as e:
             context.cur_entry.block_error = e
             node.add_block(count)
@@ -201,6 +204,7 @@ class StatisticSlot(ProcessorSlot):
                 context.cur_entry.origin_node.add_block(count)
             if resource.entry_type == EntryType.IN:
                 _entry_node().add_block(count)
+            _ext.on_block(resource.name, count, context.origin, e, args)
             raise
         else:
             node.increase_thread()
@@ -215,6 +219,8 @@ class StatisticSlot(ProcessorSlot):
                 en = _entry_node()
                 en.increase_thread()
                 en.add_pass(count)
+            _ext.on_pass(resource.name, count, args)
+            _ext.on_thread_inc(resource.name, args)
 
     def exit(self, context, resource, count, args):
         entry = context.cur_entry
@@ -236,6 +242,10 @@ class StatisticSlot(ProcessorSlot):
                 en = _entry_node()
                 en.add_rt_and_success(rt, count)
                 en.decrease_thread()
+            from sentinel_tpu.metrics import extension as _ext
+
+            _ext.on_complete(resource.name, count, rt, args)
+            _ext.on_thread_dec(resource.name, args)
         self.fire_exit(context, resource, count, args)
 
 

@@ -70,6 +70,9 @@ class Entry:
                 node.cluster_node.add_exception(count)
         if self.origin_node is not None:
             self.origin_node.add_exception(count)
+        from sentinel_tpu.metrics import extension as _ext
+
+        _ext.on_exception(self.resource.name, count, error)
 
     def exit(self, count: int = 1) -> None:
         if self._exited:

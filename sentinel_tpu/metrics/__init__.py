@@ -1,6 +1,8 @@
-"""Metric log pipeline (analog of ``node/metric/*`` in the reference):
-1-second aggregation of every resource's cluster node into rolling log files,
-plus the searcher the dashboard's ``/metric`` command reads."""
+"""Metric pipeline (analog of ``node/metric/*`` + ``metric/extension/*`` +
+``sentinel-metric-exporter``): 1-second aggregation of every resource's
+cluster node into rolling log files, the searcher the dashboard's
+``/metric`` command reads, the pluggable extension SPI on the statistic
+write path, and the Prometheus scrape exporter."""
 
 from sentinel_tpu.metrics.log import (
     MetricNode,
@@ -8,5 +10,21 @@ from sentinel_tpu.metrics.log import (
     MetricSearcher,
     MetricTimer,
 )
+from sentinel_tpu.metrics.extension import (
+    MetricExtension,
+    register_extension,
+    clear_extensions_for_tests,
+)
+from sentinel_tpu.metrics.exporter import PrometheusExporter, render
 
-__all__ = ["MetricNode", "MetricWriter", "MetricSearcher", "MetricTimer"]
+__all__ = [
+    "MetricNode",
+    "MetricWriter",
+    "MetricSearcher",
+    "MetricTimer",
+    "MetricExtension",
+    "register_extension",
+    "clear_extensions_for_tests",
+    "PrometheusExporter",
+    "render",
+]

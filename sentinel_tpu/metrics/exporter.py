@@ -1,0 +1,92 @@
+"""Prometheus-format metric exporter (analog of ``sentinel-metric-exporter``).
+
+The reference exposes one JMX MBean per resource, refreshed by a collector
+(``exporter/jmx/{JMXMetricExporter,MBeanRegistry,MetricBeanWriter}.java``);
+the Python-ecosystem equivalent is a Prometheus scrape endpoint. Rendering
+happens at scrape time straight off the live ``ClusterNode`` windows — no
+refresh thread needed (Prometheus pulls; JMX needed push-into-beans).
+
+Exposed series (labels: ``resource``):
+
+- ``sentinel_pass_qps`` / ``sentinel_block_qps`` / ``sentinel_success_qps``
+  / ``sentinel_exception_qps`` — 1s-window rates
+- ``sentinel_rt_avg_ms`` — average response time over the window
+- ``sentinel_concurrency`` — current in-flight entries
+
+Serve standalone via :class:`PrometheusExporter` (its own port, like the
+JMX exporter's own registry), or mount :func:`render` under any existing
+HTTP surface (the command center registers it at ``/metric/prometheus``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.core.httpd import HttpService, Response
+from sentinel_tpu.local import chain as _chain
+
+_HELP = """\
+# HELP sentinel_pass_qps Admitted requests per second (1s sliding window).
+# TYPE sentinel_pass_qps gauge
+# HELP sentinel_block_qps Blocked requests per second (1s sliding window).
+# TYPE sentinel_block_qps gauge
+# HELP sentinel_success_qps Completed requests per second (1s sliding window).
+# TYPE sentinel_success_qps gauge
+# HELP sentinel_exception_qps Business exceptions per second (1s sliding window).
+# TYPE sentinel_exception_qps gauge
+# HELP sentinel_rt_avg_ms Average response time over the 1s window.
+# TYPE sentinel_rt_avg_ms gauge
+# HELP sentinel_concurrency Current in-flight entries.
+# TYPE sentinel_concurrency gauge
+"""
+
+
+def _escape(label: str) -> str:
+    return label.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render(now_ms: Optional[int] = None) -> str:
+    """Prometheus text exposition of every resource's live window stats."""
+    now = _clock.now_ms() if now_ms is None else now_ms
+    lines = [_HELP]
+    for name, node in sorted(_chain.cluster_node_map().items()):
+        label = f'{{resource="{_escape(name)}"}}'
+        success = node.success_qps(now)
+        avg_rt = node.avg_rt(now)
+        for metric, value in (
+            ("sentinel_pass_qps", node.pass_qps(now)),
+            ("sentinel_block_qps", node.block_qps(now)),
+            ("sentinel_success_qps", success),
+            ("sentinel_exception_qps", node.exception_qps(now)),
+            ("sentinel_rt_avg_ms", avg_rt),
+            ("sentinel_concurrency", node.cur_thread_num),
+        ):
+            lines.append(f"{metric}{label} {value:g}")
+    return "\n".join(lines) + "\n"
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class PrometheusExporter:
+    """Standalone scrape endpoint: ``GET /metrics``."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 9092):
+        self._service = HttpService(self._route, host, port, "prom-exporter")
+
+    def _route(self, method: str, path: str, params: dict, body: str) -> Response:
+        if method == "GET" and path in ("metrics", ""):
+            return (200, render(), CONTENT_TYPE)
+        return (404, "not found\n", "text/plain")
+
+    def start(self) -> "PrometheusExporter":
+        self._service.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._service.port
+
+    def stop(self) -> None:
+        self._service.stop()

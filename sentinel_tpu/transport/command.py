@@ -54,6 +54,8 @@ def _route(method: str, name: str, params: Dict[str, str], body: str) -> Respons
     except Exception as e:
         record_log.exception("command %s failed", name)
         return json_response(500, f"command failed: {e}")
+    if isinstance(result, tuple) and len(result) == 3:
+        return result  # handler provided a full (status, body, content-type)
     if isinstance(result, (dict, list)):
         return json_response(200, json.dumps(result))
     return json_response(200, str(result))

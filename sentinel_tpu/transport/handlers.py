@@ -117,6 +117,13 @@ def cmd_metric(params, body):
     return "\n".join(lines)
 
 
+@command_mapping("metric/prometheus", "Prometheus text exposition of live stats")
+def cmd_metric_prometheus(params, body):
+    from sentinel_tpu.metrics.exporter import CONTENT_TYPE, render
+
+    return (200, render(), CONTENT_TYPE)  # text format, not JSON
+
+
 @command_mapping("clusterNode", "per-resource statistics snapshot")
 def cmd_cluster_node(params, body):
     from sentinel_tpu.local.chain import cluster_node_map

@@ -1,0 +1,122 @@
+"""aiohttp middleware and Tornado mixin adapters (real framework servers)."""
+
+import asyncio
+import json
+
+import pytest
+
+from sentinel_tpu.local.chain import (
+    cluster_node_map,
+    reset_cluster_nodes_for_tests,
+)
+from sentinel_tpu.local.flow import FlowRule, FlowRuleManager
+
+
+@pytest.fixture(autouse=True)
+def clean(manual_clock):
+    reset_cluster_nodes_for_tests()
+    FlowRuleManager.load_rules([])
+    yield
+    FlowRuleManager.load_rules([])
+    reset_cluster_nodes_for_tests()
+
+
+class TestAiohttp:
+    def _app(self):
+        from aiohttp import web
+
+        from sentinel_tpu.adapters.aiohttp_middleware import sentinel_middleware
+
+        async def hello(request):
+            return web.json_response({"ok": True})
+
+        async def boom(request):
+            raise RuntimeError("kaput")
+
+        app = web.Application(middlewares=[sentinel_middleware()])
+        app.router.add_get("/hello", hello)
+        app.router.add_get("/boom", boom)
+        return app
+
+    def _drive(self, paths):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def run():
+            client = TestClient(TestServer(self._app()))
+            await client.start_server()
+            try:
+                out = []
+                for p in paths:
+                    resp = await client.get(p)
+                    out.append((resp.status, await resp.text()))
+                return out
+            finally:
+                await client.close()
+
+        return asyncio.new_event_loop().run_until_complete(run())
+
+    def test_pass_block_and_trace(self):
+        FlowRuleManager.load_rules([FlowRule(resource="GET:/hello", count=2.0)])
+        results = self._drive(["/hello"] * 4 + ["/boom"])
+        statuses = [s for s, _ in results[:4]]
+        assert statuses == [200, 200, 429, 429]
+        assert json.loads(results[2][1])["error"].startswith("Blocked")
+        assert results[4][0] == 500  # handler error propagates
+        node = cluster_node_map()["GET:/hello"]
+        assert node.pass_qps() == 2
+        assert node.block_qps() == 2
+        boom = cluster_node_map()["GET:/boom"]
+        assert boom.exception_qps() == 1
+
+
+class TestTornado:
+    def _fetch(self, app, paths):
+        from tornado.httpserver import HTTPServer
+        from tornado.httpclient import AsyncHTTPClient
+        from tornado.testing import bind_unused_port
+
+        async def run():
+            sock, port = bind_unused_port()
+            server = HTTPServer(app)
+            server.add_sockets([sock])
+            client = AsyncHTTPClient()
+            out = []
+            try:
+                for p in paths:
+                    resp = await client.fetch(
+                        f"http://127.0.0.1:{port}{p}", raise_error=False
+                    )
+                    out.append((resp.code, resp.body.decode()))
+            finally:
+                server.stop()
+            return out
+
+        return asyncio.new_event_loop().run_until_complete(run())
+
+    def _app(self):
+        from tornado import web
+
+        from sentinel_tpu.adapters.tornado_handler import (
+            SentinelRequestHandlerMixin,
+        )
+
+        class Hello(SentinelRequestHandlerMixin, web.RequestHandler):
+            def get(self):
+                self.write("hi")
+
+        class Boom(SentinelRequestHandlerMixin, web.RequestHandler):
+            def get(self):
+                raise RuntimeError("kaput")
+
+        return web.Application([("/hello", Hello), ("/boom", Boom)])
+
+    def test_pass_block_and_trace(self):
+        FlowRuleManager.load_rules([FlowRule(resource="GET:/hello", count=2.0)])
+        results = self._fetch(self._app(), ["/hello"] * 4 + ["/boom"])
+        assert [s for s, _ in results[:4]] == [200, 200, 429, 429]
+        assert "Blocked" in results[2][1]
+        assert results[4][0] == 500
+        node = cluster_node_map()["GET:/hello"]
+        assert node.pass_qps() == 2
+        assert node.block_qps() == 2
+        assert cluster_node_map()["GET:/boom"].exception_qps() == 1

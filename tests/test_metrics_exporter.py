@@ -1,0 +1,132 @@
+"""Metric extension SPI + Prometheus exporter."""
+
+import urllib.request
+
+import pytest
+
+from sentinel_tpu import local as sentinel
+from sentinel_tpu.local import BlockException
+from sentinel_tpu.local.chain import reset_cluster_nodes_for_tests
+from sentinel_tpu.local.flow import FlowRule, FlowRuleManager
+from sentinel_tpu.metrics import (
+    MetricExtension,
+    PrometheusExporter,
+    clear_extensions_for_tests,
+    register_extension,
+    render,
+)
+
+
+class Recorder(MetricExtension):
+    def __init__(self):
+        self.events = []
+
+    def add_pass(self, resource, n, args):
+        self.events.append(("pass", resource, n))
+
+    def add_block(self, resource, n, origin, error, args):
+        self.events.append(("block", resource, n, type(error).__name__))
+
+    def add_success(self, resource, n, args):
+        self.events.append(("success", resource, n))
+
+    def add_rt(self, resource, rt_ms, args):
+        self.events.append(("rt", resource))
+
+    def add_exception(self, resource, n, error):
+        self.events.append(("exception", resource, n))
+
+    def increase_thread_num(self, resource, args):
+        self.events.append(("thread+", resource))
+
+    def decrease_thread_num(self, resource, args):
+        self.events.append(("thread-", resource))
+
+
+@pytest.fixture(autouse=True)
+def clean(manual_clock):
+    reset_cluster_nodes_for_tests()
+    clear_extensions_for_tests()
+    FlowRuleManager.load_rules([])
+    yield
+    clear_extensions_for_tests()
+    FlowRuleManager.load_rules([])
+    reset_cluster_nodes_for_tests()
+
+
+class TestExtensionSpi:
+    def test_pass_and_exit_callbacks(self):
+        rec = Recorder()
+        register_extension(rec)
+        with sentinel.entry("api"):
+            pass
+        kinds = [e[0] for e in rec.events]
+        assert kinds == ["pass", "thread+", "success", "rt", "thread-"]
+        assert all(e[1] == "api" for e in rec.events)
+
+    def test_block_callback(self):
+        FlowRuleManager.load_rules([FlowRule(resource="api", count=0.0)])
+        rec = Recorder()
+        register_extension(rec)
+        with pytest.raises(BlockException):
+            with sentinel.entry("api"):
+                pass
+        assert ("block", "api", 1, "FlowException") in rec.events
+        assert not any(e[0] == "pass" for e in rec.events)
+
+    def test_exception_callback(self):
+        rec = Recorder()
+        register_extension(rec)
+        try:
+            with sentinel.entry("api"):
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert ("exception", "api", 1) in rec.events
+
+
+class TestPrometheusExporter:
+    def _traffic(self):
+        FlowRuleManager.load_rules([FlowRule(resource="api", count=2.0)])
+        for _ in range(4):
+            try:
+                with sentinel.entry("api"):
+                    pass
+            except BlockException:
+                pass
+
+    def test_render_series(self):
+        self._traffic()
+        text = render()
+        assert 'sentinel_pass_qps{resource="api"} 2' in text
+        assert 'sentinel_block_qps{resource="api"} 2' in text
+        assert 'sentinel_concurrency{resource="api"} 0' in text
+        assert "# TYPE sentinel_rt_avg_ms gauge" in text
+
+    def test_label_escaping(self):
+        with sentinel.entry('we"ird'):
+            pass
+        assert 'resource="we\\"ird"' in render()
+
+    def test_http_scrape(self):
+        self._traffic()
+        exporter = PrometheusExporter(host="127.0.0.1", port=0).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/metrics", timeout=5
+            ) as resp:
+                body = resp.read().decode()
+                assert resp.headers["Content-Type"].startswith("text/plain")
+            assert 'sentinel_pass_qps{resource="api"} 2' in body
+        finally:
+            exporter.stop()
+
+    def test_command_center_route(self):
+        import sentinel_tpu.transport.handlers  # noqa: F401 — registers commands
+        from sentinel_tpu.transport.command import _route
+
+        self._traffic()
+        code, body, ctype = _route("GET", "metric/prometheus", {}, "")
+        assert code == 200
+        assert "sentinel_pass_qps" in body
+        assert ctype.startswith("text/plain")  # exposition format, not JSON

@@ -1,0 +1,95 @@
+"""Local entry overhead micro-benchmark — the JMH analog.
+
+Reference: ``sentinel-benchmark/.../SentinelEntryBenchmark.java:44-140``
+measures ops/s of a small workload (shuffle+sort of K ints) bare vs wrapped
+in ``SphU.entry``, at 1..16 threads. Same shape here: the interesting
+number is the *entry overhead per call*, i.e. how much tax the guard adds
+to a microsecond-scale workload.
+
+Run: ``python benchmarks/local_entry_bench.py [--threads N] [--size K]``
+Prints one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+
+
+def workload(size: int) -> None:
+    nums = list(range(size))
+    random.shuffle(nums)
+    nums.sort()
+
+
+def run_loop(fn, stop, counter, idx):
+    n = 0
+    while not stop.is_set():
+        fn()
+        n += 1
+    counter[idx] = n
+
+
+def measure(fn, threads: int, seconds: float) -> float:
+    stop = threading.Event()
+    counts = [0] * threads
+    ts = [
+        threading.Thread(target=run_loop, args=(fn, stop, counts, i))
+        for i in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in ts:
+        t.join()
+    return sum(counts) / seconds
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--threads", type=int, default=0,
+                        help="0 = sweep 1,2,4,8")
+    parser.add_argument("--size", type=int, default=100)
+    parser.add_argument("--seconds", type=float, default=2.0)
+    args = parser.parse_args()
+
+    from sentinel_tpu import local as sentinel
+    from sentinel_tpu.local import BlockException
+    from sentinel_tpu.local.flow import FlowRule, FlowRuleManager
+
+    # a rule that never blocks — measuring the guard tax, not verdicts
+    FlowRuleManager.load_rules([FlowRule(resource="bench", count=1e12)])
+
+    def bare():
+        workload(args.size)
+
+    def guarded():
+        try:
+            with sentinel.entry("bench"):
+                workload(args.size)
+        except BlockException:
+            pass
+
+    sweep = [args.threads] if args.threads else [1, 2, 4, 8]
+    for threads in sweep:
+        base = measure(bare, threads, args.seconds)
+        wrapped = measure(guarded, threads, args.seconds)
+        per_call_us = (1e6 / wrapped - 1e6 / base) * threads if wrapped else 0
+        print(json.dumps({
+            "metric": "local_entry_overhead",
+            "threads": threads,
+            "workload_size": args.size,
+            "bare_ops_s": round(base),
+            "guarded_ops_s": round(wrapped),
+            "overhead_us_per_entry": round(per_call_us, 2),
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

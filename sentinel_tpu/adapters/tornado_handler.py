@@ -57,12 +57,16 @@ class SentinelRequestHandlerMixin:
             self.finish(self.sentinel_block_body)
 
     def _sentinel_exit_context(self):
-        if self._sentinel_ctx:
+        if getattr(self, "_sentinel_ctx", False):
             _ctx.exit()
             self._sentinel_ctx = False
 
     def _sentinel_close(self, error: Optional[BaseException] = None):
-        e, self._sentinel_entry = self._sentinel_entry, None
+        # getattr: Tornado can finish a request without ever calling
+        # prepare() (e.g. HTTPError(405) for an unsupported method raised
+        # inside _execute before the prepare hook)
+        e = getattr(self, "_sentinel_entry", None)
+        self._sentinel_entry = None
         if e is not None:
             if error is not None:
                 e.trace(error)
@@ -74,7 +78,16 @@ class SentinelRequestHandlerMixin:
         super().on_finish()
 
     def log_exception(self, typ, value, tb):
-        if value is not None and not isinstance(value, BlockException):
+        from tornado.web import HTTPError
+
+        # HTTPError is framework control flow (404s, 405s), not a business
+        # failure — tracing it would inflate exception ratios and could trip
+        # exception-ratio circuit breakers (the aiohttp middleware excludes
+        # web.HTTPException for the same reason)
+        if (
+            value is not None
+            and not isinstance(value, (BlockException, HTTPError))
+        ):
             e = getattr(self, "_sentinel_entry", None)
             if e is not None:
                 e.trace(value)

@@ -266,6 +266,20 @@ class DefaultTokenService(TokenService):
         status = np.asarray(verdicts.status)
         remaining = np.asarray(verdicts.remaining)
         wait = np.asarray(verdicts.wait_ms)
+        # cluster server stat log (ClusterServerStatLogUtil analog): one
+        # aggregated counter per verdict class per window
+        from sentinel_tpu.metrics.stat_logger import log_cluster
+
+        head = status[:n]
+        for event, code in (
+            ("pass", int(TokenStatus.OK)),
+            ("block", int(TokenStatus.BLOCKED)),
+            ("occupied", int(TokenStatus.SHOULD_WAIT)),
+            ("tooManyRequest", int(TokenStatus.TOO_MANY_REQUEST)),
+        ):
+            hits = int((head == code).sum())
+            if hits:
+                log_cluster(event, count=hits)
         inv = np.empty_like(order)
         inv[order] = np.arange(n)
         return [

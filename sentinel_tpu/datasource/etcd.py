@@ -52,16 +52,24 @@ class EtcdDataSource(AutoRefreshDataSource):
         return {"Authorization": self._auth_token} if self._auth_token else {}
 
     def _range(self) -> dict:
-        resp = request(
-            f"{self.endpoint}/v3/kv/range",
-            method="POST",
-            data=('{"key":"%s"}' % _b64(self.rule_key)).encode(),
-            headers=self._headers(),
-            timeout_s=5.0,
-        )
-        if resp.status != 200:
-            raise RuntimeError(f"etcd range failed: {resp.status} {resp.text}")
-        return resp.json()
+        for attempt in (0, 1):
+            resp = request(
+                f"{self.endpoint}/v3/kv/range",
+                method="POST",
+                data=('{"key":"%s"}' % _b64(self.rule_key)).encode(),
+                headers=self._headers(),
+                timeout_s=5.0,
+            )
+            if resp.status == 200:
+                return resp.json()
+            # etcd simple tokens expire (default TTL 300s); drop the cached
+            # token and re-authenticate once instead of failing every poll
+            # until restart
+            if resp.status in (401, 403) and self._user and attempt == 0:
+                self._auth_token = None
+                continue
+            break
+        raise RuntimeError(f"etcd range failed: {resp.status} {resp.text}")
 
     def read_source(self) -> str:
         body = self._range()

@@ -45,6 +45,10 @@ class EurekaDataSource(AutoRefreshDataSource):
                 instances = (resp.json().get("application") or {}).get(
                     "instance"
                 ) or []
+                if isinstance(instances, dict):
+                    # Eureka's XStream JSON renders a single-instance app as
+                    # an object, not a one-element list
+                    instances = [instances]
                 for inst in instances:
                     if inst.get("instanceId") != self.instance_id:
                         continue

@@ -147,7 +147,7 @@ class RedisDataSource(ReadableDataSource):
         while not self._stop.is_set():
             try:
                 reply = self._sub.reader.read_reply()
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, RespError):
                 if self._stop.is_set():
                     return
                 # redis restarted / transient drop: resubscribe with backoff
@@ -163,7 +163,9 @@ class RedisDataSource(ReadableDataSource):
                 try:
                     self._subscribe()
                     self.refresh()
-                except (ConnectionError, OSError) as e:
+                except (ConnectionError, OSError, RespError) as e:
+                    # RespError covers transient server states like
+                    # "-LOADING ..." right after a restart — retry, don't die
                     record_log.warning("redis reconnect failed: %s", e)
                 continue
             if not (isinstance(reply, list) and len(reply) == 3):

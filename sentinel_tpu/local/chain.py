@@ -160,7 +160,11 @@ class LogSlot(ProcessorSlot):
         except BlockException as e:
             from sentinel_tpu.core import clock as _clock
             from sentinel_tpu.core.log import record_log
+            from sentinel_tpu.metrics.stat_logger import log_block
 
+            # aggregated block log (EagleEyeLogUtil.log analog): every block
+            # lands in the rolling stat log keyed (resource, origin, rule)
+            log_block(resource.name, context.origin, type(e).__name__)
             sec = _clock.now_ms() // 1000
             key = resource.name
             if LogSlot._last_logged.get(key) != sec:
@@ -194,6 +198,10 @@ class StatisticSlot(ProcessorSlot):
                 context.cur_entry.origin_node.increase_thread()
             if resource.entry_type == EntryType.IN:
                 _entry_node().increase_thread()
+            # the borrow pre-paid the pass in the built-in counters, but
+            # extension sinks still observe it as a pass (the reference
+            # fires onPass in its PriorityWaitException catch too)
+            _ext.on_pass(resource.name, count, args)
             _ext.on_thread_inc(resource.name, args)
         except BlockException as e:
             context.cur_entry.block_error = e

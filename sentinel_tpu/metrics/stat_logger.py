@@ -1,0 +1,183 @@
+"""Rolling stat logger — the EagleEye analog.
+
+The reference embeds a high-throughput keyed stat logger
+(``eagleeye/EagleEye.java:25``, ``StatLogger.java:24,85``,
+``StatRollingData``, ``EagleEyeRollingFileAppender``, ``TokenBucket``) used
+for the block log (``slots/logger/EagleEyeLogUtil.java``) and the cluster
+server's stat logs (``ClusterServerStatLogUtil``). Model: callers ``stat()``
+keyed counters on the hot path; a time-window roll swaps the accumulation
+map and a writer thread appends one line per key to a size-rolled file:
+
+    timestamp|key1,key2|count          (count-only entries)
+    timestamp|key1,key2|count,total    (value entries, e.g. rt sums)
+
+Differences from the JVM design: accumulation is a dict under one lock
+instead of CHM+LongAdder (host Python is not the hot path here — the hot
+path is on-device; these logs serve the *control* plane), and the roll is
+driven lazily by writers plus an explicit ``flush()``, with time from the
+process clock so tests drive it with ``ManualClock``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.core.log import record_log
+
+
+def default_stat_log_dir() -> str:
+    return os.environ.get("SENTINEL_LOG_DIR") or os.path.expanduser("~/logs/csp")
+
+
+class RollingFileWriter:
+    """Append-only writer with size-based rolling (``EagleEyeRollingFileAppender``):
+    at ``max_bytes`` the file rotates to ``.1`` … ``.N`` (oldest dropped)."""
+
+    def __init__(self, path: str, max_bytes: int = 300 * 1024 * 1024,
+                 max_backups: int = 3):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.max_backups = max_backups
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def write_lines(self, lines: List[str]) -> None:
+        if not lines:
+            return
+        data = "".join(line + "\n" for line in lines)
+        with self._lock:
+            try:
+                if (
+                    os.path.exists(self.path)
+                    and os.path.getsize(self.path) + len(data) > self.max_bytes
+                ):
+                    self._roll()
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(data)
+            except OSError as e:
+                record_log.warning("stat log write failed: %s", e)
+
+    def _roll(self) -> None:
+        oldest = f"{self.path}.{self.max_backups}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_backups - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        if os.path.exists(self.path):
+            os.replace(self.path, f"{self.path}.1")
+
+
+class StatLogger:
+    """Keyed counter accumulation over fixed time windows.
+
+    ``stat(*key)`` adds to the current window; when a write lands in a new
+    window (or ``flush()`` is called) the previous window's map is sealed
+    and written out. ``max_entries`` bounds per-window cardinality the way
+    EagleEye's entry cap does — overflow keys are dropped and counted in a
+    ``__overflow__`` line rather than growing without bound.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        interval_ms: int = 1_000,
+        log_dir: Optional[str] = None,
+        max_bytes: int = 300 * 1024 * 1024,
+        max_backups: int = 3,
+        max_entries: int = 20_000,
+    ):
+        self.name = name
+        self.interval_ms = interval_ms
+        self.max_entries = max_entries
+        log_dir = log_dir or default_stat_log_dir()
+        self.writer = RollingFileWriter(
+            os.path.join(log_dir, f"{name}.log"), max_bytes, max_backups
+        )
+        self._lock = threading.Lock()
+        self._window_start = 0
+        self._data: Dict[Tuple[str, ...], List[float]] = {}
+        self._overflow = 0
+
+    def stat(self, *key: str, count: int = 1, value: Optional[float] = None):
+        now = _clock.now_ms()
+        start = now - now % self.interval_ms
+        sealed = None
+        with self._lock:
+            if start != self._window_start:
+                sealed = self._seal(start)
+            slot = self._data.get(key)
+            if slot is None:
+                if len(self._data) >= self.max_entries:
+                    self._overflow += count
+                    slot = None
+                else:
+                    slot = self._data[key] = [0.0, 0.0, value is not None]
+            if slot is not None:
+                slot[0] += count
+                if value is not None:
+                    slot[1] += value
+        if sealed:
+            self.writer.write_lines(sealed)
+
+    def _seal(self, new_start: int) -> List[str]:
+        """Format + clear the finished window. Caller holds the lock."""
+        lines = []
+        ts = self._window_start
+        for key, (count, total, has_value) in self._data.items():
+            joined = ",".join(key)
+            if has_value:
+                lines.append(f"{ts}|{joined}|{int(count)},{total:g}")
+            else:
+                lines.append(f"{ts}|{joined}|{int(count)}")
+        if self._overflow:
+            lines.append(f"{ts}|__overflow__|{self._overflow}")
+        self._data = {}
+        self._overflow = 0
+        self._window_start = new_start
+        return lines
+
+    def flush(self) -> None:
+        """Seal and write the current window immediately (shutdown/tests)."""
+        with self._lock:
+            sealed = self._seal(self._window_start)
+        self.writer.write_lines(sealed)
+
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, StatLogger] = {}
+
+
+def stat_logger(name: str, **kwargs) -> StatLogger:
+    """Process-wide named loggers (``EagleEye.statLoggerBuilder`` registry)."""
+    with _registry_lock:
+        logger = _registry.get(name)
+        if logger is None:
+            logger = _registry[name] = StatLogger(name, **kwargs)
+        return logger
+
+
+def reset_registry_for_tests() -> None:
+    with _registry_lock:
+        _registry.clear()
+
+
+# -- the two built-in stat logs -------------------------------------------
+
+BLOCK_LOG = "sentinel-block-record"  # EagleEyeLogUtil's block.log analog
+CLUSTER_LOG = "sentinel-cluster-server-stat"  # ClusterServerStatLogUtil
+
+
+def log_block(resource: str, origin: str, rule_type: str, count: int = 1):
+    """``EagleEyeLogUtil.log(resource, exceptionName, ruleLimitApp, origin,
+    count)`` — one aggregated line per (resource, origin, rule) per second."""
+    stat_logger(BLOCK_LOG).stat(resource, origin or "-", rule_type, count=count)
+
+
+def log_cluster(event: str, flow_id: int = -1, count: int = 1):
+    key = (event,) if flow_id < 0 else (event, str(flow_id))
+    stat_logger(CLUSTER_LOG).stat(*key, count=count)

@@ -1,0 +1,94 @@
+"""Rolling stat logger (EagleEye analog)."""
+
+import os
+
+import pytest
+
+from sentinel_tpu.metrics.stat_logger import (
+    RollingFileWriter,
+    StatLogger,
+    reset_registry_for_tests,
+    stat_logger,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    reset_registry_for_tests()
+    yield
+    reset_registry_for_tests()
+
+
+class TestStatLogger:
+    def test_window_aggregation_and_format(self, manual_clock, tmp_path):
+        lg = StatLogger("t", interval_ms=1000, log_dir=str(tmp_path))
+        base = manual_clock.now_ms() // 1000 * 1000
+        manual_clock.set_ms(base)
+        for _ in range(3):
+            lg.stat("res", "origin")
+        lg.stat("res2", "-", count=2)
+        lg.stat("rt", value=12.5)
+        lg.stat("rt", value=7.5)
+        manual_clock.advance(1000)  # next window: first write seals previous
+        lg.stat("res", "origin")
+        lg.flush()
+        lines = (tmp_path / "t.log").read_text().strip().splitlines()
+        assert f"{base}|res,origin|3" in lines
+        assert f"{base}|res2,-|2" in lines
+        assert f"{base}|rt|2,20" in lines
+        assert f"{base + 1000}|res,origin|1" in lines
+
+    def test_entry_cap_overflows_are_counted(self, manual_clock, tmp_path):
+        lg = StatLogger("cap", interval_ms=1000, log_dir=str(tmp_path),
+                        max_entries=2)
+        base = manual_clock.now_ms() // 1000 * 1000
+        manual_clock.set_ms(base)
+        for i in range(5):
+            lg.stat(f"k{i}")
+        lg.flush()
+        text = (tmp_path / "cap.log").read_text()
+        assert f"{base}|k0|1" in text
+        assert f"{base}|k1|1" in text
+        assert f"{base}|__overflow__|3" in text
+
+    def test_registry_returns_same_instance(self, tmp_path):
+        a = stat_logger("same", log_dir=str(tmp_path))
+        b = stat_logger("same", log_dir=str(tmp_path))
+        assert a is b
+
+
+class TestRollingFileWriter:
+    def test_size_roll_with_backups(self, tmp_path):
+        path = str(tmp_path / "roll.log")
+        w = RollingFileWriter(path, max_bytes=40, max_backups=2)
+        w.write_lines(["a" * 30])
+        w.write_lines(["b" * 30])  # rolls: roll.log.1 = a's
+        w.write_lines(["c" * 30])  # rolls again: .2 = a's, .1 = b's
+        assert "c" in open(path).read()
+        assert "b" in open(path + ".1").read()
+        assert "a" in open(path + ".2").read()
+        w.write_lines(["d" * 30])  # oldest (a) dropped
+        assert not os.path.exists(path + ".3")
+        assert "b" in open(path + ".2").read()
+
+
+class TestBlockLogWiring:
+    def test_blocks_land_in_stat_log(self, manual_clock, tmp_path, monkeypatch):
+        monkeypatch.setenv("SENTINEL_LOG_DIR", str(tmp_path))
+        from sentinel_tpu import local as sentinel
+        from sentinel_tpu.local import BlockException
+        from sentinel_tpu.local.chain import reset_cluster_nodes_for_tests
+        from sentinel_tpu.local.flow import FlowRule, FlowRuleManager
+
+        reset_cluster_nodes_for_tests()
+        FlowRuleManager.load_rules([FlowRule(resource="api", count=0.0)])
+        try:
+            with pytest.raises(BlockException):
+                with sentinel.entry("api"):
+                    pass
+            stat_logger("sentinel-block-record").flush()
+            text = (tmp_path / "sentinel-block-record.log").read_text()
+            assert "api,-,FlowException" in text
+        finally:
+            FlowRuleManager.load_rules([])
+            reset_cluster_nodes_for_tests()

@@ -1,0 +1,40 @@
+"""Launch a live guarded app + dashboard for browser verification."""
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys, tempfile, threading, time
+
+import sentinel_tpu.metrics.log as mlog
+tmp = tempfile.mkdtemp()
+mlog.default_metric_dir = lambda: tmp
+
+from sentinel_tpu import local as sentinel
+from sentinel_tpu.local import BlockException
+from sentinel_tpu.local.flow import FlowRule, FlowRuleManager
+from sentinel_tpu.metrics.log import MetricTimer, MetricWriter
+from sentinel_tpu.transport.command import CommandCenter
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+from sentinel_tpu.dashboard.server import DashboardServer
+
+dash = DashboardServer(port=18081, fetch_interval_s=0.5).start()
+cc = CommandCenter(port=0).start()
+timer = MetricTimer(MetricWriter(base_dir=tmp), interval_s=0.5)
+timer.start()
+FlowRuleManager.load_rules([FlowRule(resource="GET:/checkout", count=30.0)])
+hb = HeartbeatSender(dashboard_addrs=["127.0.0.1:18081"], command_port=cc.port,
+                     interval_ms=500, client_ip="127.0.0.1")
+hb.start()
+
+
+def traffic():
+    while True:
+        for _ in range(50):
+            try:
+                with sentinel.entry("GET:/checkout"):
+                    pass
+            except BlockException:
+                pass
+        time.sleep(1.0)
+
+
+threading.Thread(target=traffic, daemon=True).start()
+print(f"READY dash=http://127.0.0.1:18081 cc={cc.port}", flush=True)
+time.sleep(600)

@@ -28,11 +28,24 @@ _client: Optional[TokenService] = None
 _embedded: Optional[TokenService] = None
 
 
+def _close_quietly(service) -> None:
+    close = getattr(service, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:
+            pass
+
+
 def set_client(client: TokenService) -> None:
     global _client, _mode
     with _lock:
-        _client = client
+        prev, _client = _client, client
         _mode = ClusterMode.CLIENT
+    # a replaced client holds a socket + reader thread; reassignment (e.g.
+    # the dashboard re-pointing the fleet) must not leak one per swap
+    if prev is not None and prev is not client:
+        _close_quietly(prev)
 
 
 def set_embedded_server(service: TokenService) -> None:
@@ -64,9 +77,11 @@ def _pick_service() -> Optional[TokenService]:
 def reset_for_tests() -> None:
     global _mode, _client, _embedded
     with _lock:
+        prev_client, _client = _client, None
         _mode = ClusterMode.NOT_STARTED
-        _client = None
         _embedded = None
+    if prev_client is not None:
+        _close_quietly(prev_client)
 
 
 # -- called from sentinel_tpu.local.flow ------------------------------------

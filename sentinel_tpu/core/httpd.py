@@ -8,6 +8,7 @@ services; this module owns the one copy of the handler/lifecycle plumbing
 
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -19,7 +20,10 @@ from sentinel_tpu.core.log import record_log
 # (status code, body text, content type)
 Response = Tuple[int, str, str]
 
-# (method, path-without-leading-slash, query params, body) -> Response
+# (method, path-without-leading-slash, query params, body) -> Response.
+# A router declaring a parameter named ``headers`` (or **kwargs) also
+# receives the request headers as a keyword (an email.message.Message-like
+# mapping) — used for cookie-based auth.
 Router = Callable[[str, str, dict, str], Response]
 
 MAX_BODY_BYTES = 4 * 1024 * 1024  # rule payloads are small; cap abuse
@@ -47,6 +51,16 @@ class HttpService:
     def start(self) -> "HttpService":
         router = self.router
         name = self.name
+        # headers are passed as an opt-in KEYWORD, detected by name — a
+        # positional count would misfire on variadic or defaulted routers
+        try:
+            sig_params = inspect.signature(router).parameters
+            wants_headers = "headers" in sig_params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig_params.values()
+            )
+        except (TypeError, ValueError):  # builtins/partials w/o signature
+            wants_headers = False
 
         class Handler(BaseHTTPRequestHandler):
             server_version = "SentinelTPU"
@@ -55,18 +69,24 @@ class HttpService:
                 parsed = urlparse(self.path)
                 params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
                 try:
-                    code, text, ctype = router(
-                        method, parsed.path.strip("/"), params, body
-                    )
+                    args = (method, parsed.path.strip("/"), params, body)
+                    if wants_headers:
+                        result = router(*args, headers=self.headers)
+                    else:
+                        result = router(*args)
                 except Exception as e:
                     record_log.exception("%s request failed", name)
-                    code, text, ctype = json_response(
-                        500, json.dumps({"error": str(e)})
-                    )
+                    result = json_response(500, json.dumps({"error": str(e)}))
+                # routers may append a 4th element: extra response headers
+                # (e.g. Set-Cookie for the dashboard login)
+                code, text, ctype = result[:3]
+                extra = result[3] if len(result) > 3 else {}
                 data = text.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -80,6 +80,31 @@ class ApiClient:
             record_log.warning("bad rules payload from %s", machine.key)
             return None
 
+    def get_cluster_mode(self, machine: MachineInfo) -> Optional[int]:
+        raw = self._get(machine, "getClusterMode", {})
+        if raw is None:
+            return None
+        try:
+            return int(json.loads(raw).get("mode", -1))
+        except (ValueError, AttributeError):
+            return None
+
+    def set_cluster_mode(
+        self, machine: MachineInfo, mode: int, token_port: Optional[int] = None
+    ) -> bool:
+        params = {"mode": str(mode)}
+        if token_port is not None:
+            params["tokenPort"] = str(token_port)
+        return self._get(machine, "setClusterMode", params) is not None
+
+    def push_cluster_client_config(
+        self, machine: MachineInfo, server_host: str, server_port: int
+    ) -> bool:
+        body = json.dumps(
+            {"serverHost": server_host, "serverPort": server_port}
+        )
+        return self._post(machine, "cluster/client/modifyConfig", {}, body) is not None
+
     def push_rules(self, machine: MachineInfo, rule_type: str, rules: list) -> bool:
         rsp = self._post(
             machine, "setRules", {"type": rule_type}, json.dumps(rules)

@@ -74,3 +74,14 @@ class AppManagement:
     def remove_app(self, app: str) -> None:
         with self._lock:
             self._apps.pop(app, None)
+
+    def remove_machine(self, app: str, ip: str, port: int) -> bool:
+        """Deregister one machine; drops the app when it was the last one."""
+        key = f"{ip}:{port}"
+        with self._lock:
+            machines = self._apps.get(app)
+            if machines is None or machines.pop(key, None) is None:
+                return False
+            if not machines:
+                self._apps.pop(app, None)
+            return True

@@ -9,7 +9,10 @@ threading HTTP server — the console is an ops tool, not a hot path.
 
 from __future__ import annotations
 
+import hmac
 import json
+import secrets
+from typing import Optional, Tuple
 
 from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.httpd import (
@@ -23,7 +26,13 @@ from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
 from sentinel_tpu.dashboard.fetcher import MetricFetcher
 from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
 
-RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow")
+RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow", "gateway")
+
+# Paths reachable without a session when auth is enabled: machine heartbeats
+# (apps can't log in) and the login exchange itself + the console shell,
+# which renders a login form client-side (same exclusions as the
+# reference's LoginAuthenticationFilter).
+AUTH_EXEMPT = {"registry/machine", "auth/login", "", "index.html"}
 
 _INDEX_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>sentinel-tpu console</title>
@@ -36,40 +45,117 @@ _INDEX_HTML = """<!doctype html>
  code{background:#f0f0f0;padding:0 .3rem}
 </style></head><body>
 <h1>sentinel-tpu console</h1>
+<div id="login" style="display:none">
+ <h2>login</h2>
+ <input id="u" placeholder="username"> <input id="p" type="password"
+  placeholder="password"> <button onclick="login()">sign in</button>
+ <span id="loginmsg" class="dead"></span>
+</div>
 <div id="apps"></div>
+<div id="ruled" style="display:none">
+ <h2>rules: <span id="ruleapp"></span></h2>
+ <select id="ruletype"></select>
+ <button onclick="loadRules()">load</button>
+ <button onclick="pushRules()">push to app</button>
+ <span id="rulemsg"></span><br>
+ <textarea id="rulebox" rows="14" cols="100" spellcheck="false"></textarea>
+</div>
 <script>
 // resource names and machine fields are attacker-influenced (a resource is
 // often a raw request path) — build rows with textContent only, never
 // string-interpolated HTML
+const RULE_TYPES = ['flow','degrade','system','authority','paramFlow','gateway'];
 function row(table, cells, tag){
   const tr = document.createElement('tr');
   for (const c of cells){
     const td = document.createElement(tag || 'td');
-    if (c && c.cls) { td.textContent = c.text; td.className = c.cls; }
+    if (c && c.nodeType) td.appendChild(c);
+    else if (c && c.cls) { td.textContent = c.text; td.className = c.cls; }
     else td.textContent = c;
     tr.appendChild(td);
   }
   table.appendChild(tr);
 }
+async function api(path){
+  const r = await fetch(path);
+  if (r.status === 401){ showLogin(); throw new Error('auth'); }
+  return r.json();
+}
+function showLogin(){ document.getElementById('login').style.display=''; }
+async function login(){
+  const body = JSON.stringify({username: u.value, password: p.value});
+  const r = await fetch('auth/login', {method:'POST', body});
+  if (r.status === 200){ login_el().style.display='none'; refresh(); }
+  else document.getElementById('loginmsg').textContent = 'invalid credentials';
+}
+function login_el(){ return document.getElementById('login'); }
+function openRules(app){
+  document.getElementById('ruled').style.display='';
+  document.getElementById('ruleapp').textContent = app;
+  const sel = document.getElementById('ruletype');
+  if (!sel.options.length)
+    for (const t of RULE_TYPES){
+      const o = document.createElement('option'); o.textContent = t; sel.appendChild(o);
+    }
+  loadRules();
+}
+async function loadRules(){
+  const app = document.getElementById('ruleapp').textContent;
+  const t = document.getElementById('ruletype').value;
+  const rules = await api(`rules?app=${encodeURIComponent(app)}&type=${encodeURIComponent(t)}`);
+  document.getElementById('rulebox').value = JSON.stringify(rules, null, 2);
+}
+async function pushRules(){
+  const app = document.getElementById('ruleapp').textContent;
+  const t = document.getElementById('ruletype').value;
+  let parsed;
+  try { parsed = JSON.parse(document.getElementById('rulebox').value); }
+  catch(e){ document.getElementById('rulemsg').textContent = 'invalid JSON'; return; }
+  const r = await fetch(`rules?app=${encodeURIComponent(app)}&type=${encodeURIComponent(t)}`,
+    {method:'POST', body: JSON.stringify(parsed)});
+  document.getElementById('rulemsg').textContent = JSON.stringify(await r.json());
+}
+async function assign(app, machine){
+  const r = await fetch(`cluster/assign?app=${encodeURIComponent(app)}`,
+    {method:'POST', body: JSON.stringify({server: machine})});
+  alert(JSON.stringify(await r.json())); refresh();
+}
+const MODES = {'-1':'off','0':'client','1':'server'};
 async function refresh(){
-  const apps = await (await fetch('apps')).json();
+  let apps;
+  try { apps = await api('apps'); } catch(e){ return; }
   const root = document.getElementById('apps');
   root.innerHTML = '';
   for (const app of apps){
-    const h = document.createElement('h2'); h.textContent = app.name; root.appendChild(h);
+    const h = document.createElement('h2'); h.textContent = app.name;
+    const btn = document.createElement('button');
+    btn.textContent = 'rules'; btn.style.marginLeft = '1rem';
+    btn.onclick = () => openRules(app.name);
+    h.appendChild(btn); root.appendChild(h);
+    let modes = {};
+    try {
+      for (const s of await api('cluster/state?app='+encodeURIComponent(app.name)))
+        modes[s.machine] = s.mode;
+    } catch(e){}
     const mt = document.createElement('table');
-    row(mt, ['machine', 'version', 'status'], 'th');
-    for (const m of app.machines)
-      row(mt, [`${m.ip}:${m.port}`, m.version,
-               {text: m.healthy?'healthy':'dead', cls: m.healthy?'ok':'dead'}]);
+    row(mt, ['machine', 'version', 'status', 'cluster', ''], 'th');
+    for (const m of app.machines){
+      const key = `${m.ip}:${m.port}`;
+      const abtn = document.createElement('button');
+      abtn.textContent = 'make token server';
+      abtn.onclick = () => assign(app.name, key);
+      row(mt, [key, m.version,
+               {text: m.healthy?'healthy':'dead', cls: m.healthy?'ok':'dead'},
+               MODES[String(modes[key])] ?? '?', abtn]);
+    }
     root.appendChild(mt);
-    const res = await (await fetch('resources?app='+encodeURIComponent(app.name))).json();
+    const res = await api('resources?app='+encodeURIComponent(app.name));
     const rt = document.createElement('table');
     row(rt, ['resource', 'pass qps', 'block qps', 'rt ms'], 'th');
     const now = Date.now();
     for (const r of res){
-      const ms = await (await fetch(`metric?app=${encodeURIComponent(app.name)}` +
-        `&identity=${encodeURIComponent(r)}&startTime=${now-15000}&endTime=${now}`)).json();
+      const ms = await api(`metric?app=${encodeURIComponent(app.name)}` +
+        `&identity=${encodeURIComponent(r)}&startTime=${now-15000}&endTime=${now}`);
       const last = ms[ms.length-1] || {};
       row(rt, [r, last.passQps??'', last.blockQps??'', last.rt??'']);
     }
@@ -86,13 +172,25 @@ class DashboardServer:
         host: str = "127.0.0.1",
         port: int = 8080,
         fetch_interval_s: float = 1.0,
+        auth: Optional[Tuple[str, str]] = None,
     ):
+        """``auth=(username, password)`` enables login (the reference's
+        ``sentinel.dashboard.auth.username/password`` simple auth); default
+        is open access, matching the reference's default ``sentinel/sentinel``
+        stance for dev use."""
         self.apps = AppManagement()
         self.repository = InMemoryMetricsRepository()
         self.client = ApiClient()
         self.fetcher = MetricFetcher(
             self.apps, self.repository, self.client, fetch_interval_s
         )
+        self.auth = auth
+        # token → expiry-ms; bounded and TTL'd (an unbounded forever-valid
+        # session set would grow with every login and keep stolen cookies
+        # alive until restart)
+        self._sessions: dict = {}
+        self.session_ttl_ms = 24 * 3600 * 1000
+        self.max_sessions = 1000
         self._service = HttpService(
             self._respond, host, port, name="sentinel-dashboard"
         )
@@ -105,8 +203,57 @@ class DashboardServer:
     def port(self) -> int:
         return self._service.port
 
+    # -- auth ----------------------------------------------------------------
+    def _session_of(self, headers) -> Optional[str]:
+        cookie = headers.get("Cookie", "") if headers is not None else ""
+        now = _clock.now_ms()
+        for part in cookie.split(";"):
+            k, _, v = part.strip().partition("=")
+            if k == "sentinel_session":
+                expiry = self._sessions.get(v)
+                if expiry is not None and expiry > now:
+                    return v
+                self._sessions.pop(v, None)  # expired
+        return None
+
+    def _login(self, params: dict, body: str):
+        data = json.loads(body) if body else dict(params)
+        user, password = self.auth
+        if not (
+            hmac.compare_digest(str(data.get("username", "")), user)
+            and hmac.compare_digest(str(data.get("password", "")), password)
+        ):
+            return (401, json.dumps({"error": "invalid credentials"}),
+                    "application/json; charset=utf-8")
+        token = secrets.token_urlsafe(24)
+        now = _clock.now_ms()
+        self._sessions = {
+            t: exp for t, exp in self._sessions.items() if exp > now
+        }
+        while len(self._sessions) >= self.max_sessions:
+            self._sessions.pop(next(iter(self._sessions)))  # oldest first
+        self._sessions[token] = now + self.session_ttl_ms
+        return (
+            200,
+            json.dumps({"code": 0}),
+            "application/json; charset=utf-8",
+            {"Set-Cookie": f"sentinel_session={token}; HttpOnly; Path=/"},
+        )
+
     # -- request handling ----------------------------------------------------
-    def _respond(self, method: str, path: str, params: dict, body: str) -> Response:
+    def _respond(
+        self, method: str, path: str, params: dict, body: str, headers=None
+    ) -> Response:
+        if self.auth is not None:
+            if method == "POST" and path == "auth/login":
+                return self._login(params, body)
+            if method == "POST" and path == "auth/logout":
+                token = self._session_of(headers)
+                if token is not None:
+                    self._sessions.pop(token, None)
+                return json_response(200, json.dumps({"code": 0}))
+            if path not in AUTH_EXEMPT and self._session_of(headers) is None:
+                return json_response(401, json.dumps({"error": "login required"}))
         result = self._route(method, path, params, body)
         if result is None:
             return json_response(404, json.dumps({"error": "not found"}))
@@ -160,6 +307,59 @@ class DashboardServer:
                 )
                 return {"pushed": pushed, "machines": len(machines)}
             return self.client.fetch_rules(machines[0], rule_type)
+        if method == "POST" and path == "machine/remove":
+            # per-machine deregistration; ip+port name the machine
+            removed = self.apps.remove_machine(
+                params.get("app", ""), params.get("ip", ""),
+                int(params.get("port", 0)),
+            )
+            return {"code": 0 if removed else 1}
+        if method == "POST" and path == "app/remove":
+            self.apps.remove_app(params.get("app", ""))
+            return {"code": 0}
+        if path == "cluster/state":
+            # per-machine cluster mode snapshot (ClusterAssignController's
+            # read side): -1 off, 0 client, 1 server, null unreachable
+            app = params.get("app", "")
+            return [
+                {
+                    "machine": m.key,
+                    "ip": m.ip,
+                    "port": m.port,
+                    "mode": self.client.get_cluster_mode(m),
+                }
+                for m in self.apps.healthy_machines(app)
+            ]
+        if method == "POST" and path == "cluster/assign":
+            # one-shot assignment (ClusterAssignServiceImpl analog): flip the
+            # chosen machine to server mode, everything else to client mode
+            # pointed at it
+            data = json.loads(body) if body else {}
+            app = params.get("app", "") or data.get("app", "")
+            server_key = data.get("server", "")
+            token_port = int(data.get("tokenPort", 18730))
+            machines = self.apps.healthy_machines(app)
+            server = next((m for m in machines if m.key == server_key), None)
+            if server is None:
+                return {"error": f"machine {server_key} not found/healthy"}
+            if not self.client.set_cluster_mode(server, 1, token_port):
+                # abort BEFORE touching clients: re-pointing the fleet at a
+                # machine that failed to become a server would break every
+                # cluster check at once
+                return {"error": f"promoting {server_key} to token server "
+                        "failed; no clients were reconfigured"}
+            results = {"server": True, "clients": 0, "failed": []}
+            for m in machines:
+                if m.key == server_key:
+                    continue
+                ok = self.client.push_cluster_client_config(
+                    m, server.ip, token_port
+                ) and self.client.set_cluster_mode(m, 0)
+                if ok:
+                    results["clients"] += 1
+                else:
+                    results["failed"].append(m.key)
+            return results
         if path in ("", "index.html"):
             return _INDEX_HTML
         return None

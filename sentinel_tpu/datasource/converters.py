@@ -193,3 +193,71 @@ def param_flow_rules_to_json(rules: List[ParamFlowRule]) -> str:
         ],
         indent=2,
     )
+
+
+def gateway_flow_rules_from_json(text: str):
+    """Gateway rule schema mirrors ``GatewayFlowRule.java`` field names (what
+    the reference dashboard's gateway UI exchanges)."""
+    from sentinel_tpu.adapters.gateway import (
+        GatewayFlowRule,
+        GatewayParamFlowItem,
+        MatchStrategy,
+        ParseStrategy,
+        ResourceMode,
+    )
+
+    out = []
+    for d in json.loads(text) or []:
+        item = d.get("paramItem")
+        out.append(
+            GatewayFlowRule(
+                resource=d["resource"],
+                resource_mode=ResourceMode(d.get("resourceMode", 0)),
+                count=float(d.get("count", 0)),
+                grade=FlowGrade(d.get("grade", 1)),
+                interval_sec=int(d.get("intervalSec", 1)),
+                control_behavior=ControlBehavior(d.get("controlBehavior", 0)),
+                burst=int(d.get("burst", 0)),
+                max_queueing_time_ms=int(d.get("maxQueueingTimeoutMs", 500)),
+                param_item=(
+                    GatewayParamFlowItem(
+                        parse_strategy=ParseStrategy(item.get("parseStrategy", 0)),
+                        field_name=item.get("fieldName"),
+                        pattern=item.get("pattern"),
+                        match_strategy=MatchStrategy(item.get("matchStrategy", 0)),
+                    )
+                    if item
+                    else None
+                ),
+            )
+        )
+    return out
+
+
+def gateway_flow_rules_to_json(rules) -> str:
+    return json.dumps(
+        [
+            {
+                "resource": r.resource,
+                "resourceMode": int(r.resource_mode),
+                "count": r.count,
+                "grade": int(r.grade),
+                "intervalSec": r.interval_sec,
+                "controlBehavior": int(r.control_behavior),
+                "burst": r.burst,
+                "maxQueueingTimeoutMs": r.max_queueing_time_ms,
+                "paramItem": (
+                    {
+                        "parseStrategy": int(r.param_item.parse_strategy),
+                        "fieldName": r.param_item.field_name,
+                        "pattern": r.param_item.pattern,
+                        "matchStrategy": int(r.param_item.match_strategy),
+                    }
+                    if r.param_item
+                    else None
+                ),
+            }
+            for r in rules
+        ],
+        indent=2,
+    )

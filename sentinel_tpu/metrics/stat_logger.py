@@ -116,11 +116,12 @@ class StatLogger:
                     self._overflow += count
                     slot = None
                 else:
-                    slot = self._data[key] = [0.0, 0.0, value is not None]
+                    slot = self._data[key] = [0.0, 0.0, False]
             if slot is not None:
                 slot[0] += count
                 if value is not None:
                     slot[1] += value
+                    slot[2] = True  # any valued stat upgrades the line format
         if sealed:
             self.writer.write_lines(sealed)
 

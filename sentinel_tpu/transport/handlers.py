@@ -60,7 +60,24 @@ _RULE_TYPES = {
         conv.param_flow_rules_from_json,
         ParamFlowRuleManager.load_rules,
     ),
+    "gateway": (
+        lambda: conv.gateway_flow_rules_to_json(_gateway_rules()),
+        conv.gateway_flow_rules_from_json,
+        lambda rules: _gateway_manager().load_rules(rules),
+    ),
 }
+
+
+def _gateway_manager():
+    from sentinel_tpu.adapters.gateway import GatewayRuleManager
+
+    return GatewayRuleManager
+
+
+def _gateway_rules():
+    return [
+        r for lst in _gateway_manager()._rules.values() for r in lst
+    ]
 
 
 @command_mapping("version", "framework version")
@@ -81,7 +98,7 @@ def cmd_basic_info(params, body):
     }
 
 
-@command_mapping("getRules", "get active rules; type=flow|degrade|system|authority|paramFlow")
+@command_mapping("getRules", "get active rules; type=flow|degrade|system|authority|paramFlow|gateway")
 def cmd_get_rules(params, body):
     rtype = params.get("type", "flow")
     if rtype not in _RULE_TYPES:
@@ -203,13 +220,70 @@ def cmd_get_cluster_mode(params, body):
     return {"mode": int(cluster_api.get_mode())}
 
 
-@command_mapping("setClusterMode", "switch cluster state; mode=0|1")
+_EMBEDDED_SERVER = {"server": None}
+
+
+@command_mapping(
+    "setClusterMode", "switch cluster state; mode=-1|0|1 [&tokenPort=18730]"
+)
 def cmd_set_cluster_mode(params, body):
+    """Mode 1 actually provisions the embedded token server (transport +
+    device service) and registers it — the analog of
+    ``ModifyClusterModeCommandHandler`` → ``DefaultEmbeddedTokenServer``
+    start. Leaving server mode stops it."""
     from sentinel_tpu.cluster import api as cluster_api
 
     mode = int(params.get("mode", -1))
+    prev = _EMBEDDED_SERVER["server"]
+    if mode == int(cluster_api.ClusterMode.SERVER):
+        if prev is None:
+            from sentinel_tpu.cluster.server import TokenServer
+            from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+            server = TokenServer(
+                DefaultTokenService(),
+                host="0.0.0.0",
+                port=int(params.get("tokenPort", 18730)),
+            )
+            server.start()
+            _EMBEDDED_SERVER["server"] = server
+        cluster_api.set_embedded_server(_EMBEDDED_SERVER["server"].service)
+        return "success"
+    if prev is not None:
+        _EMBEDDED_SERVER["server"] = None
+        prev.stop()
     cluster_api.set_mode(cluster_api.ClusterMode(mode))
     return "success"
+
+
+@command_mapping(
+    "cluster/client/modifyConfig", "point the token client at a server; data={serverHost, serverPort}"
+)
+def cmd_cluster_client_modify_config(params, body):
+    """``ModifyClusterClientConfigHandler`` analog: (re)install the global
+    token client against the assigned server address."""
+    from sentinel_tpu.cluster import api as cluster_api
+    from sentinel_tpu.cluster.client import TokenClient
+
+    data = json.loads(body) if body else params
+    host = data.get("serverHost")
+    port = int(data.get("serverPort", 0))
+    if not host or not port:
+        return {"error": "serverHost and serverPort required"}
+    timeout_ms = int(data.get("requestTimeout", 20))
+    cluster_api.set_client(TokenClient(host, port, timeout_ms=timeout_ms))
+    _CLUSTER_CLIENT_CONFIG.update(
+        serverHost=host, serverPort=port, requestTimeout=timeout_ms
+    )
+    return "success"
+
+
+_CLUSTER_CLIENT_CONFIG: dict = {}
+
+
+@command_mapping("cluster/client/fetchConfig", "current token-client assignment")
+def cmd_cluster_client_fetch_config(params, body):
+    return dict(_CLUSTER_CLIENT_CONFIG)
 
 
 @command_mapping("cluster/server/metrics", "token-server per-flow metrics")

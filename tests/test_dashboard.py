@@ -184,6 +184,169 @@ def _get(port: int, path: str):
         return json.loads(r.read().decode())
 
 
+def _post(port: int, path: str, payload, cookie=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Cookie": cookie} if cookie else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read().decode()), r.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}"), e.headers
+
+
+class TestAuth:
+    def test_login_gates_api(self):
+        import urllib.error
+
+        dash = DashboardServer(port=0, auth=("admin", "s3cret")).start()
+        try:
+            # unauthenticated API access → 401; console shell stays open
+            try:
+                _get(dash.port, "apps")
+                assert False, "expected 401"
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/", timeout=5
+            ) as r:
+                assert b"login" in r.read()
+            # bad credentials rejected
+            code, _, _ = _post(dash.port, "auth/login",
+                               {"username": "admin", "password": "nope"})
+            assert code == 401
+            # good credentials → session cookie → API opens up
+            code, _, headers = _post(dash.port, "auth/login",
+                                     {"username": "admin", "password": "s3cret"})
+            assert code == 200
+            cookie = headers["Set-Cookie"].split(";")[0]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dash.port}/apps",
+                headers={"Cookie": cookie},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 200
+            # heartbeat endpoint stays open for apps
+            code, body, _ = _post(dash.port, "registry/machine",
+                                  {"app": "a", "ip": "1.2.3.4", "port": 1})
+            assert code == 200 and body["code"] == 0
+        finally:
+            dash.stop()
+
+
+class TestClusterAssign:
+    def test_assign_flips_modes_and_points_clients(self, manual_clock):
+        from sentinel_tpu.transport.command import CommandCenter
+        from sentinel_tpu.transport import handlers as _h  # register commands
+        from sentinel_tpu.cluster import api as cluster_api
+
+        cluster_api.reset_for_tests()
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            _post(dash.port, "registry/machine",
+                  {"app": "svc", "ip": "127.0.0.1", "port": cc.port})
+            state = _get(dash.port, "cluster/state?app=svc")
+            assert state[0]["mode"] == -1  # off
+            code, result, _ = _post(
+                dash.port, "cluster/assign?app=svc",
+                {"server": f"127.0.0.1:{cc.port}", "tokenPort": 28731},
+            )
+            assert code == 200 and result["server"] is True
+            state = _get(dash.port, "cluster/state?app=svc")
+            assert state[0]["mode"] == 1  # the one machine became the server
+            # mode 1 actually provisioned a listening token server
+            from sentinel_tpu.cluster.client import TokenClient
+            from sentinel_tpu.engine import TokenStatus
+
+            tc = TokenClient("127.0.0.1", 28731, timeout_ms=2000)
+            res = tc.request_token(12345)  # no rule loaded
+            assert res.status == TokenStatus.NO_RULE_EXISTS
+            tc.close()
+            # switching away stops it
+            _get(dash.port, "apps")  # keep dash alive
+            _get_cc = _get(cc.port, "setClusterMode?mode=-1")
+            assert cluster_api.get_mode() == cluster_api.ClusterMode.NOT_STARTED
+        finally:
+            from sentinel_tpu.transport.handlers import _EMBEDDED_SERVER
+
+            srv = _EMBEDDED_SERVER.pop("server", None)
+            _EMBEDDED_SERVER["server"] = None
+            if srv is not None:
+                srv.stop()
+            cc.stop()
+            dash.stop()
+            cluster_api.reset_for_tests()
+
+    def test_assign_aborts_when_server_unreachable(self, manual_clock):
+        dash = DashboardServer(port=0).start()
+        try:
+            # register a machine whose command port is dead
+            _post(dash.port, "registry/machine",
+                  {"app": "svc", "ip": "127.0.0.1", "port": 1})
+            code, result, _ = _post(
+                dash.port, "cluster/assign?app=svc",
+                {"server": "127.0.0.1:1"},
+            )
+            assert code == 200 and "error" in result
+        finally:
+            dash.stop()
+
+
+class TestMachineRemoval:
+    def test_remove_single_machine_then_app(self):
+        dash = DashboardServer(port=0).start()
+        try:
+            _post(dash.port, "registry/machine",
+                  {"app": "svc", "ip": "10.0.0.1", "port": 1})
+            _post(dash.port, "registry/machine",
+                  {"app": "svc", "ip": "10.0.0.2", "port": 1})
+            code, body, _ = _post(
+                dash.port, "machine/remove?app=svc&ip=10.0.0.1&port=1", {})
+            assert body["code"] == 0
+            apps = _get(dash.port, "apps")
+            assert len(apps[0]["machines"]) == 1
+            # removing the last machine drops the app
+            _post(dash.port, "machine/remove?app=svc&ip=10.0.0.2&port=1", {})
+            assert _get(dash.port, "apps") == []
+        finally:
+            dash.stop()
+
+
+class TestGatewayRuleRoundTrip:
+    def test_get_set_via_command_center(self):
+        from sentinel_tpu.transport.command import CommandCenter
+        from sentinel_tpu.transport import handlers as _h  # register commands
+        from sentinel_tpu.adapters.gateway import GatewayRuleManager
+
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            rules = [{
+                "resource": "route-a", "resourceMode": 0, "count": 5.0,
+                "grade": 1, "intervalSec": 1, "controlBehavior": 0,
+                "burst": 2, "maxQueueingTimeoutMs": 500,
+                "paramItem": {"parseStrategy": 0, "fieldName": None,
+                              "pattern": None, "matchStrategy": 0},
+            }]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{cc.port}/setRules?type=gateway",
+                data=json.dumps(rules).encode(),
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 200
+            assert GatewayRuleManager.rules_for("route-a")
+            got = _get(cc.port, "getRules?type=gateway")
+            assert got[0]["resource"] == "route-a"
+            assert got[0]["burst"] == 2
+        finally:
+            GatewayRuleManager.load_rules([])
+            cc.stop()
+
+
 class TestEndToEnd:
     def test_full_pull_pipeline(self):
         """app (command center + metric log + heartbeat) → dashboard."""

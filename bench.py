@@ -8,20 +8,59 @@ Baseline: the reference token server's default per-namespace self-protection
 cap of 30,000 decisions/s (``ServerFlowConfig.java:31``) — its own statement
 of per-server scale (BASELINE.md). The north-star target is ≥10M/s across a
 v5e-8, i.e. ≥1.25M/s per chip.
+
+Robustness (round-1 lesson: the TPU backend can fail or hang at init, and a
+monolithic run then records nothing): the parent process never imports jax.
+It ladders through measurement configs — full TPU shape, reduced TPU shape,
+CPU fallback — each in a child process under a hard timeout, and ALWAYS
+prints exactly one JSON line, even if every attempt dies.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-from functools import partial
 
-import numpy as np
+BASELINE_QPS = 30_000.0  # reference maxAllowedQps per namespace/server
+METRIC = "flow_decisions_per_sec_per_chip_at_100k_rules"
+
+# (name, child-config, timeout_s). The ladder keeps 100k rules as long as
+# possible (the metric is *at 100k rules*); only the batch geometry shrinks.
+ATTEMPTS = [
+    ("tpu-full", dict(platform="tpu", n_flows=100_000, batch=16384, chain=64,
+                      repeats=5), 480),
+    ("tpu-reduced", dict(platform="tpu", n_flows=100_000, batch=8192, chain=16,
+                         repeats=3), 240),
+    ("cpu-fallback", dict(platform="cpu", n_flows=100_000, batch=4096, chain=8,
+                          repeats=3), 180),
+]
 
 
-def main() -> None:
+def _measure(cfg: dict) -> None:
+    """Child: run one measurement and print a JSON line."""
+    if cfg["platform"] == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import jax
     import jax.numpy as jnp
+    import numpy as np
+
+    # Backend init can fail transiently (round-1: "Unable to initialize
+    # backend 'axon'") — bounded retry before giving up on this config.
+    last = None
+    for attempt in range(3):
+        try:
+            dev = jax.devices()[0]
+            break
+        except Exception as e:  # pragma: no cover - env dependent
+            last = e
+            time.sleep(5.0)
+    else:
+        raise RuntimeError(f"backend init failed after retries: {last}")
 
     from sentinel_tpu.engine import (
         ClusterFlowRule,
@@ -34,11 +73,10 @@ def main() -> None:
     from sentinel_tpu.engine.decide import _decide_core
     from sentinel_tpu.engine.rules import ThresholdMode
 
-    n_flows = 100_000
+    n_flows = cfg["n_flows"]
     config = EngineConfig(
-        max_flows=n_flows, max_namespaces=64, batch_size=16384
+        max_flows=n_flows, max_namespaces=64, batch_size=cfg["batch"]
     )
-
     rules = [
         ClusterFlowRule(
             flow_id=i,
@@ -56,20 +94,17 @@ def main() -> None:
     # a chain of batches inside ONE dispatch (also sidesteps the ~100ms
     # per-dispatch latency of the remote-tunnel dev setup, which a
     # co-located server would not pay).
-    chain = 64  # batches per dispatch
+    chain = cfg["chain"]
 
     def chained(state, stacked_batches, now0):
         def body(carry, xs):
             st, now = carry
-            batch = xs
             st, verdicts = _decide_core(
-                config, st, table, batch, now, grouped=True, uniform=True
+                config, st, table, xs, now, grouped=True, uniform=True
             )
             return (st, now + 1), verdicts.status
 
-        (state, _), statuses = jax.lax.scan(
-            body, (state, now0), stacked_batches
-        )
+        (state, _), statuses = jax.lax.scan(body, (state, now0), stacked_batches)
         return state, statuses
 
     step = jax.jit(chained, donate_argnums=(0,))
@@ -86,17 +121,15 @@ def main() -> None:
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
 
     now = 10_000
-    # warmup / compile
-    state, statuses = step(state, stacked, jnp.int32(now))
+    state, statuses = step(state, stacked, jnp.int32(now))  # warmup/compile
     jax.block_until_ready(statuses)
     ok_frac = float((np.asarray(statuses[0]) == TokenStatus.OK).mean())
     assert ok_frac > 0.5, f"warmup sanity: ok fraction {ok_frac}"
 
-    # timed steady state
-    repeats = 5
+    repeats = cfg["repeats"]
     lat = []
     t_total0 = time.perf_counter()
-    for i in range(repeats):
+    for _ in range(repeats):
         now += chain
         t0 = time.perf_counter()
         state, statuses = step(state, stacked, jnp.int32(now))
@@ -105,26 +138,94 @@ def main() -> None:
     total = time.perf_counter() - t_total0
 
     decisions_per_sec = repeats * chain * config.batch_size / total
-    # per-batch device time: the latency a queued micro-batch experiences
-    p99_ms = float(min(lat) / chain * 1e3)
-    baseline = 30_000.0  # reference maxAllowedQps per namespace/server
+    lat_ms = sorted(1e3 * x for x in lat)
+    per_batch_med_ms = lat_ms[len(lat_ms) // 2] / chain
     print(
         json.dumps(
             {
-                "metric": "flow_decisions_per_sec_per_chip_at_100k_rules",
+                "metric": METRIC,
                 "value": round(decisions_per_sec),
                 "unit": "decisions/s",
-                "vs_baseline": round(decisions_per_sec / baseline, 2),
+                "vs_baseline": round(decisions_per_sec / BASELINE_QPS, 2),
                 "extra": {
-                    "per_batch_device_ms": round(p99_ms, 3),
+                    # honest stats: median/max wall time of a full chained
+                    # dispatch, and median device time per micro-batch.
+                    # True end-to-end p99 lives in benchmarks/latency_bench.py.
+                    "dispatch_ms_p50": round(lat_ms[len(lat_ms) // 2], 2),
+                    "dispatch_ms_max": round(lat_ms[-1], 2),
+                    "per_batch_device_ms_med": round(per_batch_med_ms, 3),
                     "batch_size": config.batch_size,
-                    "backend": jax.devices()[0].platform,
-                    "device": str(jax.devices()[0]),
+                    "chain": chain,
+                    "n_flows": n_flows,
+                    "backend": dev.platform,
+                    "device": str(dev),
                 },
             }
         )
     )
 
 
+def main() -> None:
+    errors = {}
+    for name, cfg, timeout_s in ATTEMPTS:
+        env = dict(os.environ)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run",
+                 json.dumps(cfg)],
+                capture_output=True, text=True, timeout=timeout_s, env=env,
+            )
+        except subprocess.TimeoutExpired:
+            errors[name] = f"timeout after {timeout_s}s"
+            continue
+        line = next(
+            (ln for ln in reversed(proc.stdout.splitlines())
+             if ln.startswith("{")), None,
+        )
+        if proc.returncode == 0 and line:
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                errors[name] = "unparseable child output"
+                continue
+            parsed.setdefault("extra", {})["bench_config"] = name
+            if errors:
+                parsed["extra"]["prior_failures"] = errors
+            out = json.dumps(parsed)
+            print(out)
+            _record(out)
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        errors[name] = (tail[-1] if tail else f"rc={proc.returncode}")[-300:]
+    # Every attempt failed — still emit the JSON line the driver parses.
+    out = json.dumps(
+        {
+            "metric": METRIC,
+            "value": 0,
+            "unit": "decisions/s",
+            "vs_baseline": 0.0,
+            "extra": {"error": "all bench attempts failed", "attempts": errors},
+        }
+    )
+    print(out)
+    _record(out)
+
+
+def _record(line: str) -> None:
+    """Commit-able copy of every bench emission (VERDICT round-1 #10)."""
+    try:
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "results")
+        os.makedirs(d, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        with open(os.path.join(d, f"bench-{stamp}.json"), "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        _measure(json.loads(sys.argv[2]))
+    else:
+        main()

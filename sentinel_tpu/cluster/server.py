@@ -2,10 +2,11 @@
 
 Analog of ``NettyTransportServer.java:51`` + ``TokenServerHandler.java:39``,
 re-shaped for the TPU data plane: instead of one decision per channelRead, the
-handler enqueues requests and a batcher drains them every ``batch_window_ms``
-(or when a full batch is ready) into **one device step** — this is what turns
-the reference's 20ms RPC budget (``ClusterConstants.java:44``) into ≤~1ms
-micro-batches with room to spare.
+handler enqueues requests and an **adaptive** batcher drains everything queued
+into one device step the moment the device is free — batches grow naturally
+with load (arrivals pile up behind the in-flight step) and a lone request
+pays no batching delay. This is what turns the reference's 20ms RPC budget
+(``ClusterConstants.java:44``) into sub-ms micro-batches with room to spare.
 
 The asyncio loop runs on a dedicated thread (``start()``/``stop()`` are
 host-thread-safe); the device step runs in a worker thread so the IO loop
@@ -30,14 +31,19 @@ class TokenServer:
         service: TokenService,
         host: str = "127.0.0.1",
         port: int = 18730,
-        batch_window_ms: float = 1.0,
+        batch_window_ms: float = 0.0,
         max_batch: int = 1024,
+        inline_below: int = 64,
     ):
         self.service = service
         self.host = host
         self.port = port
         self.batch_window_ms = batch_window_ms
         self.max_batch = max_batch
+        # flow batches at or under this size dispatch inline on the loop
+        # thread (sub-ms step; executor hops would dominate); larger ones go
+        # through to_thread so the IO loop keeps pumping during the step
+        self.inline_below = inline_below
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -171,20 +177,40 @@ class TokenServer:
 
     # -- micro-batcher ------------------------------------------------------
     async def _batcher(self) -> None:
+        """Adaptive micro-batching: dispatch as soon as the device is free.
+
+        While a device step is in flight (``_process`` awaits it), new
+        arrivals pile up in the queue and the next iteration drains them all
+        in one go — so batches grow naturally with load and a lone request
+        under light load pays ZERO batching delay. A fixed collect window
+        (``batch_window_ms > 0``) is still honored for callers that prefer
+        bigger batches over tail latency.
+        """
         while True:
             first = await self._queue.get()
             batch: List[Tuple[P.FlowRequest, asyncio.StreamWriter]] = [first]
-            deadline = asyncio.get_event_loop().time() + self.batch_window_ms / 1000.0
             while len(batch) < self.max_batch:
-                timeout = deadline - asyncio.get_event_loop().time()
-                if timeout <= 0:
-                    break
                 try:
-                    batch.append(
-                        await asyncio.wait_for(self._queue.get(), timeout=timeout)
-                    )
-                except asyncio.TimeoutError:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
                     break
+            if self.batch_window_ms > 0:
+                deadline = (
+                    asyncio.get_event_loop().time()
+                    + self.batch_window_ms / 1000.0
+                )
+                while len(batch) < self.max_batch:
+                    timeout = deadline - asyncio.get_event_loop().time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(
+                                self._queue.get(), timeout=timeout
+                            )
+                        )
+                    except asyncio.TimeoutError:
+                        break
             await self._process(batch)
 
     async def _process(self, batch) -> None:
@@ -198,9 +224,17 @@ class TokenServer:
         if flow_items:
             flow_reqs = [(r.flow_id, r.count, r.prioritized) for _, r in flow_items]
             try:
-                flow_results = await asyncio.to_thread(
-                    self.service.request_batch, flow_reqs
-                )
+                if len(flow_reqs) <= self.inline_below:
+                    # small step: run it right here on the loop thread. The
+                    # two executor hops of to_thread cost more than the step
+                    # blocks the loop for, and a blocked loop just means
+                    # arrivals pile up into the next batch — which is the
+                    # batching policy anyway.
+                    flow_results = self.service.request_batch(flow_reqs)
+                else:
+                    flow_results = await asyncio.to_thread(
+                        self.service.request_batch, flow_reqs
+                    )
             except Exception:
                 record_log.exception("device step failed; failing batch")
                 flow_results = None

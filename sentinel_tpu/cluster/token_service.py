@@ -116,6 +116,13 @@ class DefaultTokenService(TokenService):
         param_config: Optional[ParamConfig] = None,
     ):
         self.config = config or EngineConfig()
+        # serving shape buckets: a lightly-loaded step pads to 64 instead of
+        # the full batch size (the decide cost is shape-proportional — ~4×
+        # cheaper at 64 than 1024 — and state tensors are batch-agnostic, so
+        # each bucket is just one more compiled variant of the same kernel)
+        self._serve_buckets = sorted(
+            {min(64, self.config.batch_size), self.config.batch_size}
+        )
         self._lock = threading.Lock()
         self._state = make_state(self.config)
         self._table, self._index = build_rule_table(self.config, [])
@@ -208,12 +215,15 @@ class DefaultTokenService(TokenService):
         *and* let early traffic slip through an expired window."""
         with self._lock:
             now = self._engine_now()
-            batch = make_batch(self.config, [-1])
-            # compile both serving variants (uniform acquire and mixed)
-            decide(self.config, self._state, self._table, batch, jnp.int32(now),
-                   grouped=True, uniform=True)
-            decide(self.config, self._state, self._table, batch, jnp.int32(now),
-                   grouped=True, uniform=False)
+            # compile both serving variants (uniform acquire and mixed) for
+            # every shape bucket the serving path can pick
+            for bucket in self._serve_buckets:
+                cfg = self.config._replace(batch_size=bucket)
+                batch = make_batch(cfg, [-1])
+                decide(cfg, self._state, self._table, batch, jnp.int32(now),
+                       grouped=True, uniform=True)
+                decide(cfg, self._state, self._table, batch, jnp.int32(now),
+                       grouped=True, uniform=False)
             idx = hash_indices(
                 np.zeros(1, np.int64), self.param_config.depth, self.param_config.width
             )
@@ -255,12 +265,15 @@ class DefaultTokenService(TokenService):
             # refinement (see decide()'s grouped/uniform flags)
             order = np.argsort(slots, kind="stable")
             uniform = bool(acquires.min() == acquires.max())
+            # smallest compiled shape bucket that fits this batch
+            bucket = next(b for b in self._serve_buckets if n <= b)
+            cfg = self.config._replace(batch_size=bucket)
             batch = make_batch(
-                self.config, slots[order], acquires[order], prios[order]
+                cfg, slots[order], acquires[order], prios[order]
             )
             now = self._engine_now()
             self._state, verdicts = decide(
-                self.config, self._state, self._table, batch, jnp.int32(now),
+                cfg, self._state, self._table, batch, np.int32(now),
                 grouped=True, uniform=uniform,
             )
         status = np.asarray(verdicts.status)

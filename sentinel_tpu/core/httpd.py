@@ -82,13 +82,22 @@ class HttpService:
                 code, text, ctype = result[:3]
                 extra = result[3] if len(result) > 3 else {}
                 data = text.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in extra.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(data)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    for k, v in extra.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client gave up (e.g. its timeout fired while a slow
+                    # handler ran) — the work is done; dropping the response
+                    # is not an error worth a traceback
+                    record_log.warning(
+                        "%s: client closed before response (%s)",
+                        name, parsed.path,
+                    )
 
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET", "")

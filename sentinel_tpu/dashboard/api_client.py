@@ -16,15 +16,27 @@ from sentinel_tpu.dashboard.discovery import MachineInfo
 from sentinel_tpu.metrics.log import MetricNode
 
 
-class ApiClient:
-    def __init__(self, timeout_s: float = 3.0):
-        self.timeout_s = timeout_s
+# Budget for promoting an agent to token server: the handler jit-compiles
+# the decision kernels (two shape buckets × two variants — seconds on CPU,
+# tens of seconds on a cold TPU) before acking. The handler is idempotent,
+# so even a timeout here reconciles on retry.
+PROMOTE_TIMEOUT_S = 120.0
 
-    def _get(self, machine: MachineInfo, command: str, params: dict) -> Optional[str]:
+
+class ApiClient:
+    def __init__(self, timeout_s: float = 3.0,
+                 promote_timeout_s: float = PROMOTE_TIMEOUT_S):
+        self.timeout_s = timeout_s
+        self.promote_timeout_s = promote_timeout_s
+
+    def _get(self, machine: MachineInfo, command: str, params: dict,
+             timeout_s: Optional[float] = None) -> Optional[str]:
         query = urllib.parse.urlencode({k: v for k, v in params.items() if v is not None})
         url = f"http://{machine.ip}:{machine.port}/{command}?{query}"
         try:
-            with urllib.request.urlopen(url, timeout=self.timeout_s) as rsp:
+            with urllib.request.urlopen(
+                url, timeout=timeout_s or self.timeout_s
+            ) as rsp:
                 return rsp.read().decode()
         except Exception as e:
             record_log.warning("command %s on %s failed: %s", command, machine.key, e)
@@ -95,7 +107,12 @@ class ApiClient:
         params = {"mode": str(mode)}
         if token_port is not None:
             params["tokenPort"] = str(token_port)
-        return self._get(machine, "setClusterMode", params) is not None
+        timeout_s = (
+            max(self.timeout_s, self.promote_timeout_s) if mode == 1 else None
+        )
+        return self._get(
+            machine, "setClusterMode", params, timeout_s=timeout_s
+        ) is not None
 
     def push_cluster_client_config(
         self, machine: MachineInfo, server_host: str, server_port: int

@@ -12,6 +12,7 @@ from __future__ import annotations
 import hmac
 import json
 import secrets
+import threading
 from typing import Optional, Tuple
 
 from sentinel_tpu.core import clock as _clock
@@ -189,6 +190,10 @@ class DashboardServer:
         # session set would grow with every login and keep stolen cookies
         # alive until restart)
         self._sessions: dict = {}
+        # ThreadingHTTPServer handles each request on its own thread — every
+        # _sessions access goes through this lock (prune in place, never
+        # rebind, so a concurrent logout can't be lost on an old dict)
+        self._sessions_lock = threading.Lock()
         self.session_ttl_ms = 24 * 3600 * 1000
         self.max_sessions = 1000
         self._service = HttpService(
@@ -210,10 +215,11 @@ class DashboardServer:
         for part in cookie.split(";"):
             k, _, v = part.strip().partition("=")
             if k == "sentinel_session":
-                expiry = self._sessions.get(v)
-                if expiry is not None and expiry > now:
-                    return v
-                self._sessions.pop(v, None)  # expired
+                with self._sessions_lock:
+                    expiry = self._sessions.get(v)
+                    if expiry is not None and expiry > now:
+                        return v
+                    self._sessions.pop(v, None)  # expired
         return None
 
     def _login(self, params: dict, body: str):
@@ -227,17 +233,18 @@ class DashboardServer:
                     "application/json; charset=utf-8")
         token = secrets.token_urlsafe(24)
         now = _clock.now_ms()
-        self._sessions = {
-            t: exp for t, exp in self._sessions.items() if exp > now
-        }
-        while len(self._sessions) >= self.max_sessions:
-            self._sessions.pop(next(iter(self._sessions)))  # oldest first
-        self._sessions[token] = now + self.session_ttl_ms
+        with self._sessions_lock:
+            for t in [t for t, exp in self._sessions.items() if exp <= now]:
+                del self._sessions[t]
+            while len(self._sessions) >= self.max_sessions:
+                self._sessions.pop(next(iter(self._sessions)))  # oldest first
+            self._sessions[token] = now + self.session_ttl_ms
         return (
             200,
             json.dumps({"code": 0}),
             "application/json; charset=utf-8",
-            {"Set-Cookie": f"sentinel_session={token}; HttpOnly; Path=/"},
+            {"Set-Cookie":
+             f"sentinel_session={token}; HttpOnly; Path=/; SameSite=Lax"},
         )
 
     # -- request handling ----------------------------------------------------
@@ -250,7 +257,8 @@ class DashboardServer:
             if method == "POST" and path == "auth/logout":
                 token = self._session_of(headers)
                 if token is not None:
-                    self._sessions.pop(token, None)
+                    with self._sessions_lock:
+                        self._sessions.pop(token, None)
                 return json_response(200, json.dumps({"code": 0}))
             if path not in AUTH_EXEMPT and self._session_of(headers) is None:
                 return json_response(401, json.dumps({"error": "login required"}))

@@ -51,12 +51,17 @@ class EtcdDataSource(AutoRefreshDataSource):
                 self._auth_token = resp.json().get("token")
         return {"Authorization": self._auth_token} if self._auth_token else {}
 
-    def _range(self) -> dict:
+    def _range(self, keys_only: bool = False) -> dict:
+        payload = {"key": _b64(self.rule_key)}
+        if keys_only:
+            # metadata-only poll: kvs come back with mod_revision but no
+            # value, so the change check doesn't transfer the rule payload
+            payload["keys_only"] = True
         for attempt in (0, 1):
             resp = request(
                 f"{self.endpoint}/v3/kv/range",
                 method="POST",
-                data=('{"key":"%s"}' % _b64(self.rule_key)).encode(),
+                data=json.dumps(payload).encode(),
                 headers=self._headers(),
                 timeout_s=5.0,
             )
@@ -81,7 +86,7 @@ class EtcdDataSource(AutoRefreshDataSource):
         return base64.b64decode(kvs[0].get("value", "")).decode("utf-8")
 
     def is_modified(self) -> bool:
-        body = self._range()
+        body = self._range(keys_only=True)
         kvs = body.get("kvs") or []
         rev = int(kvs[0].get("mod_revision", 0)) if kvs else 0
         return rev != (self._last_mod_rev or 0)

@@ -122,8 +122,17 @@ class StatLogger:
                 if value is not None:
                     slot[1] += value
                     slot[2] = True  # any valued stat upgrades the line format
-        if sealed:
-            self.writer.write_lines(sealed)
+            if sealed:
+                # enqueue under the lock: seal order == enqueue order ==
+                # file order (the put itself is non-blocking)
+                self._write_async(sealed)
+
+    def _write_async(self, lines: List[str]) -> None:
+        """Hand a sealed window to the shared writer thread — ``stat()``
+        sits on the serving hot path (called per micro-batch by the token
+        server), so the file open/roll/append must not stall the caller
+        (this is the role EagleEye's dedicated writer thread plays)."""
+        _writer_queue_put(self.writer, lines)
 
     def _seal(self, new_start: int) -> List[str]:
         """Format + clear the finished window. Caller holds the lock."""
@@ -143,10 +152,70 @@ class StatLogger:
         return lines
 
     def flush(self) -> None:
-        """Seal and write the current window immediately (shutdown/tests)."""
+        """Seal and write the current window immediately (shutdown/tests).
+
+        Routes through the same writer queue as async seals (so the file
+        stays in seal order) and waits until everything queued so far —
+        including this window — is on disk."""
         with self._lock:
             sealed = self._seal(self._window_start)
-        self.writer.write_lines(sealed)
+            if sealed:
+                self._write_async(sealed)
+        _writer_drain_barrier()
+
+
+# One shared background writer drains sealed windows for every StatLogger
+# (lazily started, daemon — dies with the process; flush() still writes
+# synchronously so shutdown/tests lose nothing).
+_writer_queue: Optional["queue.Queue"] = None
+_writer_lock = threading.Lock()
+
+
+def _writer_queue_put(writer: RollingFileWriter, lines: List[str]) -> None:
+    global _writer_queue
+    if _writer_queue is None:
+        with _writer_lock:
+            if _writer_queue is None:
+                import queue as _queue_mod
+
+                q: "queue.Queue" = _queue_mod.Queue(maxsize=1024)
+
+                def drain() -> None:
+                    while True:
+                        w, ls = q.get()
+                        if w is None:  # flush barrier
+                            ls.set()
+                            continue
+                        try:
+                            w.write_lines(ls)
+                        except Exception:  # never kill the writer thread
+                            record_log.exception("stat writer failed")
+
+                threading.Thread(
+                    target=drain, name="sentinel-stat-writer", daemon=True
+                ).start()
+                _writer_queue = q
+    try:
+        _writer_queue.put_nowait((writer, lines))
+    except Exception:
+        # queue full — a stalled disk must not back-pressure the serving
+        # path; drop the window (EagleEye drops on overload too)
+        record_log.warning("stat writer queue full; dropped a window")
+
+
+def _writer_drain_barrier(timeout_s: float = 5.0) -> None:
+    """Block until every window queued so far has been written (bounded:
+    a stalled disk makes this a best-effort wait, never a hang)."""
+    if _writer_queue is None:
+        return
+    import queue as _queue_mod
+
+    done = threading.Event()
+    try:
+        _writer_queue.put((None, done), timeout=timeout_s)
+    except _queue_mod.Full:
+        return  # writer is wedged; don't hang shutdown on it
+    done.wait(timeout_s)
 
 
 _registry_lock = threading.Lock()

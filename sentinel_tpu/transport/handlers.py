@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 
 import sentinel_tpu
 from sentinel_tpu.core import clock as _clock
@@ -221,6 +222,10 @@ def cmd_get_cluster_mode(params, body):
 
 
 _EMBEDDED_SERVER = {"server": None}
+# Guards the check-create-store sequence below: a retried setClusterMode
+# (promotion compiles the decision kernels, so the first call can be slow)
+# must not race the in-flight first call and double-start port-bound servers.
+_EMBEDDED_LOCK = threading.Lock()
 
 
 @command_mapping(
@@ -230,30 +235,39 @@ def cmd_set_cluster_mode(params, body):
     """Mode 1 actually provisions the embedded token server (transport +
     device service) and registers it — the analog of
     ``ModifyClusterModeCommandHandler`` → ``DefaultEmbeddedTokenServer``
-    start. Leaving server mode stops it."""
+    start. Leaving server mode stops it. Idempotent: repeating the current
+    mode (e.g. a dashboard retry after a slow first promote) reconciles
+    instead of double-starting."""
     from sentinel_tpu.cluster import api as cluster_api
 
     mode = int(params.get("mode", -1))
-    prev = _EMBEDDED_SERVER["server"]
-    if mode == int(cluster_api.ClusterMode.SERVER):
-        if prev is None:
-            from sentinel_tpu.cluster.server import TokenServer
-            from sentinel_tpu.cluster.token_service import DefaultTokenService
+    with _EMBEDDED_LOCK:
+        prev = _EMBEDDED_SERVER["server"]
+        if mode == int(cluster_api.ClusterMode.SERVER):
+            if prev is None:
+                from sentinel_tpu.cluster.server import TokenServer
+                from sentinel_tpu.cluster.token_service import (
+                    DefaultTokenService,
+                )
 
-            server = TokenServer(
-                DefaultTokenService(),
-                host="0.0.0.0",
-                port=int(params.get("tokenPort", 18730)),
-            )
-            server.start()
-            _EMBEDDED_SERVER["server"] = server
-        cluster_api.set_embedded_server(_EMBEDDED_SERVER["server"].service)
+                server = TokenServer(
+                    DefaultTokenService(),
+                    host="0.0.0.0",
+                    port=int(params.get("tokenPort", 18730)),
+                )
+                try:
+                    server.start()
+                except Exception:
+                    server.stop()  # release any half-bound resources
+                    raise
+                _EMBEDDED_SERVER["server"] = server
+            cluster_api.set_embedded_server(_EMBEDDED_SERVER["server"].service)
+            return "success"
+        if prev is not None:
+            _EMBEDDED_SERVER["server"] = None
+            prev.stop()
+        cluster_api.set_mode(cluster_api.ClusterMode(mode))
         return "success"
-    if prev is not None:
-        _EMBEDDED_SERVER["server"] = None
-        prev.stop()
-    cluster_api.set_mode(cluster_api.ClusterMode(mode))
-    return "success"
 
 
 @command_mapping(

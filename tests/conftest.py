@@ -36,6 +36,24 @@ def pytest_sessionstart(session):
     )
 
 
+def pytest_sessionfinish(session, exitstatus):
+    # Backstop: a test that provisioned the embedded token server via
+    # setClusterMode but died before its cleanup must not leave a port-bound
+    # server logging past the pytest summary.
+    try:
+        from sentinel_tpu.transport.handlers import (
+            _EMBEDDED_LOCK,
+            _EMBEDDED_SERVER,
+        )
+
+        with _EMBEDDED_LOCK:
+            srv, _EMBEDDED_SERVER["server"] = _EMBEDDED_SERVER["server"], None
+        if srv is not None:
+            srv.stop()
+    except Exception:
+        pass
+
+
 @pytest.fixture
 def manual_clock():
     """Install a deterministic clock for the duration of a test."""

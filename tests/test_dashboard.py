@@ -184,14 +184,14 @@ def _get(port: int, path: str):
         return json.loads(r.read().decode())
 
 
-def _post(port: int, path: str, payload, cookie=None):
+def _post(port: int, path: str, payload, cookie=None, timeout=5):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/{path}",
         data=json.dumps(payload).encode(),
         headers={"Cookie": cookie} if cookie else {},
     )
     try:
-        with urllib.request.urlopen(req, timeout=5) as r:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read().decode()), r.headers
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read().decode() or "{}"), e.headers
@@ -251,9 +251,13 @@ class TestClusterAssign:
                   {"app": "svc", "ip": "127.0.0.1", "port": cc.port})
             state = _get(dash.port, "cluster/state?app=svc")
             assert state[0]["mode"] == -1  # off
+            # promote compiles the decision kernels on the agent (multi-
+            # second); the ApiClient grants setClusterMode a 30s budget, so
+            # the outer call gets a matching one
             code, result, _ = _post(
                 dash.port, "cluster/assign?app=svc",
                 {"server": f"127.0.0.1:{cc.port}", "tokenPort": 28731},
+                timeout=60,
             )
             assert code == 200 and result["server"] is True
             state = _get(dash.port, "cluster/state?app=svc")
@@ -266,9 +270,12 @@ class TestClusterAssign:
             res = tc.request_token(12345)  # no rule loaded
             assert res.status == TokenStatus.NO_RULE_EXISTS
             tc.close()
-            # switching away stops it
+            # switching away stops it (plain-text "success" response)
             _get(dash.port, "apps")  # keep dash alive
-            _get_cc = _get(cc.port, "setClusterMode?mode=-1")
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{cc.port}/setClusterMode?mode=-1", timeout=5
+            ) as r:
+                assert b"success" in r.read()
             assert cluster_api.get_mode() == cluster_api.ClusterMode.NOT_STARTED
         finally:
             from sentinel_tpu.transport.handlers import _EMBEDDED_SERVER

@@ -31,8 +31,22 @@ from sentinel_tpu.cluster.api import (
     set_embedded_server,
     set_mode,
 )
+from sentinel_tpu.cluster.connection import ConnectionManager
+from sentinel_tpu.cluster.namespaces import (
+    NamespaceAssignment,
+    aggregate_snapshots,
+    flow_namespaces,
+    partition_rules,
+)
+from sentinel_tpu.cluster.routing import RoutingTokenClient
 
 __all__ = [
+    "ConnectionManager",
+    "NamespaceAssignment",
+    "RoutingTokenClient",
+    "aggregate_snapshots",
+    "flow_namespaces",
+    "partition_rules",
     "TokenResult",
     "TokenService",
     "DefaultTokenService",

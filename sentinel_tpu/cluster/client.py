@@ -35,10 +35,14 @@ class _Pending:
 
 
 class TokenClient(TokenService):
-    def __init__(self, host: str, port: int, timeout_ms: int = 20):
+    def __init__(self, host: str, port: int, timeout_ms: int = 20,
+                 namespace: str = "default"):
         self.host = host
         self.port = port
         self.timeout_ms = timeout_ms
+        # declared to the server in the PING handshake; the server scopes
+        # its connection counts (AVG_LOCAL scaling) by this group
+        self.namespace = namespace
         self._xid = itertools.count(1)
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
@@ -76,7 +80,13 @@ class TokenClient(TokenService):
                 name="sentinel-token-client-reader",
             )
             self._reader.start()
-            return True
+            handshake = True
+        if handshake:
+            # outside _state_lock (ping → _send → _ensure_connected would
+            # re-enter it); best-effort — a lost handshake only delays the
+            # server's connected-count update to the next keepalive
+            self.ping()
+        return True
 
     def _drop_connection(self, sock: socket.socket) -> None:
         with self._state_lock:
@@ -161,8 +171,14 @@ class TokenClient(TokenService):
             return TokenResult(TokenStatus.FAIL)
         return TokenResult(TokenStatus(rsp.status))
 
-    def ping(self) -> bool:
-        return self._roundtrip(P.Ping(next(self._xid))) is not None
+    def ping(self, namespace: Optional[str] = None) -> bool:
+        """Handshake/keepalive; declares a namespace this client serves
+        (``TokenServerHandler.handlePingRequest``). One connection may
+        declare several namespaces — each ping adds one group membership."""
+        return (
+            self._roundtrip(P.Ping(next(self._xid), namespace or self.namespace))
+            is not None
+        )
 
     def _roundtrip(self, req) -> Optional[P.FlowResponse]:
         """Correlated request/response: register pending, send, wait, pop."""

@@ -1,0 +1,68 @@
+"""Namespace-scoped connection groups.
+
+Analog of ``connection/ConnectionManager.java:35`` + ``ConnectionGroup.java``:
+the token server groups client connections by the namespace they declared in
+their PING handshake; each group's connected count feeds the AVG_LOCAL
+threshold scaling (``ClusterFlowChecker.java:43-47`` →
+``rules.ns_connected`` in the device table here).
+
+Instance-scoped rather than the reference's static map: every ``TokenServer``
+owns one manager, so two embedded servers in one process (tests, multi-pod
+dryruns) don't share groups.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+
+class ConnectionManager:
+    def __init__(
+        self, on_count_changed: Optional[Callable[[str, int], None]] = None
+    ):
+        self._lock = threading.Lock()
+        self._groups: Dict[str, Set[str]] = {}
+        # address → namespaces it registered (one connection may serve
+        # several namespaces; each PING adds one)
+        self._by_address: Dict[str, Set[str]] = {}
+        self._on_count_changed = on_count_changed
+
+    def add(self, namespace: str, address: str) -> int:
+        """Register; returns the group's connected count (PING response)."""
+        with self._lock:
+            group = self._groups.setdefault(namespace, set())
+            group.add(address)
+            self._by_address.setdefault(address, set()).add(namespace)
+            n = len(group)
+        if self._on_count_changed is not None:
+            self._on_count_changed(namespace, n)
+        return n
+
+    def remove_address(self, address: str) -> None:
+        """Drop every registration of a disconnected client."""
+        changed: List[tuple] = []
+        with self._lock:
+            for ns in self._by_address.pop(address, ()):
+                group = self._groups.get(ns)
+                if group is not None:
+                    group.discard(address)
+                    changed.append((ns, len(group)))
+                    if not group:
+                        del self._groups[ns]
+        if self._on_count_changed is not None:
+            for ns, n in changed:
+                self._on_count_changed(ns, n)
+
+    def connected_count(self, namespace: str) -> int:
+        with self._lock:
+            return len(self._groups.get(namespace, ()))
+
+    def namespaces(self) -> List[str]:
+        with self._lock:
+            return sorted(self._groups)
+
+    def snapshot(self) -> Dict[str, List[str]]:
+        """namespace → sorted addresses (FetchClusterServerInfo shape)."""
+        with self._lock:
+            return {ns: sorted(g) for ns, g in self._groups.items()}

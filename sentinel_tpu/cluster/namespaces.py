@@ -1,0 +1,104 @@
+"""Namespace partitioning across pods — the second scaling tier.
+
+Tier 1 (ICI): within one pod, the flow axis of the engine state shards over
+the pod's chips (``DefaultTokenService(mesh=...)`` →
+``parallel.sharding.make_sharded_decide``).
+
+Tier 2 (DCN): namespaces partition across pods, mirroring the reference's
+ownership model — a namespace is served by exactly ONE token server
+(``ClusterFlowRuleManager.java:67`` keeps namespace → flowId sets,
+``ConnectionManager.java:35`` namespace → connection group; clients are
+pointed at their namespace's server by assignment config). Decisions never
+cross pods, so the cross-pod (DCN) traffic is only:
+
+- assignment changes (this module's ``NamespaceAssignment``),
+- global observability (``aggregate_snapshots`` — the dashboard-facing sum
+  of per-pod metric snapshots).
+
+Counter state is ephemeral by design (sliding windows ≤ seconds; SURVEY §5
+checkpoint stance), so moving a namespace = repoint rules + clients; the new
+owner starts with fresh windows — the same behavior the reference exhibits
+when a token server restarts or an assignment changes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+from sentinel_tpu.engine.rules import ClusterFlowRule
+
+
+class NamespaceAssignment:
+    """namespace → pod ownership map with a generation counter.
+
+    The generation bumps on every change so routers can cheaply detect
+    staleness (the reference pushes new assignment configs through the
+    property system; here the property payload carries ``snapshot()``).
+    """
+
+    def __init__(self, initial: Optional[Mapping[str, str]] = None):
+        self._lock = threading.Lock()
+        self._pod_of: Dict[str, str] = dict(initial or {})
+        self.generation = 0
+
+    def assign(self, namespace: str, pod_id: str) -> None:
+        with self._lock:
+            if self._pod_of.get(namespace) != pod_id:
+                self._pod_of[namespace] = pod_id
+                self.generation += 1
+
+    move = assign  # moving is just re-assigning; counters don't travel
+
+    def unassign(self, namespace: str) -> None:
+        with self._lock:
+            if self._pod_of.pop(namespace, None) is not None:
+                self.generation += 1
+
+    def pod_of(self, namespace: str) -> Optional[str]:
+        with self._lock:
+            return self._pod_of.get(namespace)
+
+    def namespaces_of(self, pod_id: str) -> List[str]:
+        with self._lock:
+            return sorted(
+                ns for ns, p in self._pod_of.items() if p == pod_id
+            )
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._pod_of)
+
+
+def partition_rules(
+    rules: Iterable[ClusterFlowRule], assignment: NamespaceAssignment
+) -> Dict[str, List[ClusterFlowRule]]:
+    """Split a global rule set by owning pod (namespace → flowId set,
+    ``ClusterFlowRuleManager.java:67``). Rules in unassigned namespaces are
+    grouped under ``None`` so callers can surface the config error instead
+    of silently dropping quota enforcement."""
+    out: Dict[str, List[ClusterFlowRule]] = {}
+    for rule in rules:
+        out.setdefault(assignment.pod_of(rule.namespace), []).append(rule)
+    return out
+
+
+def flow_namespaces(rules: Iterable[ClusterFlowRule]) -> Dict[int, str]:
+    """flow_id → namespace routing key (what clients use to pick a pod)."""
+    return {r.flow_id: r.namespace for r in rules}
+
+
+def aggregate_snapshots(
+    snapshots: Iterable[Mapping[int, Mapping[str, float]]],
+) -> Dict[int, Dict[str, float]]:
+    """DCN-tier metric aggregation: sum per-flow metric snapshots from every
+    pod into the global view the dashboard shows. Namespace ownership makes
+    this a disjoint union in steady state, but a snapshot taken mid-move can
+    see a flow on two pods — summing (not overwriting) keeps totals right."""
+    out: Dict[int, Dict[str, float]] = {}
+    for snap in snapshots:
+        for fid, metrics in snap.items():
+            slot = out.setdefault(int(fid), {})
+            for k, v in metrics.items():
+                slot[k] = slot.get(k, 0.0) + float(v)
+    return out

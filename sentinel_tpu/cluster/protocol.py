@@ -70,12 +70,20 @@ class FlowResponse:
 
 @dataclass(frozen=True)
 class Ping:
+    """Connection handshake/keepalive. Carries the client's namespace as its
+    payload — the reference binds the connection to a namespace group this
+    way (``TokenServerHandler.handlePingRequest`` reads the namespace string
+    from the request data and answers with the group's connected count)."""
+
     xid: int
+    namespace: str = "default"
 
 
 def encode_request(req) -> bytes:
     if isinstance(req, Ping):
-        payload = _HEAD.pack(req.xid, MsgType.PING)
+        payload = _HEAD.pack(req.xid, MsgType.PING) + req.namespace.encode(
+            "utf-8"
+        )
     elif isinstance(req, FlowRequest):
         payload = _HEAD.pack(req.xid, req.msg_type) + _FLOW_REQ.pack(
             req.flow_id, req.count, 1 if req.prioritized else 0
@@ -104,7 +112,10 @@ def decode_request(payload: bytes):
     xid, mtype = _HEAD.unpack_from(payload, 0)
     mtype = MsgType(mtype)
     if mtype == MsgType.PING:
-        return Ping(xid)
+        ns = payload[_HEAD.size :].decode("utf-8", errors="replace")
+        # lenient where the reference answers "bad" on a blank namespace:
+        # an empty payload (older client) falls back to the default group
+        return Ping(xid, ns or "default")
     if mtype in (MsgType.FLOW, MsgType.CONCURRENT_ACQUIRE, MsgType.CONCURRENT_RELEASE):
         flow_id, count, prio = _FLOW_REQ.unpack_from(payload, _HEAD.size)
         return FlowRequest(xid, flow_id, count, bool(prio), mtype)

@@ -1,0 +1,147 @@
+"""Namespace-routing token client.
+
+The reference points each app at its namespace's token server through
+assignment config (``ClusterClientAssignConfig`` pushed via the property
+system); an app in several namespaces would run several clients. This client
+generalizes that: it holds one ``TokenClient`` per pod and routes each
+request by ``flow_id → namespace → pod``, so a caller is oblivious to the
+partitioning (``cluster/namespaces.py``).
+
+Reconfiguration (``update``) swaps the routing tables atomically — in-flight
+requests finish against the old pod (its verdict is still valid: counters
+are ephemeral and the old owner keeps enforcing until clients drain), new
+requests go to the new owner.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.engine import TokenStatus
+
+Endpoint = Tuple[str, int]
+
+
+class RoutingTokenClient(TokenService):
+    def __init__(
+        self,
+        timeout_ms: int = 20,
+        namespace_of: Optional[Mapping[int, str]] = None,
+        pod_of: Optional[Mapping[str, str]] = None,
+        endpoints: Optional[Mapping[str, Endpoint]] = None,
+        client_factory: Callable[..., TokenService] = TokenClient,
+    ):
+        self.timeout_ms = timeout_ms
+        self._factory = client_factory
+        self._lock = threading.Lock()
+        # routing tables — replaced wholesale by update(), never mutated
+        self._namespace_of: Mapping[int, str] = dict(namespace_of or {})
+        self._pod_of: Mapping[str, str] = dict(pod_of or {})
+        self._endpoints: Mapping[str, Endpoint] = dict(endpoints or {})
+        self._clients: Dict[str, TokenService] = {}
+        # namespaces each pod's client has declared via the PING handshake —
+        # a pod can serve several, and AVG_LOCAL counts need every one
+        self._declared: Dict[str, set] = {}
+
+    # -- reconfiguration ----------------------------------------------------
+    def update(
+        self,
+        namespace_of: Optional[Mapping[int, str]] = None,
+        pod_of: Optional[Mapping[str, str]] = None,
+        endpoints: Optional[Mapping[str, Endpoint]] = None,
+    ) -> None:
+        """Install new routing tables (assignment-config push analog).
+        Pods that disappeared get their clients closed."""
+        with self._lock:
+            if namespace_of is not None:
+                self._namespace_of = dict(namespace_of)
+            if pod_of is not None:
+                self._pod_of = dict(pod_of)
+            if endpoints is not None:
+                self._endpoints = dict(endpoints)
+                for pod_id in list(self._clients):
+                    if pod_id not in self._endpoints:
+                        client = self._clients.pop(pod_id)
+                        self._declared.pop(pod_id, None)
+                        close = getattr(client, "close", None)
+                        if close:
+                            close()
+
+    def _client_for(self, flow_id: int) -> Optional[TokenService]:
+        declare = False
+        with self._lock:
+            ns = self._namespace_of.get(flow_id)
+            if ns is None:
+                return None
+            pod_id = self._pod_of.get(ns)
+            if pod_id is None:
+                return None
+            client = self._clients.get(pod_id)
+            if client is None:
+                endpoint = self._endpoints.get(pod_id)
+                if endpoint is None:
+                    return None
+                client = self._factory(
+                    endpoint[0], endpoint[1],
+                    timeout_ms=self.timeout_ms, namespace=ns,
+                )
+                self._clients[pod_id] = client
+                self._declared[pod_id] = {ns}  # ctor namespace auto-pings
+            elif ns not in self._declared.setdefault(pod_id, set()):
+                self._declared[pod_id].add(ns)
+                declare = True
+        if declare:
+            # additional namespace on an existing pod connection: declare it
+            # so the server's AVG_LOCAL connection count includes us
+            # (best-effort, outside the lock — a lost ping only delays the
+            # count to the next keepalive)
+            ping = getattr(client, "ping", None)
+            if ping is not None:
+                ping(namespace=ns)
+        return client
+
+    # -- TokenService -------------------------------------------------------
+    def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
+        client = self._client_for(flow_id)
+        if client is None:
+            # unknown flow/namespace/pod: same shape as the reference's
+            # no-rule path — caller falls back to its local check
+            return TokenResult(TokenStatus.NO_RULE_EXISTS)
+        return client.request_token(flow_id, acquire, prioritized)
+
+    def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
+        client = self._client_for(flow_id)
+        if client is None:
+            return TokenResult(TokenStatus.NO_RULE_EXISTS)
+        return client.request_params_token(flow_id, acquire, param_hashes)
+
+    def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
+        client = self._client_for(flow_id)
+        if client is None:
+            return TokenResult(TokenStatus.NO_RULE_EXISTS)
+        return client.request_concurrent_token(flow_id, acquire, prioritized)
+
+    def release_concurrent_token(self, token_id):
+        # token ids don't carry the flow — broadcast the release; exactly
+        # one pod holds the token (reference releases against the issuing
+        # server; a router must fan out or remember issuance — we fan out)
+        with self._lock:
+            clients = list(self._clients.values())
+        result = TokenResult(TokenStatus.FAIL)
+        for client in clients:
+            r = client.release_concurrent_token(token_id)
+            if r.status == TokenStatus.OK:
+                result = r
+        return result
+
+    def close(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+            self._declared.clear()
+        for client in clients:
+            close = getattr(client, "close", None)
+            if close:
+                close()

@@ -20,6 +20,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.connection import ConnectionManager
 from sentinel_tpu.cluster.token_service import TokenService
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
@@ -49,8 +50,10 @@ class TokenServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._queue: Optional[asyncio.Queue] = None
         self._started = threading.Event()
-        self._conn_count = 0
-        self._conn_lock = threading.Lock()
+        # namespace-scoped connection groups (ConnectionManager.java:35);
+        # counts feed the service's AVG_LOCAL threshold scaling
+        notify = getattr(self.service, "connected_count_changed", None)
+        self.connections = ConnectionManager(on_count_changed=notify)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -125,22 +128,12 @@ class TokenServer:
         self._started.set()
 
     # -- per-connection reader ---------------------------------------------
-    def _connection_changed(self, delta: int) -> None:
-        with self._conn_lock:
-            self._conn_count += delta
-            n = self._conn_count
-        notify = getattr(self.service, "connected_count_changed", None)
-        if notify is not None:
-            # reference scopes connection counts per namespace
-            # (ConnectionManager.java:30-58); single-namespace grouping here,
-            # refined when the namespace handshake lands
-            notify("default", max(1, n))
-
     async def _on_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         frames = P.FrameReader()
-        self._connection_changed(+1)
+        peer = writer.get_extra_info("peername")
+        address = f"{peer[0]}:{peer[1]}" if peer else repr(writer)
         try:
             while True:
                 data = await reader.read(4096)
@@ -158,9 +151,16 @@ class TokenServer:
                         record_log.warning("bad frame from client; closing")
                         return
                     if isinstance(req, P.Ping):
+                        # handshake: bind this connection to its namespace
+                        # group; answer with the group's connected count
+                        # (TokenServerHandler.handlePingRequest)
+                        count = self.connections.add(req.namespace, address)
                         writer.write(
                             P.encode_response(
-                                P.FlowResponse(req.xid, P.MsgType.PING, 0)
+                                P.FlowResponse(
+                                    req.xid, P.MsgType.PING, 0,
+                                    remaining=count,
+                                )
                             )
                         )
                         await writer.drain()
@@ -169,7 +169,7 @@ class TokenServer:
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
-            self._connection_changed(-1)
+            self.connections.remove_address(address)
             try:
                 writer.close()
             except Exception:

@@ -114,6 +114,7 @@ class DefaultTokenService(TokenService):
         self,
         config: Optional[EngineConfig] = None,
         param_config: Optional[ParamConfig] = None,
+        mesh=None,
     ):
         self.config = config or EngineConfig()
         # serving shape buckets: a lightly-loaded step pads to 64 instead of
@@ -123,9 +124,17 @@ class DefaultTokenService(TokenService):
         self._serve_buckets = sorted(
             {min(64, self.config.batch_size), self.config.batch_size}
         )
+        # Optional jax.sharding.Mesh: the flow axis of the engine state and
+        # rule table shards across the mesh's devices and the decision step
+        # runs under shard_map with psums over ICI — one pod's chips serve
+        # one namespace partition together (SURVEY §7.5 tier 1; tier 2 —
+        # namespaces across pods — is sentinel_tpu.cluster.namespaces).
+        self.mesh = mesh
+        self._sharded_steps: Dict[Tuple[int, bool], object] = {}
         self._lock = threading.Lock()
-        self._state = make_state(self.config)
-        self._table, self._index = build_rule_table(self.config, [])
+        self._state = self._place_state(make_state(self.config))
+        table, self._index = build_rule_table(self.config, [])
+        self._table = self._place_rules(table)
         self._epoch_ms: Optional[int] = None
         self._connected: Dict[str, int] = {}  # namespace → client count
         self._ns_max_qps = 30_000.0
@@ -141,6 +150,41 @@ class DefaultTokenService(TokenService):
         self.concurrency = ConcurrencyManager()
         self._expiry = None  # background sweep; started on first rule load
 
+    # -- mesh placement -----------------------------------------------------
+    def _place_state(self, state):
+        if self.mesh is None:
+            return state
+        from sentinel_tpu.parallel.sharding import shard_state
+
+        return shard_state(state, self.mesh)
+
+    def _place_rules(self, table):
+        if self.mesh is None:
+            return table
+        from sentinel_tpu.parallel.sharding import shard_rules
+
+        return shard_rules(table, self.mesh)
+
+    def _step_fn(self, bucket: int, uniform: bool):
+        """The device step for one (shape bucket, uniform) variant —
+        single-shard ``decide`` or the mesh-sharded shard_map step."""
+        if self.mesh is None:
+            cfg = self.config._replace(batch_size=bucket)
+            return lambda state, table, batch, now: decide(
+                cfg, state, table, batch, now, grouped=True, uniform=uniform
+            )
+        key = (bucket, uniform)
+        step = self._sharded_steps.get(key)
+        if step is None:
+            from sentinel_tpu.parallel.sharding import make_sharded_decide
+
+            cfg = self.config._replace(batch_size=bucket)
+            step = make_sharded_decide(
+                cfg, self.mesh, grouped=True, uniform=uniform
+            )
+            self._sharded_steps[key] = step
+        return step
+
     # -- rule management (ClusterFlowRuleManager analog) --------------------
     def load_rules(
         self,
@@ -153,11 +197,16 @@ class DefaultTokenService(TokenService):
                 self._ns_max_qps = ns_max_qps
             if connected is not None:
                 self._connected.update(connected)
-            self._table, self._index = build_rule_table(
+            table, self._index = build_rule_table(
                 self.config, rules, index=self._index,
                 ns_max_qps=self._ns_max_qps, connected=self._connected,
             )
-            self._state = drain_pending_clear(self._index, self._state)
+            self._table = self._place_rules(table)
+            # re-place after the drain scatter: eager sharding propagation
+            # through .at[].set isn't guaranteed to keep the flow layout
+            self._state = self._place_state(
+                drain_pending_clear(self._index, self._state)
+            )
 
     def connected_count_changed(self, namespace: str, n: int) -> None:
         """``ConnectionManager`` callback: AVG_LOCAL thresholds scale with it.
@@ -171,7 +220,9 @@ class DefaultTokenService(TokenService):
                 return  # no rule in this namespace yet; applied on next load
             conn = np.array(self._table.ns_connected)  # writable copy
             conn[ns] = max(1, int(n))
-            self._table = self._table._replace(ns_connected=jnp.asarray(conn))
+            self._table = self._place_rules(
+                self._table._replace(ns_connected=jnp.asarray(conn))
+            )
 
     # -- time ---------------------------------------------------------------
     # int32 engine-ms wraps after ~24.8 days; re-base well before that.
@@ -216,14 +267,14 @@ class DefaultTokenService(TokenService):
         with self._lock:
             now = self._engine_now()
             # compile both serving variants (uniform acquire and mixed) for
-            # every shape bucket the serving path can pick
+            # every shape bucket the serving path can pick (mesh-sharded
+            # variants when this service runs over a pod mesh)
             for bucket in self._serve_buckets:
                 cfg = self.config._replace(batch_size=bucket)
                 batch = make_batch(cfg, [-1])
-                decide(cfg, self._state, self._table, batch, jnp.int32(now),
-                       grouped=True, uniform=True)
-                decide(cfg, self._state, self._table, batch, jnp.int32(now),
-                       grouped=True, uniform=False)
+                for uniform in (True, False):
+                    step = self._step_fn(bucket, uniform)
+                    step(self._state, self._table, batch, jnp.int32(now))
             idx = hash_indices(
                 np.zeros(1, np.int64), self.param_config.depth, self.param_config.width
             )
@@ -272,9 +323,9 @@ class DefaultTokenService(TokenService):
                 cfg, slots[order], acquires[order], prios[order]
             )
             now = self._engine_now()
-            self._state, verdicts = decide(
-                cfg, self._state, self._table, batch, np.int32(now),
-                grouped=True, uniform=uniform,
+            step = self._step_fn(bucket, uniform)
+            self._state, verdicts = step(
+                self._state, self._table, batch, np.int32(now)
             )
         status = np.asarray(verdicts.status)
         remaining = np.asarray(verdicts.remaining)

@@ -156,22 +156,29 @@ class StatLogger:
 
         Routes through the same writer queue as async seals (so the file
         stays in seal order) and waits until everything queued so far —
-        including this window — is on disk."""
+        including this window — is on disk. If the writer queue is wedged
+        (stalled disk), the sealed window is written synchronously as a
+        last resort so an explicit flush never silently drops data."""
         with self._lock:
             sealed = self._seal(self._window_start)
-            if sealed:
-                self._write_async(sealed)
+        if sealed:
+            if not _writer_queue_put(self.writer, sealed):
+                self.writer.write_lines(sealed)
+                return
         _writer_drain_barrier()
 
 
 # One shared background writer drains sealed windows for every StatLogger
-# (lazily started, daemon — dies with the process; flush() still writes
-# synchronously so shutdown/tests lose nothing).
+# (lazily started, daemon — dies with the process). stat()'s hot-path seals
+# are fire-and-forget (dropped with a warning if the queue is wedged);
+# flush() falls back to a synchronous write so explicit flushes lose
+# nothing.
 _writer_queue: Optional["queue.Queue"] = None
 _writer_lock = threading.Lock()
 
 
-def _writer_queue_put(writer: RollingFileWriter, lines: List[str]) -> None:
+def _writer_queue_put(writer: RollingFileWriter, lines: List[str]) -> bool:
+    """Enqueue for the shared writer thread; False if the queue is full."""
     global _writer_queue
     if _writer_queue is None:
         with _writer_lock:
@@ -197,10 +204,13 @@ def _writer_queue_put(writer: RollingFileWriter, lines: List[str]) -> None:
                 _writer_queue = q
     try:
         _writer_queue.put_nowait((writer, lines))
+        return True
     except Exception:
         # queue full — a stalled disk must not back-pressure the serving
-        # path; drop the window (EagleEye drops on overload too)
+        # path; hot-path callers drop the window (EagleEye drops on
+        # overload too), flush() falls back to a synchronous write
         record_log.warning("stat writer queue full; dropped a window")
+        return False
 
 
 def _writer_drain_barrier(timeout_s: float = 5.0) -> None:

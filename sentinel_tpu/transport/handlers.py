@@ -285,9 +285,16 @@ def cmd_cluster_client_modify_config(params, body):
     if not host or not port:
         return {"error": "serverHost and serverPort required"}
     timeout_ms = int(data.get("requestTimeout", 20))
-    cluster_api.set_client(TokenClient(host, port, timeout_ms=timeout_ms))
+    # the namespace this agent declares in its PING handshake — the server
+    # scopes connection counts (AVG_LOCAL scaling) by it
+    # (ClusterClientConfigManager's namespace config)
+    namespace = str(data.get("namespace", "default") or "default")
+    cluster_api.set_client(
+        TokenClient(host, port, timeout_ms=timeout_ms, namespace=namespace)
+    )
     _CLUSTER_CLIENT_CONFIG.update(
-        serverHost=host, serverPort=port, requestTimeout=timeout_ms
+        serverHost=host, serverPort=port, requestTimeout=timeout_ms,
+        namespace=namespace,
     )
     return "success"
 

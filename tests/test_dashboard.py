@@ -252,12 +252,12 @@ class TestClusterAssign:
             state = _get(dash.port, "cluster/state?app=svc")
             assert state[0]["mode"] == -1  # off
             # promote compiles the decision kernels on the agent (multi-
-            # second); the ApiClient grants setClusterMode a 30s budget, so
-            # the outer call gets a matching one
+            # second); the ApiClient grants setClusterMode PROMOTE_TIMEOUT_S
+            # (120s), so the outer call must wait at least as long
             code, result, _ = _post(
                 dash.port, "cluster/assign?app=svc",
                 {"server": f"127.0.0.1:{cc.port}", "tokenPort": 28731},
-                timeout=60,
+                timeout=150,
             )
             assert code == 200 and result["server"] is True
             state = _get(dash.port, "cluster/state?app=svc")

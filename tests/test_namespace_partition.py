@@ -1,0 +1,245 @@
+"""Namespace partitioning (tier 2) tests.
+
+Reference model: a namespace is served by exactly one token server
+(``ClusterFlowRuleManager.java:67`` namespace→flowId sets,
+``ConnectionManager.java:35`` namespace→connection groups, client assignment
+config per namespace). These tests cover the ownership map, rule
+partitioning, the routing client, connection groups fed by the PING
+handshake, per-namespace isolation under shard movement, and the DCN-tier
+metric aggregation.
+"""
+
+import threading
+
+import pytest
+
+from sentinel_tpu.cluster.connection import ConnectionManager
+from sentinel_tpu.cluster.namespaces import (
+    NamespaceAssignment,
+    aggregate_snapshots,
+    flow_namespaces,
+    partition_rules,
+)
+from sentinel_tpu.cluster.routing import RoutingTokenClient
+from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.engine import ClusterFlowRule, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+
+
+class TestNamespaceAssignment:
+    def test_assign_move_generation(self):
+        a = NamespaceAssignment({"ns1": "pod0"})
+        assert a.pod_of("ns1") == "pod0"
+        assert a.generation == 0
+        a.assign("ns2", "pod1")
+        assert a.generation == 1
+        a.move("ns2", "pod0")
+        assert a.generation == 2
+        assert a.namespaces_of("pod0") == ["ns1", "ns2"]
+        a.assign("ns2", "pod0")  # no-op: same owner
+        assert a.generation == 2
+        a.unassign("ns1")
+        assert a.pod_of("ns1") is None
+        assert a.generation == 3
+
+    def test_partition_rules_and_unassigned(self):
+        a = NamespaceAssignment({"a": "pod0", "b": "pod1"})
+        rules = [
+            ClusterFlowRule(flow_id=1, count=1, namespace="a"),
+            ClusterFlowRule(flow_id=2, count=1, namespace="b"),
+            ClusterFlowRule(flow_id=3, count=1, namespace="a"),
+            ClusterFlowRule(flow_id=4, count=1, namespace="orphan"),
+        ]
+        parts = partition_rules(rules, a)
+        assert [r.flow_id for r in parts["pod0"]] == [1, 3]
+        assert [r.flow_id for r in parts["pod1"]] == [2]
+        # unassigned namespaces surface under None instead of vanishing
+        assert [r.flow_id for r in parts[None]] == [4]
+        assert flow_namespaces(rules)[4] == "orphan"
+
+
+class TestConnectionManager:
+    def test_groups_counts_and_callbacks(self):
+        seen = []
+        cm = ConnectionManager(on_count_changed=lambda ns, n: seen.append((ns, n)))
+        assert cm.add("a", "1.1.1.1:1") == 1
+        assert cm.add("a", "1.1.1.1:2") == 2
+        assert cm.add("b", "1.1.1.1:1") == 1  # one conn, two namespaces
+        assert cm.connected_count("a") == 2
+        cm.remove_address("1.1.1.1:1")  # drops both registrations
+        assert cm.connected_count("a") == 1
+        assert cm.connected_count("b") == 0
+        assert cm.namespaces() == ["a"]
+        assert ("a", 2) in seen and ("b", 0) in seen
+
+    def test_duplicate_add_is_idempotent(self):
+        cm = ConnectionManager()
+        cm.add("a", "x:1")
+        assert cm.add("a", "x:1") == 1
+        assert cm.snapshot() == {"a": ["x:1"]}
+
+
+class _StubClient(TokenService):
+    """Records which pod answered; stands in for a real TokenClient."""
+
+    def __init__(self, host, port, timeout_ms=20, namespace="default"):
+        self.endpoint = (host, port)
+        self.namespace = namespace
+        self.calls = []
+        self.closed = False
+
+    def request_token(self, flow_id, acquire=1, prioritized=False):
+        self.calls.append(flow_id)
+        return TokenResult(TokenStatus.OK, remaining=self.endpoint[1])
+
+    def ping(self, namespace=None):
+        self.pinged = getattr(self, "pinged", []) + [namespace or self.namespace]
+        return True
+
+    def close(self):
+        self.closed = True
+
+
+class TestRoutingTokenClient:
+    def _router(self):
+        return RoutingTokenClient(
+            namespace_of={1: "a", 2: "b"},
+            pod_of={"a": "pod0", "b": "pod1"},
+            endpoints={"pod0": ("h0", 10), "pod1": ("h1", 11)},
+            client_factory=_StubClient,
+        )
+
+    def test_routes_by_namespace(self):
+        r = self._router()
+        assert r.request_token(1).remaining == 10  # pod0's port marker
+        assert r.request_token(2).remaining == 11
+        # unknown flow → NO_RULE (caller falls back locally)
+        assert r.request_token(99).status == TokenStatus.NO_RULE_EXISTS
+
+    def test_client_carries_namespace_handshake(self):
+        r = self._router()
+        r.request_token(1)
+        client = r._clients["pod0"]
+        assert client.namespace == "a"
+
+    def test_pod_serving_multiple_namespaces_declares_each(self):
+        # AVG_LOCAL counts are per namespace group — a pod client must
+        # declare EVERY namespace it routes, not just its first
+        r = RoutingTokenClient(
+            namespace_of={1: "a", 2: "b"},
+            pod_of={"a": "pod0", "b": "pod0"},
+            endpoints={"pod0": ("h0", 10)},
+            client_factory=_StubClient,
+        )
+        r.request_token(1)
+        r.request_token(2)
+        r.request_token(2)  # already declared — no extra ping
+        client = r._clients["pod0"]
+        assert client.namespace == "a"  # ctor namespace (auto-handshake)
+        assert getattr(client, "pinged", []) == ["b"]
+
+    def test_update_moves_namespace_and_closes_dead_pods(self):
+        r = self._router()
+        r.request_token(2)
+        old = r._clients["pod1"]
+        # move namespace b to pod0 and retire pod1 entirely
+        r.update(pod_of={"a": "pod0", "b": "pod0"},
+                 endpoints={"pod0": ("h0", 10)})
+        assert r.request_token(2).remaining == 10
+        assert old.closed
+
+    def test_close_closes_all(self):
+        r = self._router()
+        r.request_token(1)
+        r.request_token(2)
+        clients = list(r._clients.values())
+        r.close()
+        assert all(c.closed for c in clients)
+
+
+class TestAggregation:
+    def test_sums_disjoint_and_overlapping(self):
+        total = aggregate_snapshots([
+            {1: {"pass_qps": 5.0}, 2: {"pass_qps": 1.0}},
+            {3: {"pass_qps": 2.0}, 2: {"pass_qps": 0.5}},  # mid-move overlap
+        ])
+        assert total[1]["pass_qps"] == 5.0
+        assert total[2]["pass_qps"] == 1.5
+        assert total[3]["pass_qps"] == 2.0
+
+
+class TestPartitionIsolationE2E:
+    """Two in-process pods; namespace movement repoints routing and the new
+    owner enforces with fresh windows (the documented ephemeral stance)."""
+
+    def test_isolation_and_movement(self):
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.engine import EngineConfig
+
+        rules = [
+            ClusterFlowRule(flow_id=1, count=1e9, namespace="a",
+                            mode=ThresholdMode.GLOBAL),
+            ClusterFlowRule(flow_id=2, count=1e9, namespace="b",
+                            mode=ThresholdMode.GLOBAL),
+        ]
+        assignment = NamespaceAssignment({"a": "pod0", "b": "pod1"})
+        pods = {
+            p: DefaultTokenService(
+                EngineConfig(max_flows=8, max_namespaces=4, batch_size=8)
+            )
+            for p in ("pod0", "pod1")
+        }
+        parts = partition_rules(rules, assignment)
+        for pod_id, pod_rules in parts.items():
+            pods[pod_id].load_rules(pod_rules)
+
+        # ownership: each pod only answers its own namespace's flows
+        assert pods["pod0"].request_token(1).status == TokenStatus.OK
+        assert pods["pod0"].request_token(2).status == TokenStatus.NO_RULE_EXISTS
+        assert pods["pod1"].request_token(2).status == TokenStatus.OK
+
+        # move namespace b → pod0 (rules follow ownership; counters don't)
+        assignment.move("b", "pod0")
+        parts = partition_rules(rules, assignment)
+        pods["pod0"].load_rules(parts["pod0"])
+        pods["pod1"].load_rules(parts.get("pod1", []))
+        assert pods["pod0"].request_token(2).status == TokenStatus.OK
+        # the old owner no longer recognizes the moved flow
+        assert pods["pod1"].request_token(2).status == TokenStatus.NO_RULE_EXISTS
+
+        for svc in pods.values():
+            svc.close()
+
+    def test_avg_local_scales_with_handshaked_clients(self):
+        """Connection-group counts from the PING handshake scale AVG_LOCAL
+        thresholds (ClusterFlowChecker.java:43-47)."""
+        from sentinel_tpu.cluster.client import TokenClient
+        from sentinel_tpu.cluster.server import TokenServer
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.engine import EngineConfig
+
+        svc = DefaultTokenService(
+            EngineConfig(max_flows=8, max_namespaces=4, batch_size=8)
+        )
+        svc.load_rules([
+            ClusterFlowRule(flow_id=5, count=2.0, namespace="grp",
+                            mode=ThresholdMode.AVG_LOCAL),
+        ])
+        server = TokenServer(svc, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            c1 = TokenClient("127.0.0.1", server.port, timeout_ms=2000,
+                             namespace="grp")
+            c2 = TokenClient("127.0.0.1", server.port, timeout_ms=2000,
+                             namespace="grp")
+            assert c1.ping() and c2.ping()
+            assert server.connections.connected_count("grp") == 2
+            # threshold = 2.0/client × 2 clients = 4 global
+            statuses = [c1.request_token(5).status for _ in range(6)]
+            assert statuses.count(TokenStatus.OK) == 4, statuses
+            assert statuses.count(TokenStatus.BLOCKED) == 2, statuses
+            c1.close()
+            c2.close()
+        finally:
+            server.stop()
+            svc.close()

@@ -127,3 +127,41 @@ class TestShardedParity:
             ok_per_flow[f] = ok_per_flow.get(f, 0) + (st[i] == TokenStatus.OK)
         for f in range(16):
             assert ok_per_flow[f] == 2 + (f % 3)  # count=2+(f%3)
+
+
+class TestMeshBackedService:
+    """DefaultTokenService(mesh=...) — a pod's chips serving together
+    (tier 1 of SURVEY §7.5; tier 2 is tests/test_namespace_partition.py)."""
+
+    def test_serves_and_enforces_over_mesh(self, mesh):
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+        svc = DefaultTokenService(CFG, mesh=mesh)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=i, count=3.0, mode=G) for i in range(16)]
+        )
+        svc.warmup()  # compile outside the metric window
+        res = svc.request_batch([(1, 1, False)] * 5)
+        statuses = [r.status for r in res]
+        assert statuses.count(TokenStatus.OK) == 3, statuses
+        assert statuses.count(TokenStatus.BLOCKED) == 2, statuses
+        assert svc.request_token(99).status == TokenStatus.NO_RULE_EXISTS
+        snap = svc.metrics_snapshot()
+        assert snap[1]["pass_qps"] > 0
+        # state is genuinely sharded across the mesh
+        assert len(svc._state.flow.counts.addressable_shards) == 8
+        svc.close()
+
+    def test_rule_reload_keeps_serving(self, mesh):
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+        svc = DefaultTokenService(CFG, mesh=mesh)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=1e9, mode=G)])
+        svc.warmup()
+        assert svc.request_token(1).status == TokenStatus.OK
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=f, count=1e9, mode=G) for f in (1, 2)]
+        )
+        assert svc.request_token(2).status == TokenStatus.OK
+        assert svc.request_token(1).status == TokenStatus.OK
+        svc.close()

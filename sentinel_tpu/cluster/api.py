@@ -65,6 +65,13 @@ def get_mode() -> ClusterMode:
     return _mode
 
 
+def get_embedded_server() -> Optional[TokenService]:
+    """The in-process token service when this agent runs in SERVER mode
+    (``EmbeddedClusterTokenServerProvider`` analog) — the cluster/server/*
+    command handlers operate on it."""
+    return _embedded
+
+
 def _pick_service() -> Optional[TokenService]:
     """``FlowRuleChecker.pickClusterService`` (``:176-184``)."""
     if _mode == ClusterMode.CLIENT:

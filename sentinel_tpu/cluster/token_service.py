@@ -52,6 +52,7 @@ class ClusterParamFlowRule:
     flow_id: int
     count: float
     item_thresholds: Optional[Tuple[Tuple[int, float], ...]] = None
+    namespace: str = "default"
 
 
 @dataclass(frozen=True)
@@ -138,6 +139,14 @@ class DefaultTokenService(TokenService):
         self._epoch_ms: Optional[int] = None
         self._connected: Dict[str, int] = {}  # namespace → client count
         self._ns_max_qps = 30_000.0
+        # namespace-scoped rule bookkeeping (ClusterFlowRuleManager keeps
+        # namespace → flowId sets; the command surface edits one namespace
+        # at a time while the device table always holds the union)
+        self._rules_by_ns: Dict[str, Dict[int, ClusterFlowRule]] = {}
+        self._param_rules_src: Dict[int, "ClusterParamFlowRule"] = {}
+        # namespaces this server explicitly serves (modifyNamespaceSet);
+        # unioned with namespaces of loaded rules for info/fetchConfig
+        self.namespace_set: set = set()
         # hot-param sketch path (ClusterParamFlowChecker analog)
         self.param_config = param_config or ParamConfig()
         self._param_state = make_param_state(self.param_config)
@@ -197,6 +206,10 @@ class DefaultTokenService(TokenService):
                 self._ns_max_qps = ns_max_qps
             if connected is not None:
                 self._connected.update(connected)
+            by_ns: Dict[str, Dict[int, ClusterFlowRule]] = {}
+            for r in rules:
+                by_ns.setdefault(r.namespace, {})[r.flow_id] = r
+            self._rules_by_ns = by_ns
             table, self._index = build_rule_table(
                 self.config, rules, index=self._index,
                 ns_max_qps=self._ns_max_qps, connected=self._connected,
@@ -207,6 +220,64 @@ class DefaultTokenService(TokenService):
             self._state = self._place_state(
                 drain_pending_clear(self._index, self._state)
             )
+
+    def load_namespace_rules(
+        self, namespace: str, rules: List[ClusterFlowRule]
+    ) -> None:
+        """Replace ONE namespace's flow rules, keeping every other
+        namespace's intact (``ClusterFlowRuleManager.loadRules(namespace,
+        rules)`` — the shape the cluster/server/modifyFlowRules command
+        edits)."""
+        fixed = [
+            r if r.namespace == namespace
+            else ClusterFlowRule(r.flow_id, r.count, r.mode, namespace)
+            for r in rules
+        ]
+        with self._lock:
+            merged = {
+                ns: dict(m) for ns, m in self._rules_by_ns.items()
+                if ns != namespace
+            }
+            if fixed:
+                merged[namespace] = {r.flow_id: r for r in fixed}
+            flat = [r for m in merged.values() for r in m.values()]
+        self.load_rules(flat)
+
+    def current_rules(
+        self, namespace: Optional[str] = None
+    ) -> List[ClusterFlowRule]:
+        with self._lock:
+            if namespace is not None:
+                return list(self._rules_by_ns.get(namespace, {}).values())
+            return [
+                r for m in self._rules_by_ns.values() for r in m.values()
+            ]
+
+    def served_namespaces(self) -> List[str]:
+        """Explicit namespace set ∪ namespaces with loaded rules."""
+        with self._lock:
+            return sorted(self.namespace_set | set(self._rules_by_ns))
+
+    def set_max_allowed_qps(self, qps: float) -> None:
+        """Dynamic ``ServerFlowConfig.maxAllowedQps`` update — rebuilds the
+        namespace-guard row of the rule table without retracing."""
+        self.load_rules(self.current_rules(), ns_max_qps=float(qps))
+
+    def config_snapshot(self) -> Dict[str, object]:
+        """Flow-config view (cluster/server/fetchConfig shape)."""
+        from sentinel_tpu.engine.state import flow_spec
+
+        spec = flow_spec(self.config)
+        return {
+            "exceedCount": self.config.exceed_count,
+            "maxOccupyRatio": self.config.max_occupy_ratio,
+            "intervalMs": spec.interval_ms,
+            "sampleCount": self.config.n_buckets,
+            "maxAllowedQps": self._ns_max_qps,
+            "maxFlows": self.config.max_flows,
+            "batchSize": self.config.batch_size,
+            "namespaceSet": self.served_namespaces(),
+        }
 
     def connected_count_changed(self, namespace: str, n: int) -> None:
         """``ConnectionManager`` callback: AVG_LOCAL thresholds scale with it.
@@ -381,6 +452,35 @@ class DefaultTokenService(TokenService):
                     slot = self._param_free.pop()
                 items = dict(rule.item_thresholds or ())
                 self._param_rules[rule.flow_id] = (slot, rule.count, items)
+            self._param_rules_src = {r.flow_id: r for r in rules}
+
+    def load_namespace_param_rules(
+        self, namespace: str, rules: List[ClusterParamFlowRule]
+    ) -> None:
+        """Replace one namespace's param rules, keeping the others
+        (``ClusterParamFlowRuleManager`` namespace scope — the
+        cluster/server/modifyParamRules command edits one namespace)."""
+        fixed = [
+            r if r.namespace == namespace
+            else ClusterParamFlowRule(r.flow_id, r.count, r.item_thresholds,
+                                      namespace)
+            for r in rules
+        ]
+        with self._lock:
+            keep = [
+                r for r in self._param_rules_src.values()
+                if r.namespace != namespace
+            ]
+        self.load_param_rules(keep + fixed)
+
+    def current_param_rules(
+        self, namespace: Optional[str] = None
+    ) -> List[ClusterParamFlowRule]:
+        with self._lock:
+            rules = list(self._param_rules_src.values())
+        if namespace is not None:
+            rules = [r for r in rules if r.namespace == namespace]
+        return rules
 
     def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
         """CMS-windowed per-value admission. All values of the request are

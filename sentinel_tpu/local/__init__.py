@@ -117,3 +117,6 @@ def reset_for_tests() -> None:
     chain.reset_entry_node_for_tests()
     context.reset_for_tests()
     _sph().reset_for_tests()
+    from sentinel_tpu.local import sph as _sph_mod
+
+    _sph_mod.set_enabled(True)

@@ -118,6 +118,21 @@ class Entry:
         return False
 
 
+# Global kill switch (Constants.ON analog, toggled by the reference's
+# setSwitch/getSwitch commands): when off, every entry passes through
+# unguarded and uncounted.
+_enabled = True
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
 class Sph:
     """``CtSph``: chain cache + the entry path."""
 
@@ -150,6 +165,10 @@ class Sph:
         ``BlockException`` on a block verdict."""
         resource = ResourceWrapper(name, entry_type)
         ctx = ctx_mod.get_context()
+        if not _enabled:
+            # global switch off (CtSph.entryWithPriority's Constants.ON
+            # check): pass-through, no stats, no rules
+            return Entry(resource, None, ctx or NullContext(), count, args)
         if isinstance(ctx, NullContext):
             return Entry(resource, None, ctx, count, args)
         if ctx is None:

@@ -44,9 +44,10 @@ def list_commands() -> Dict[str, str]:
 
 
 def _route(method: str, name: str, params: Dict[str, str], body: str) -> Response:
-    if name == "api":
-        return json_response(200, json.dumps(list_commands()))
     handler = get_command(name)
+    if handler is None and name == "api":
+        # fallback if the default handler set was never imported
+        return json_response(200, json.dumps(list_commands()))
     if handler is None:
         return json_response(404, f"Unknown command `{name}`; see /api")
     try:

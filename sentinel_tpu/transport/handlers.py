@@ -316,3 +316,323 @@ def cmd_cluster_server_metrics(params, body):
     if snapshot is None:
         return {}
     return {str(k): v for k, v in snapshot().items()}
+
+
+# ---------------------------------------------------------------------------
+# transport-common parity: api / switch / tree + node variants
+# (``ApiCommandHandler``, ``{Fetch,Modify}SwitchCommandHandler``,
+# ``FetchJsonTreeCommandHandler``, ``FetchClusterNodeByIdCommandHandler``,
+# ``FetchSimpleClusterNodeCommandHandler``)
+# ---------------------------------------------------------------------------
+
+
+@command_mapping("api", "list all supported commands")
+def cmd_api(params, body):
+    from sentinel_tpu.transport.command import list_commands
+
+    return [
+        {"url": f"/{name}", "desc": desc}
+        for name, desc in sorted(list_commands().items())
+    ]
+
+
+@command_mapping("getSwitch", "global guard switch state")
+def cmd_get_switch(params, body):
+    from sentinel_tpu.local import sph as sph_mod
+
+    return {"enabled": sph_mod.is_enabled()}
+
+
+@command_mapping("setSwitch", "toggle the global guard switch; value=true|false")
+def cmd_set_switch(params, body):
+    from sentinel_tpu.local import sph as sph_mod
+
+    value = str(params.get("value", "")).lower()
+    if value not in ("true", "false"):
+        return {"error": "value must be true or false"}
+    sph_mod.set_enabled(value == "true")
+    return "success"
+
+
+@command_mapping("jsonTree", "invocation tree as JSON")
+def cmd_json_tree(params, body):
+    from sentinel_tpu.local import context as ctx_mod
+
+    now = _clock.now_ms()
+
+    def node_dict(node):
+        name = getattr(node, "resource", None)
+        d = {
+            "id": name.name if name else "machine-root",
+            "passQps": node.pass_qps(now) if hasattr(node, "pass_qps") else 0,
+            "blockQps": node.block_qps(now) if hasattr(node, "block_qps") else 0,
+            "averageRt": node.avg_rt(now) if hasattr(node, "avg_rt") else 0,
+            "threadNum": getattr(node, "cur_thread_num", 0),
+            "children": [
+                node_dict(child) for child in getattr(node, "children", [])
+            ],
+        }
+        return d
+
+    return node_dict(ctx_mod.ROOT)
+
+
+@command_mapping("clusterNodeById", "one resource's statistics; id=<resource>")
+def cmd_cluster_node_by_id(params, body):
+    from sentinel_tpu.local.chain import get_cluster_node
+
+    name = params.get("id", "")
+    cn = get_cluster_node(name)
+    if cn is None:
+        return {}
+    now = _clock.now_ms()
+    return {
+        "resourceName": name,
+        "passQps": cn.pass_qps(now),
+        "blockQps": cn.block_qps(now),
+        "totalQps": cn.total_qps(now),
+        "averageRt": cn.avg_rt(now),
+        "exceptionQps": cn.exception_qps(now),
+        "threadNum": cn.cur_thread_num,
+        "oneMinutePass": cn.total_pass_minute(now),
+    }
+
+
+@command_mapping("cnode", "plain-text per-resource statistics table")
+def cmd_cnode(params, body):
+    from sentinel_tpu.local.chain import cluster_node_map
+
+    now = _clock.now_ms()
+    lines = ["resource passQps blockQps totalQps rt threads"]
+    for name, cn in sorted(cluster_node_map().items()):
+        lines.append(
+            f"{name} {cn.pass_qps(now):g} {cn.block_qps(now):g} "
+            f"{cn.total_qps(now):g} {cn.avg_rt(now):g} {cn.cur_thread_num}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# cluster-server command set (``sentinel-cluster-server-default/.../command/
+# handler/``): rule fetch/modify per namespace, config fetch/modify,
+# namespace set, server info, per-namespace metrics
+# ---------------------------------------------------------------------------
+
+
+def _embedded_service():
+    from sentinel_tpu.cluster import api as cluster_api
+
+    service = cluster_api.get_embedded_server()
+    if service is None:
+        return None, {"error": "this machine is not a token server"}
+    return service, None
+
+
+def _flow_rule_to_dict(rule) -> dict:
+    return {
+        "flowId": rule.flow_id,
+        "count": rule.count,
+        "thresholdType": int(rule.mode),
+        "namespace": rule.namespace,
+    }
+
+
+def _flow_rule_from_dict(d: dict, namespace: str):
+    from sentinel_tpu.engine import ClusterFlowRule
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    return ClusterFlowRule(
+        flow_id=int(d["flowId"]),
+        count=float(d["count"]),
+        mode=ThresholdMode(int(d.get("thresholdType", 0))),
+        namespace=namespace,
+    )
+
+
+@command_mapping("cluster/server/flowRules", "cluster flow rules [namespace=]")
+def cmd_cluster_server_flow_rules(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    return [
+        _flow_rule_to_dict(r)
+        for r in service.current_rules(params.get("namespace"))
+    ]
+
+
+@command_mapping(
+    "cluster/server/modifyFlowRules",
+    "replace one namespace's cluster flow rules; namespace=&data=[...]",
+)
+def cmd_cluster_server_modify_flow_rules(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    namespace = params.get("namespace")
+    if not namespace:
+        return {"error": "namespace cannot be empty"}
+    data = json.loads(body or params.get("data", "[]"))
+    service.load_namespace_rules(
+        namespace, [_flow_rule_from_dict(d, namespace) for d in data]
+    )
+    return "success"
+
+
+@command_mapping(
+    "cluster/server/paramRules", "cluster param-flow rules [namespace=]"
+)
+def cmd_cluster_server_param_rules(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    return [
+        {
+            "flowId": r.flow_id,
+            "count": r.count,
+            "namespace": r.namespace,
+            "itemThresholds": [list(t) for t in (r.item_thresholds or ())],
+        }
+        for r in service.current_param_rules(params.get("namespace"))
+    ]
+
+
+@command_mapping(
+    "cluster/server/modifyParamRules",
+    "replace one namespace's cluster param rules; namespace=&data=[...]",
+)
+def cmd_cluster_server_modify_param_rules(params, body):
+    from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
+
+    service, err = _embedded_service()
+    if err:
+        return err
+    namespace = params.get("namespace")
+    if not namespace:
+        return {"error": "namespace cannot be empty"}
+    data = json.loads(body or params.get("data", "[]"))
+    rules = [
+        ClusterParamFlowRule(
+            flow_id=int(d["flowId"]),
+            count=float(d["count"]),
+            item_thresholds=tuple(
+                (int(h), float(c)) for h, c in d.get("itemThresholds", [])
+            ) or None,
+            namespace=namespace,
+        )
+        for d in data
+    ]
+    service.load_namespace_param_rules(namespace, rules)
+    return "success"
+
+
+@command_mapping("cluster/server/fetchConfig", "token-server config view")
+def cmd_cluster_server_fetch_config(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    out = dict(service.config_snapshot())
+    with _EMBEDDED_LOCK:
+        server = _EMBEDDED_SERVER["server"]
+    if server is not None:
+        out["port"] = server.port
+    return out
+
+
+@command_mapping(
+    "cluster/server/modifyFlowConfig",
+    "modify dynamic flow config; data={maxAllowedQps}",
+)
+def cmd_cluster_server_modify_flow_config(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    data = json.loads(body or params.get("data", "{}"))
+    static_keys = {"exceedCount", "maxOccupyRatio", "intervalMs",
+                   "sampleCount"} & set(data)
+    if static_keys:
+        # these are compile-time engine geometry here (EngineConfig is baked
+        # into the jitted step); changing them means re-provisioning the
+        # server, unlike the reference's mutable statics — be explicit
+        return {"error": "static engine config cannot change at runtime: "
+                + ", ".join(sorted(static_keys))}
+    if "maxAllowedQps" in data:
+        service.set_max_allowed_qps(float(data["maxAllowedQps"]))
+    return "success"
+
+
+@command_mapping(
+    "cluster/server/modifyTransportConfig",
+    "move the token-server transport; data={port}",
+)
+def cmd_cluster_server_modify_transport_config(params, body):
+    data = json.loads(body or params.get("data", "{}"))
+    port = int(data.get("port", 0))
+    if not port:
+        return {"error": "port required"}
+    with _EMBEDDED_LOCK:
+        server = _EMBEDDED_SERVER["server"]
+        if server is None:
+            return {"error": "this machine is not a token server"}
+        if server.port == port:
+            return "success"
+        from sentinel_tpu.cluster.server import TokenServer
+
+        server.stop()
+        replacement = TokenServer(server.service, host=server.host, port=port)
+        replacement.start()  # kernels already warm; this is just a rebind
+        _EMBEDDED_SERVER["server"] = replacement
+    return "success"
+
+
+@command_mapping(
+    "cluster/server/modifyNamespaceSet", "set served namespaces; data=[...]"
+)
+def cmd_cluster_server_modify_namespace_set(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    data = json.loads(body or params.get("data", "[]"))
+    service.namespace_set = set(str(ns) for ns in data)
+    return "success"
+
+
+@command_mapping("cluster/server/info", "token-server info (connections, config)")
+def cmd_cluster_server_info(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    with _EMBEDDED_LOCK:
+        server = _EMBEDDED_SERVER["server"]
+    info = {
+        "appName": SentinelConfig.get("project.name") or "sentinel-tpu",
+        "namespaceSet": service.served_namespaces(),
+        "flow": service.config_snapshot(),
+        "embedded": server is not None,
+    }
+    if server is not None:
+        info["port"] = server.port
+        info["connection"] = [
+            {"namespace": ns, "connectedCount": len(addrs),
+             "clients": addrs}
+            for ns, addrs in sorted(server.connections.snapshot().items())
+        ]
+    return info
+
+
+@command_mapping(
+    "cluster/server/metricList", "per-flow metrics for a namespace; namespace="
+)
+def cmd_cluster_server_metric_list(params, body):
+    service, err = _embedded_service()
+    if err:
+        return err
+    namespace = params.get("namespace")
+    if not namespace:
+        return {"error": "namespace cannot be empty"}
+    flow_ids = {r.flow_id for r in service.current_rules(namespace)}
+    snapshot = service.metrics_snapshot()
+    return {
+        str(fid): metrics
+        for fid, metrics in snapshot.items()
+        if fid in flow_ids
+    }

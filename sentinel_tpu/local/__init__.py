@@ -117,6 +117,6 @@ def reset_for_tests() -> None:
     chain.reset_entry_node_for_tests()
     context.reset_for_tests()
     _sph().reset_for_tests()
-    from sentinel_tpu.local import sph as _sph_mod
+    from sentinel_tpu.local.sph import set_enabled as _set_enabled
 
-    _sph_mod.set_enabled(True)
+    _set_enabled(True)

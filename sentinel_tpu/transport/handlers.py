@@ -338,19 +338,19 @@ def cmd_api(params, body):
 
 @command_mapping("getSwitch", "global guard switch state")
 def cmd_get_switch(params, body):
-    from sentinel_tpu.local import sph as sph_mod
+    from sentinel_tpu.local.sph import is_enabled
 
-    return {"enabled": sph_mod.is_enabled()}
+    return {"enabled": is_enabled()}
 
 
 @command_mapping("setSwitch", "toggle the global guard switch; value=true|false")
 def cmd_set_switch(params, body):
-    from sentinel_tpu.local import sph as sph_mod
+    from sentinel_tpu.local.sph import set_enabled as sph_set_enabled
 
     value = str(params.get("value", "")).lower()
     if value not in ("true", "false"):
         return {"error": "value must be true or false"}
-    sph_mod.set_enabled(value == "true")
+    sph_set_enabled(value == "true")
     return "success"
 
 

@@ -97,10 +97,10 @@ def http_post(cc, path, body):
 class TestCommandCenter:
     def test_api_lists_commands(self, command_center):
         status, body = http_get(command_center, "api")
-        cmds = json.loads(body)
+        urls = {item["url"] for item in json.loads(body)}
         for expected in ("version", "getRules", "setRules", "metric",
                          "clusterNode", "basicInfo", "systemStatus"):
-            assert expected in cmds
+            assert f"/{expected}" in urls
 
     def test_version_and_basic_info(self, command_center):
         status, body = http_get(command_center, "version")
@@ -249,3 +249,34 @@ class TestHeartbeat:
         assert hb.send_once() is True
         assert received["port"] == 1234
         assert received["app"]
+
+class TestSwitchCommands:
+    """Regression: sentinel_tpu.local.sph must resolve to the *module*, not the
+    re-exported ``sph`` function (round-2 shadowing bug broke these commands
+    and reset_for_tests)."""
+
+    def test_get_and_set_switch_roundtrip(self, command_center):
+        status, body = http_get(command_center, "getSwitch")
+        assert status == 200
+        assert json.loads(body)["enabled"] is True
+
+        status, body = http_get(command_center, "setSwitch?value=false")
+        assert status == 200 and "success" in body
+        status, body = http_get(command_center, "getSwitch")
+        assert json.loads(body)["enabled"] is False
+
+        http_get(command_center, "setSwitch?value=true")
+        status, body = http_get(command_center, "getSwitch")
+        assert json.loads(body)["enabled"] is True
+
+    def test_set_switch_rejects_bad_value(self, command_center):
+        status, body = http_get(command_center, "setSwitch?value=banana")
+        assert "error" in body
+
+    def test_local_reset_for_tests_direct(self):
+        import sentinel_tpu.local as local_pkg
+
+        local_pkg.reset_for_tests()  # must not raise
+        from sentinel_tpu.local.sph import is_enabled
+
+        assert is_enabled() is True

@@ -1,0 +1,113 @@
+"""Service-lock scaling microbench (no TCP): does the served rate grow with
+concurrent caller threads?
+
+Round-2 review flagged that ``DefaultTokenService.request_batch`` held the
+service lock across numpy prep + device step + verdict unpacking, so a second
+caller thread stalled behind the first. After the round-3 narrowing, the lock
+covers ONLY the device dispatch + state swap; prep and unpack overlap with the
+in-flight step (JAX async dispatch double-buffers for free). This bench
+demonstrates the scaling: rate(2 threads) must exceed rate(1 thread).
+
+Usage: ``python benchmarks/service_scaling_bench.py [--seconds 3]``
+Prints ONE JSON line and appends a copy under ``benchmarks/results/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+
+
+def run(seconds: float = 3.0, batch: int = 256, n_flows: int = 1024) -> dict:
+    import numpy as np
+
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    config = EngineConfig(max_flows=n_flows, max_namespaces=8, batch_size=1024)
+    service = DefaultTokenService(config)
+    service.load_rules(
+        [
+            ClusterFlowRule(flow_id=i, count=1e9, mode=ThresholdMode.GLOBAL)
+            for i in range(n_flows)
+        ],
+        ns_max_qps=1e12,
+    )
+    service.warmup()
+    rng = np.random.default_rng(0)
+
+    # "wide" emulates the round-2 critical section: one lock held across
+    # prep + device step + unpack (what request_batch did before narrowing)
+    wide_lock = threading.Lock()
+
+    def measure(n_threads: int, wide: bool) -> float:
+        counts = [0] * n_threads
+        stop_at = time.perf_counter() + seconds
+
+        def pump(t: int) -> None:
+            flow_ids = rng.integers(0, n_flows, size=batch).astype(np.int64)
+            n = 0
+            while time.perf_counter() < stop_at:
+                if wide:
+                    with wide_lock:
+                        service.request_batch_arrays(flow_ids)
+                else:
+                    service.request_batch_arrays(flow_ids)
+                n += batch
+            counts[t] = n
+
+        threads = [
+            threading.Thread(target=pump, args=(t,)) for t in range(n_threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    measure(1, False)  # warm the compiled shapes / caches, untimed
+    narrow = {n: round(measure(n, False)) for n in (1, 2, 4)}
+    wide = {n: round(measure(n, True)) for n in (2,)}
+    return {
+        "metric": "service_lock_scaling",
+        "value": round(narrow[2] / wide[2], 3),
+        "unit": "narrow_over_wide_rate_ratio_2t",
+        "vs_baseline": 1.0,  # the round-2 wide lock is the baseline
+        "extra": {
+            "narrow_rate_1t": narrow[1],
+            "narrow_rate_2t": narrow[2],
+            "narrow_rate_4t": narrow[4],
+            "wide_rate_2t": wide[2],
+            "batch": batch,
+            "seconds": seconds,
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    result = run(args.seconds)
+    result["extra"]["backend"] = jax.default_backend()
+    line = json.dumps(result)
+    print(line)
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"scaling-{time.strftime('%Y%m%d-%H%M%S')}.json"),
+              "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -373,3 +373,83 @@ SN_EXPORT int64_t sn_pacer_try_pass(void *pp, int32_t slot, int64_t now,
     }
   }
 }
+
+// ---------------------------------------------------------------------------
+// Wire codec for BATCH_FLOW frames (cluster/protocol.py): big-endian packed
+// rows. Decode fills caller-provided (numpy) arrays; encode writes the full
+// frame (length prefix + header + rows) into a caller buffer. These are the
+// token server's per-frame hot path — ctypes releases the GIL around both,
+// so frame codec work overlaps the IO loops under load.
+
+namespace {
+
+inline uint16_t be16(const uint8_t *p) {
+  return uint16_t(p[0]) << 8 | uint16_t(p[1]);
+}
+inline int32_t be32(const uint8_t *p) {
+  return int32_t(uint32_t(p[0]) << 24 | uint32_t(p[1]) << 16 |
+                 uint32_t(p[2]) << 8 | uint32_t(p[3]));
+}
+inline int64_t be64(const uint8_t *p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = v << 8 | p[i];
+  return int64_t(v);
+}
+inline void put16(uint8_t *p, uint16_t v) {
+  p[0] = uint8_t(v >> 8);
+  p[1] = uint8_t(v);
+}
+inline void put32(uint8_t *p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+constexpr int kHead = 5;          // xid:int32 + type:uint8
+constexpr int kReqRow = 13;       // flow_id:int64 + count:int32 + prio:uint8
+constexpr int kRspRow = 9;        // status:int8 + remaining:int32 + wait:int32
+constexpr uint8_t kBatchFlow = 5; // MsgType.BATCH_FLOW
+
+}  // namespace
+
+// payload (without length prefix) → xid, flow_ids[n], counts[n], prios[n].
+// Returns n, or -1 if the payload is malformed/truncated.
+SN_EXPORT int32_t sn_batch_decode_req(const uint8_t *payload, int32_t len,
+                                      int32_t *xid_out, int64_t *flow_ids,
+                                      int32_t *counts, uint8_t *prios,
+                                      int32_t max_n) {
+  if (len < kHead + 2) return -1;
+  *xid_out = be32(payload);
+  int32_t n = be16(payload + kHead);
+  if (n > max_n || len < kHead + 2 + n * kReqRow) return -1;
+  const uint8_t *row = payload + kHead + 2;
+  for (int32_t i = 0; i < n; ++i, row += kReqRow) {
+    flow_ids[i] = be64(row);
+    counts[i] = be32(row + 8);
+    prios[i] = row[12];
+  }
+  return n;
+}
+
+// Encode a full response frame (length prefix included) into out; returns the
+// frame's byte length, or -1 if out_cap is too small or n exceeds a frame.
+SN_EXPORT int32_t sn_batch_encode_rsp(int32_t xid, int32_t n,
+                                      const int8_t *status,
+                                      const int32_t *remaining,
+                                      const int32_t *wait_ms, uint8_t *out,
+                                      int32_t out_cap) {
+  int64_t payload_len = kHead + 2 + int64_t(n) * kRspRow;
+  if (payload_len > 65535 || payload_len + 2 > out_cap) return -1;
+  put16(out, uint16_t(payload_len));
+  put32(out + 2, uint32_t(xid));
+  out[6] = kBatchFlow;
+  put16(out + 7, uint16_t(n));
+  uint8_t *row = out + 9;
+  for (int32_t i = 0; i < n; ++i, row += kRspRow) {
+    row[0] = uint8_t(status[i]);
+    put32(row + 1, uint32_t(remaining[i]));
+    put32(row + 5, uint32_t(wait_ms[i]));
+  }
+  return int32_t(payload_len + 2);
+}

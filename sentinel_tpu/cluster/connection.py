@@ -16,6 +16,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
+from sentinel_tpu.core import clock as _clock
+
 
 class ConnectionManager:
     def __init__(
@@ -26,6 +28,9 @@ class ConnectionManager:
         # address → namespaces it registered (one connection may serve
         # several namespaces; each PING adds one)
         self._by_address: Dict[str, Set[str]] = {}
+        # address → last activity ms (PING or any request), for the idle
+        # sweep (ScanIdleConnectionTask.java analog)
+        self._last_active_ms: Dict[str, int] = {}
         self._on_count_changed = on_count_changed
 
     def add(self, namespace: str, address: str) -> int:
@@ -34,15 +39,40 @@ class ConnectionManager:
             group = self._groups.setdefault(namespace, set())
             group.add(address)
             self._by_address.setdefault(address, set()).add(namespace)
+            self._last_active_ms[address] = _clock.now_ms()
             n = len(group)
         if self._on_count_changed is not None:
             self._on_count_changed(namespace, n)
         return n
 
+    def touch(self, address: str) -> None:
+        """Refresh a connection's liveness (any request counts, like the
+        reference updating ``Connection.lastReadTime`` per channelRead)."""
+        if address in self._by_address:  # racy pre-check is fine: worst case
+            with self._lock:  # a just-removed address gets a stale stamp
+                if address in self._by_address:
+                    self._last_active_ms[address] = _clock.now_ms()
+
+    def sweep_idle(self, ttl_ms: float) -> List[str]:
+        """Drop connections with no PING/request inside ``ttl_ms``; returns
+        the reaped addresses. ``ScanIdleConnectionTask.java`` analog: a
+        wedged client must not inflate AVG_LOCAL connected counts forever
+        (thresholds would stay too high)."""
+        now = _clock.now_ms()
+        with self._lock:
+            stale = [
+                addr for addr, ts in self._last_active_ms.items()
+                if now - ts > ttl_ms
+            ]
+        for addr in stale:
+            self.remove_address(addr)
+        return stale
+
     def remove_address(self, address: str) -> None:
         """Drop every registration of a disconnected client."""
         changed: List[tuple] = []
         with self._lock:
+            self._last_active_ms.pop(address, None)
             for ns in self._by_address.pop(address, ()):
                 group = self._groups.get(ns)
                 if group is not None:
@@ -66,3 +96,47 @@ class ConnectionManager:
         """namespace → sorted addresses (FetchClusterServerInfo shape)."""
         with self._lock:
             return {ns: sorted(g) for ns, g in self._groups.items()}
+
+
+class IdleConnectionSweeper:
+    """Periodic ``sweep_idle`` driver (``ScanIdleConnectionTask.java``: the
+    reference schedules it at fixed rate on the server's scheduler pool).
+
+    The period is wall-clock (daemon timer); the idle judgment itself uses
+    the injectable ``core.clock`` so tests advance a ManualClock and call
+    ``sweep_idle`` directly.
+    """
+
+    def __init__(self, connections: ConnectionManager, ttl_s: float = 600.0,
+                 period_s: Optional[float] = None):
+        self.connections = connections
+        self.ttl_ms = ttl_s * 1000.0
+        self.period_s = period_s if period_s is not None else max(ttl_s / 2, 0.5)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="sentinel-idle-conn-sweep", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        from sentinel_tpu.core.log import record_log
+
+        while not self._stop.wait(self.period_s):
+            reaped = self.connections.sweep_idle(self.ttl_ms)
+            if reaped:
+                record_log.info(
+                    "idle sweep reaped %d connection(s): %s",
+                    len(reaped), ", ".join(reaped),
+                )

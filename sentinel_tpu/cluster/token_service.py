@@ -116,15 +116,30 @@ class DefaultTokenService(TokenService):
         config: Optional[EngineConfig] = None,
         param_config: Optional[ParamConfig] = None,
         mesh=None,
+        serve_buckets: Optional[Sequence[int]] = None,
     ):
         self.config = config or EngineConfig()
-        # serving shape buckets: a lightly-loaded step pads to 64 instead of
-        # the full batch size (the decide cost is shape-proportional — ~4×
-        # cheaper at 64 than 1024 — and state tensors are batch-agnostic, so
-        # each bucket is just one more compiled variant of the same kernel)
-        self._serve_buckets = sorted(
-            {min(64, self.config.batch_size), self.config.batch_size}
-        )
+        # serving shape buckets: a lightly-loaded step pads to the smallest
+        # bucket that fits instead of the full batch size (the decide cost is
+        # shape-proportional — ~4× cheaper at 64 than 1024 — and state
+        # tensors are batch-agnostic, so each bucket is just one more
+        # compiled variant of the same kernel). Default: geometric ×4 ladder
+        # 64, 256, 1024, … up to batch_size, so no batch pays more than ~4×
+        # its size. Warmup compiles 2 variants per bucket; trim the set if
+        # compile time matters more than tail latency.
+        if serve_buckets is None:
+            buckets = set()
+            b = 64
+            while b < self.config.batch_size:
+                buckets.add(b)
+                b *= 4
+            buckets.add(self.config.batch_size)
+        else:
+            buckets = {
+                min(int(b), self.config.batch_size) for b in serve_buckets
+            }
+            buckets.add(self.config.batch_size)
+        self._serve_buckets = sorted(buckets)
         # Optional jax.sharding.Mesh: the flow axis of the engine state and
         # rule table shards across the mesh's devices and the decision step
         # runs under shard_map with psums over ICI — one pod's chips serve
@@ -136,6 +151,9 @@ class DefaultTokenService(TokenService):
         self._state = self._place_state(make_state(self.config))
         table, self._index = build_rule_table(self.config, [])
         self._table = self._place_rules(table)
+        # vectorized flow_id → slot lookup: one (sorted keys, slots) tuple,
+        # swapped atomically on rule load, read lock-free on the hot path
+        self._lookup = (np.empty(0, np.int64), np.empty(0, np.int32))
         self._epoch_ms: Optional[int] = None
         self._connected: Dict[str, int] = {}  # namespace → client count
         self._ns_max_qps = 30_000.0
@@ -219,6 +237,11 @@ class DefaultTokenService(TokenService):
             # through .at[].set isn't guaranteed to keep the flow layout
             self._state = self._place_state(
                 drain_pending_clear(self._index, self._state)
+            )
+            items = sorted(self._index.slot_of.items())
+            self._lookup = (
+                np.fromiter((k for k, _ in items), np.int64, len(items)),
+                np.fromiter((v for _, v in items), np.int32, len(items)),
             )
 
     def load_namespace_rules(
@@ -364,62 +387,127 @@ class DefaultTokenService(TokenService):
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
         return self.request_batch([(flow_id, acquire, prioritized)])[0]
 
-    def request_batch(self, requests) -> List[TokenResult]:
-        if not requests:
-            return []
-        n = len(requests)
+    def lookup_slots(self, flow_ids: np.ndarray) -> np.ndarray:
+        """Vectorized flow_id → slot (-1 when no rule). Lock-free: reads one
+        immutable (keys, slots) snapshot."""
+        return self._lookup_from(self._lookup, flow_ids)
+
+    @staticmethod
+    def _lookup_from(snapshot, flow_ids: np.ndarray) -> np.ndarray:
+        keys, slots = snapshot
+        if keys.size == 0:
+            return np.full(flow_ids.shape, -1, np.int32)
+        pos = np.searchsorted(keys, flow_ids)
+        pos = np.minimum(pos, keys.size - 1)
+        return np.where(keys[pos] == flow_ids, slots[pos], -1).astype(np.int32)
+
+    def request_batch_arrays(
+        self,
+        flow_ids: np.ndarray,
+        acquires: Optional[np.ndarray] = None,
+        prios: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Array-in/array-out decision path: (status int8[N], remaining
+        int32[N], wait_ms int32[N]) in request order.
+
+        This is the serving hot path. The service lock covers ONLY the device
+        dispatch + state swap — host prep (slot lookup, grouping sort, batch
+        padding) runs before it and verdict materialization after it, so with
+        JAX's async dispatch the host preps batch k+1 while the device still
+        executes batch k (the lock-free analog of the reference's
+        unsynchronized ``ClusterFlowChecker.java:55-120`` hot loop).
+        """
+        flow_ids = np.asarray(flow_ids, np.int64)
+        n = flow_ids.shape[0]
+        if n == 0:
+            empty32 = np.empty(0, np.int32)
+            return np.empty(0, np.int8), empty32, empty32
         cap = self.config.batch_size
         if n > cap:  # split oversized bursts
-            out = []
-            for i in range(0, n, cap):
-                out.extend(self.request_batch(requests[i : i + cap]))
-            return out
+            parts = [
+                self.request_batch_arrays(
+                    flow_ids[i : i + cap],
+                    None if acquires is None else acquires[i : i + cap],
+                    None if prios is None else prios[i : i + cap],
+                )
+                for i in range(0, n, cap)
+            ]
+            return tuple(np.concatenate(ps) for ps in zip(*parts))
+        # -- host prep, outside the lock --
+        lookup_snap = self._lookup
+        slots = self._lookup_from(lookup_snap, flow_ids)
+        acq = (
+            np.ones(n, np.int32) if acquires is None
+            else np.asarray(acquires, np.int32)
+        )
+        pr = (
+            np.zeros(n, bool) if prios is None
+            else np.asarray(prios, bool)
+        )
+        # serving fast path: group same-flow requests contiguously (stable,
+        # so greedy admission order within a flow is arrival order) and
+        # detect the uniform-acquire common case — together they skip the
+        # device argsort and the iterative admission refinement (see
+        # decide()'s grouped/uniform flags)
+        order = np.argsort(slots, kind="stable")
+        uniform = bool(acq.min() == acq.max())
+        # smallest compiled shape bucket that fits this batch
+        bucket = next(b for b in self._serve_buckets if n <= b)
+        cfg = self.config._replace(batch_size=bucket)
+        batch = make_batch(cfg, slots[order], acq[order], pr[order])
+        step = self._step_fn(bucket, uniform)
+        # -- device step: the only serialized section --
         with self._lock:
-            slots = np.asarray(
-                [self._index.lookup(f) for f, _, _ in requests], np.int32
-            )
-            acquires = np.asarray([a for _, a, _ in requests], np.int32)
-            prios = np.asarray([p for _, _, p in requests], bool)
-            # serving fast path: group same-flow requests contiguously
-            # (stable, so greedy admission order within a flow is arrival
-            # order) and detect the uniform-acquire common case — together
-            # they skip the device argsort and the iterative admission
-            # refinement (see decide()'s grouped/uniform flags)
-            order = np.argsort(slots, kind="stable")
-            uniform = bool(acquires.min() == acquires.max())
-            # smallest compiled shape bucket that fits this batch
-            bucket = next(b for b in self._serve_buckets if n <= b)
-            cfg = self.config._replace(batch_size=bucket)
-            batch = make_batch(
-                cfg, slots[order], acquires[order], prios[order]
-            )
+            if self._lookup is not lookup_snap:
+                # rules reloaded between prep and step: slot assignments may
+                # have moved, so redo the slot-dependent prep against the
+                # live table (rare, and still under the lock — the same
+                # atomicity load_rules callers had before the narrowing)
+                slots = self._lookup_from(self._lookup, flow_ids)
+                order = np.argsort(slots, kind="stable")
+                batch = make_batch(cfg, slots[order], acq[order], pr[order])
             now = self._engine_now()
-            step = self._step_fn(bucket, uniform)
             self._state, verdicts = step(
                 self._state, self._table, batch, np.int32(now)
             )
-        status = np.asarray(verdicts.status)
-        remaining = np.asarray(verdicts.remaining)
-        wait = np.asarray(verdicts.wait_ms)
+        # -- verdict materialization (blocks on the async dispatch), outside --
+        status_sorted = np.asarray(verdicts.status)[:n]
+        remaining_sorted = np.asarray(verdicts.remaining)[:n]
+        wait_sorted = np.asarray(verdicts.wait_ms)[:n]
+        status = np.empty(n, status_sorted.dtype)
+        remaining = np.empty(n, np.int32)
+        wait = np.empty(n, np.int32)
+        status[order] = status_sorted
+        remaining[order] = remaining_sorted
+        wait[order] = wait_sorted
         # cluster server stat log (ClusterServerStatLogUtil analog): one
         # aggregated counter per verdict class per window
         from sentinel_tpu.metrics.stat_logger import log_cluster
 
-        head = status[:n]
         for event, code in (
             ("pass", int(TokenStatus.OK)),
             ("block", int(TokenStatus.BLOCKED)),
             ("occupied", int(TokenStatus.SHOULD_WAIT)),
             ("tooManyRequest", int(TokenStatus.TOO_MANY_REQUEST)),
         ):
-            hits = int((head == code).sum())
+            hits = int((status == code).sum())
             if hits:
                 log_cluster(event, count=hits)
-        inv = np.empty_like(order)
-        inv[order] = np.arange(n)
+        return status, remaining, wait
+
+    def request_batch(self, requests) -> List[TokenResult]:
+        if not requests:
+            return []
+        n = len(requests)
+        flow_ids = np.fromiter((f for f, _, _ in requests), np.int64, n)
+        acquires = np.fromiter((a for _, a, _ in requests), np.int32, n)
+        prios = np.fromiter((p for _, _, p in requests), bool, n)
+        status, remaining, wait = self.request_batch_arrays(
+            flow_ids, acquires, prios
+        )
         return [
-            TokenResult(TokenStatus(int(status[j])), int(remaining[j]), int(wait[j]))
-            for j in (int(inv[i]) for i in range(n))
+            TokenResult(TokenStatus(int(status[i])), int(remaining[i]), int(wait[i]))
+            for i in range(n)
         ]
 
     def load_param_rules(self, rules: List[ClusterParamFlowRule]) -> None:

@@ -48,6 +48,22 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         "sn_pacer_destroy": ([P], None),
         "sn_pacer_reset": ([P, I32], None),
         "sn_pacer_try_pass": ([P, I32, I64, I32, F64, I64], I64),
+        "sn_batch_decode_req": (
+            [
+                ctypes.c_char_p, I32, ctypes.POINTER(I32),
+                ctypes.POINTER(I64), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8), I32,
+            ],
+            I32,
+        ),
+        "sn_batch_encode_rsp": (
+            [
+                I32, I32, ctypes.POINTER(ctypes.c_int8),
+                ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8), I32,
+            ],
+            I32,
+        ),
     }
     for name, (argtypes, restype) in sig.items():
         fn = getattr(lib, name)
@@ -77,6 +93,60 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def batch_decode_req(payload: bytes):
+    """BATCH_FLOW request payload → (xid, flow_ids int64[N], counts int32[N],
+    prios bool[N]); None when the native lib is absent; raises ValueError on
+    a malformed frame (mirrors the numpy codec's behavior)."""
+    lib = load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    max_n = max((len(payload) - 7) // 13, 0)
+    xid = ctypes.c_int32()
+    flow_ids = np.empty(max_n, np.int64)
+    counts = np.empty(max_n, np.int32)
+    prios = np.empty(max_n, np.uint8)
+    n = lib.sn_batch_decode_req(
+        payload, len(payload), ctypes.byref(xid),
+        flow_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        prios.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        max_n,
+    )
+    if n < 0:
+        raise ValueError("malformed BATCH_FLOW frame")
+    return (
+        int(xid.value), flow_ids[:n], counts[:n], prios[:n].astype(bool)
+    )
+
+
+def batch_encode_rsp(xid: int, status, remaining, wait_ms):
+    """(status int8[N], remaining int32[N], wait int32[N]) → full response
+    frame bytes (length prefix included); None when the lib is absent."""
+    lib = load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    status = np.ascontiguousarray(status, np.int8)
+    remaining = np.ascontiguousarray(remaining, np.int32)
+    wait_ms = np.ascontiguousarray(wait_ms, np.int32)
+    n = status.shape[0]
+    out = np.empty(2 + 7 + n * 9, np.uint8)
+    wrote = lib.sn_batch_encode_rsp(
+        xid, n,
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        remaining.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        wait_ms.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.shape[0],
+    )
+    if wrote < 0:
+        raise ValueError("batch too large for one frame")
+    return out[:wrote].tobytes()
 
 
 class NativeWindow:

@@ -51,10 +51,63 @@ class TestCodec:
             frames.extend(fr.feed(req[i : i + 3]))
         assert [P.decode_request(f).xid for f in frames] == [1, 2]
 
-    def test_oversized_frame_rejected(self):
+    def test_runt_frame_rejected(self):
         fr = P.FrameReader()
         with pytest.raises(ValueError):
-            fr.feed(b"\xff\xff" + b"x" * 100)
+            fr.feed(b"\x00\x02xx")
+
+    def test_zero_length_frame_rejected(self):
+        # an empty payload would crash peek_type downstream; reject at the
+        # reader like any other runt
+        fr = P.FrameReader()
+        with pytest.raises(ValueError):
+            fr.feed(b"\x00\x00")
+
+    def test_single_request_frame_budget_enforced(self):
+        # single-request messages keep the reference's 1024-byte frame cap
+        req = P.FlowRequest(
+            1, 1, 1, False, P.MsgType.PARAM_FLOW,
+            tuple(range(200)),  # 200×8 B of hashes > 1024
+        )
+        with pytest.raises(ValueError):
+            P.encode_request(req)
+
+    def test_batch_roundtrip(self):
+        import numpy as np
+
+        ids = np.array([5, -3, 2**40, 7], np.int64)
+        cnt = np.array([1, 2, 3, 4], np.int32)
+        pri = np.array([True, False, True, False])
+        frame = P.encode_batch_request(77, ids, cnt, pri)
+        payload = frame[2:]
+        assert P.peek_type(payload) == P.MsgType.BATCH_FLOW
+        xid, i2, c2, p2 = P.decode_batch_request(payload)
+        assert xid == 77
+        np.testing.assert_array_equal(i2, ids)
+        np.testing.assert_array_equal(c2, cnt)
+        np.testing.assert_array_equal(p2, pri)
+
+    def test_batch_response_roundtrip(self):
+        import numpy as np
+
+        st = np.array([0, 1, 2, -1], np.int8)
+        rem = np.array([10, 0, 5, 0], np.int32)
+        wt = np.array([0, 0, 250, 0], np.int32)
+        xid, s2, r2, w2 = P.decode_batch_response(
+            P.encode_batch_response(9, st, rem, wt)[2:]
+        )
+        assert xid == 9
+        np.testing.assert_array_equal(s2, st)
+        np.testing.assert_array_equal(r2, rem)
+        np.testing.assert_array_equal(w2, wt)
+
+    def test_batch_frame_cap(self):
+        import numpy as np
+
+        with pytest.raises(ValueError):
+            P.encode_batch_request(
+                1, np.zeros(P.MAX_BATCH_PER_FRAME + 1, np.int64)
+            )
 
 
 class TestTokenServiceDirect:
@@ -220,6 +273,168 @@ class TestTransport:
         r = client.request_token(1)
         assert r.status == TokenStatus.FAIL
         client.close()
+
+    def test_batch_frame_roundtrip(self, live_server):
+        import numpy as np
+
+        server, svc = live_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            out = client.request_batch_arrays(np.full(8, 1, np.int64))
+            assert out is not None
+            status, remaining, wait = out
+            assert status.shape == (8,)
+            assert int((status == int(TokenStatus.OK)).sum()) == 5
+            assert int((status == int(TokenStatus.BLOCKED)).sum()) == 3
+        finally:
+            client.close()
+
+    def test_batch_matches_single_semantics(self, live_server):
+        # one batched frame and N single frames must consume the same budget
+        server, svc = live_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            results = client.request_batch([(1, 1, False)] * 4)
+            assert sum(r.ok for r in results) == 4
+            singles = [client.request_token(1) for _ in range(4)]
+            assert sum(r.ok for r in singles) == 1  # 5-budget exhausted at 5
+        finally:
+            client.close()
+
+    def test_batch_unknown_flow_gets_no_rule(self, live_server):
+        import numpy as np
+
+        server, svc = live_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            status, _, _ = client.request_batch_arrays(
+                np.array([999999], np.int64)
+            )
+            assert int(status[0]) == int(TokenStatus.NO_RULE_EXISTS)
+        finally:
+            client.close()
+
+
+class TestMultiLoopServer:
+    def test_reuseport_loops_share_budget(self):
+        import numpy as np
+
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=10.0, mode=G)])
+        server = TokenServer(svc, port=0, n_loops=2)
+        server.start()
+        try:
+            clients = [
+                TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+                for _ in range(4)
+            ]
+            oks = 0
+            for c in clients:
+                out = c.request_batch_arrays(np.full(5, 1, np.int64))
+                assert out is not None
+                oks += int((out[0] == int(TokenStatus.OK)).sum())
+            for c in clients:
+                c.close()
+            assert oks == 10  # one budget across both loops
+        finally:
+            server.stop()
+
+
+class TestIdleReaping:
+    def test_sweep_deflates_connected_count(self, manual_clock):
+        from sentinel_tpu.cluster.connection import ConnectionManager
+
+        counts = {}
+        cm = ConnectionManager(
+            on_count_changed=lambda ns, n: counts.__setitem__(ns, n)
+        )
+        cm.add("default", "10.0.0.1:1000")
+        cm.add("default", "10.0.0.2:1000")
+        assert counts["default"] == 2
+        manual_clock.advance(500_000)
+        cm.touch("10.0.0.2:1000")  # one client stays live
+        manual_clock.advance(400_000)  # first client now idle 900s
+        reaped = cm.sweep_idle(ttl_ms=600_000)
+        assert reaped == ["10.0.0.1:1000"]
+        assert counts["default"] == 1
+        assert cm.connected_count("default") == 1
+
+    def test_batch_traffic_refreshes_liveness(self, live_server, manual_clock):
+        # a batch-only client (the high-throughput path) must not be reaped
+        # while it is actively sending
+        import numpy as np
+
+        server, svc = live_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            assert client.ping()
+            assert server.connections.connected_count("default") == 1
+            manual_clock.advance(500_000)
+            assert client.request_batch_arrays(np.array([1], np.int64)) is not None
+            manual_clock.advance(200_000)  # 700s since ping, 200s since batch
+            assert server.connections.sweep_idle(ttl_ms=600_000) == []
+            assert server.connections.connected_count("default") == 1
+        finally:
+            client.close()
+
+    def test_rule_reload_during_flight_uses_live_slots(self, manual_clock):
+        # the lock-narrowed path re-validates its lookup snapshot under the
+        # lock; a reload landing between prep and step must not decide
+        # against stale slot indices. Injected deterministically: a hooked
+        # lock performs the reload the moment the hot path tries to acquire.
+        import numpy as np
+
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=5.0, mode=G)])
+        real_lock = svc._lock
+
+        class ReloadOnEnter:
+            fired = False
+
+            def __enter__(self):
+                if not ReloadOnEnter.fired:
+                    ReloadOnEnter.fired = True
+                    svc._lock = real_lock  # reload takes the real lock
+                    svc.load_rules(
+                        [
+                            ClusterFlowRule(flow_id=2, count=7.0, mode=G),
+                            ClusterFlowRule(flow_id=1, count=5.0, mode=G),
+                        ]
+                    )
+                return real_lock.__enter__()
+
+            def __exit__(self, *exc):
+                return real_lock.__exit__(*exc)
+
+        svc._lock = ReloadOnEnter()
+        # prep sees the pre-reload snapshot (flow 2 unknown → slot -1);
+        # without the under-lock recheck every verdict would be
+        # NO_RULE_EXISTS, with it flow 2's fresh 7-budget applies
+        status, _, _ = svc.request_batch_arrays(np.full(10, 2, np.int64))
+        assert ReloadOnEnter.fired
+        assert int((status == int(TokenStatus.OK)).sum()) == 7
+        assert int((status == int(TokenStatus.BLOCKED)).sum()) == 3
+
+    def test_wedged_client_threshold_deflates(self, manual_clock):
+        # end-to-end: AVG_LOCAL threshold = count × connected; a wedged
+        # client's share must be reclaimed by the sweep
+        svc = DefaultTokenService(CFG)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=3, count=4.0, mode=ThresholdMode.AVG_LOCAL)]
+        )
+        notify = svc.connected_count_changed
+        from sentinel_tpu.cluster.connection import ConnectionManager
+
+        cm = ConnectionManager(on_count_changed=notify)
+        cm.add("default", "a:1")
+        cm.add("default", "b:1")  # threshold now 8
+        oks = sum(svc.request_token(3).ok for _ in range(10))
+        assert oks == 8
+        manual_clock.advance(700_000)
+        cm.sweep_idle(ttl_ms=600_000)  # both idle → reaped; count floors at 1
+        manual_clock.advance(2_000)  # fresh window
+        oks = sum(svc.request_token(3).ok for _ in range(10))
+        assert oks == 4  # deflated to one client's share
 
 
 class TestEmbeddedClusterFlow:

@@ -188,3 +188,62 @@ class TestHammer:
         assert node.min_rt(now=100) == pytest.approx(20.0)
         node.add_occupied_pass(1, wait_ms=500, now=100)
         assert node.try_occupy_next(100, 1, threshold=10.0) <= 500
+
+
+class TestBatchCodecParity:
+    """Native wire codec must be bit-identical with the numpy codec."""
+
+    def test_decode_req_matches_numpy(self, native):
+        import numpy as np
+
+        from sentinel_tpu.cluster import protocol as P
+        from sentinel_tpu.native import lib as native_lib
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(-(2**62), 2**62, size=257)
+        cnt = rng.integers(1, 100, size=257).astype(np.int32)
+        pri = rng.integers(0, 2, size=257).astype(bool)
+        payload = P.encode_batch_request(42, ids, cnt, pri)[2:]
+        nx, ni, nc, npr = native_lib.batch_decode_req(payload)
+        assert nx == 42
+        np.testing.assert_array_equal(ni, ids)
+        np.testing.assert_array_equal(nc, cnt)
+        np.testing.assert_array_equal(npr, pri)
+
+    def test_decode_req_rejects_truncated(self, native):
+        import numpy as np
+        import pytest
+
+        from sentinel_tpu.cluster import protocol as P
+        from sentinel_tpu.native import lib as native_lib
+
+        payload = P.encode_batch_request(1, np.arange(4, dtype=np.int64))[2:]
+        with pytest.raises(ValueError):
+            native_lib.batch_decode_req(payload[:-5])
+
+    def test_encode_rsp_matches_numpy(self, native):
+        import numpy as np
+
+        from sentinel_tpu.cluster import protocol as P
+        from sentinel_tpu.native import lib as native_lib
+
+        rng = np.random.default_rng(1)
+        st = rng.integers(-2, 5, size=300).astype(np.int8)
+        rem = rng.integers(0, 2**31 - 1, size=300).astype(np.int32)
+        wt = rng.integers(0, 10_000, size=300).astype(np.int32)
+        native_frame = native_lib.batch_encode_rsp(7, st, rem, wt)
+        # numpy reference layout (bypass the native-preferring dispatch)
+        rows = np.empty(300, dtype=P.BATCH_RSP_DTYPE)
+        rows["status"] = st
+        rows["remaining"] = rem
+        rows["wait_ms"] = wt
+        expect = (
+            P._LEN.pack(P._HEAD.size + 2 + 300 * 9)
+            + P._HEAD.pack(7, P.MsgType.BATCH_FLOW)
+            + P._BATCH_N.pack(300)
+            + rows.tobytes()
+        )
+        assert native_frame == expect
+        xid, s2, r2, w2 = P.decode_batch_response(native_frame[2:])
+        assert xid == 7
+        np.testing.assert_array_equal(s2, st)

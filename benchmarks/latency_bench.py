@@ -50,7 +50,7 @@ def _client_worker(k: int, port: int, n_requests: int, n_flows: int,
 
 
 def run(n_clients: int = 8, n_requests: int = 2000, n_flows: int = 1024,
-        timeout_ms: int = 200, port: int = 0) -> dict:
+        timeout_ms: int = 200, port: int = 0, n_loops: int = 2) -> dict:
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
     from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
@@ -67,7 +67,7 @@ def run(n_clients: int = 8, n_requests: int = 2000, n_flows: int = 1024,
         ns_max_qps=1e12,
     )
     # port 0 = ephemeral; read the bound port back after start
-    server = TokenServer(service, host="127.0.0.1", port=port)
+    server = TokenServer(service, host="127.0.0.1", port=port, n_loops=n_loops)
     server.start()
     port = server.port
 
@@ -114,6 +114,7 @@ def run(n_clients: int = 8, n_requests: int = 2000, n_flows: int = 1024,
             "requests": total,
             "error_or_timeout": int(errors),
             "target_p99_ms": 2.0,
+            "server_loops": n_loops,
         },
     }
 

@@ -55,6 +55,15 @@ def set_embedded_server(service: TokenService) -> None:
         _mode = ClusterMode.SERVER
 
 
+def clear_embedded_server() -> None:
+    """Demotion path: forget the embedded service WITHOUT switching modes —
+    cluster/server/* commands must answer 'not a token server' afterwards
+    instead of operating on a stopped server's service."""
+    global _embedded
+    with _lock:
+        _embedded = None
+
+
 def set_mode(mode: ClusterMode) -> None:
     global _mode
     with _lock:

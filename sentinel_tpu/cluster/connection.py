@@ -31,7 +31,17 @@ class ConnectionManager:
         # address → last activity ms (PING or any request), for the idle
         # sweep (ScanIdleConnectionTask.java analog)
         self._last_active_ms: Dict[str, int] = {}
+        # address → transport closer; the sweep CLOSES reaped connections
+        # (like the reference closing the netty channel) so a client that
+        # was merely quiet reconnects + re-PINGs and is counted again
+        self._closers: Dict[str, Callable[[], None]] = {}
         self._on_count_changed = on_count_changed
+
+    def attach_closer(self, address: str, closer: Callable[[], None]) -> None:
+        """Register the transport-close hook for a connection (thread-safe
+        callable; the server passes a loop.call_soon_threadsafe wrapper)."""
+        with self._lock:
+            self._closers[address] = closer
 
     def add(self, namespace: str, address: str) -> int:
         """Register; returns the group's connected count (PING response)."""
@@ -54,17 +64,25 @@ class ConnectionManager:
                     self._last_active_ms[address] = _clock.now_ms()
 
     def sweep_idle(self, ttl_ms: float) -> List[str]:
-        """Drop connections with no PING/request inside ``ttl_ms``; returns
-        the reaped addresses. ``ScanIdleConnectionTask.java`` analog: a
-        wedged client must not inflate AVG_LOCAL connected counts forever
-        (thresholds would stay too high)."""
+        """Close + drop connections with no PING/request inside ``ttl_ms``;
+        returns the reaped addresses. ``ScanIdleConnectionTask.java`` analog:
+        a wedged client must not inflate AVG_LOCAL connected counts forever
+        (thresholds would stay too high). Closing the transport — not just
+        deregistering — means a merely-quiet client notices, reconnects, and
+        re-PINGs back into its group instead of being undercounted forever."""
         now = _clock.now_ms()
         with self._lock:
             stale = [
                 addr for addr, ts in self._last_active_ms.items()
                 if now - ts > ttl_ms
             ]
-        for addr in stale:
+            closers = [self._closers.get(a) for a in stale]
+        for addr, closer in zip(stale, closers):
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    pass
             self.remove_address(addr)
         return stale
 
@@ -73,6 +91,7 @@ class ConnectionManager:
         changed: List[tuple] = []
         with self._lock:
             self._last_active_ms.pop(address, None)
+            self._closers.pop(address, None)
             for ns in self._by_address.pop(address, ()):
                 group = self._groups.get(ns)
                 if group is not None:

@@ -45,6 +45,12 @@ class RoutingTokenClient(TokenService):
         # namespaces each pod's client has declared via the PING handshake —
         # a pod can serve several, and AVG_LOCAL counts need every one
         self._declared: Dict[str, set] = {}
+        # concurrent-mode: per-pod token ids are local counters (each pod's
+        # ConcurrencyManager counts from 1), so the router namespaces the
+        # ids it returns by embedding a pod number in the high bits — the
+        # caller-visible id is globally unique and release routes exactly
+        self._pod_nums: Dict[str, int] = {}  # pod_id → 1-based number
+        self._pods_by_num: Dict[int, str] = {}
 
     # -- reconfiguration ----------------------------------------------------
     def update(
@@ -70,7 +76,11 @@ class RoutingTokenClient(TokenService):
                         if close:
                             close()
 
-    def _client_for(self, flow_id: int) -> Optional[TokenService]:
+    def _route_for(self, flow_id: int):
+        """(client, pod_id) actually routed to, or None. One lock acquisition
+        decides the route — callers that need the pod identity (concurrent
+        token-id prefixing) must use THIS pair, not re-derive the pod, or a
+        concurrent update() can name a different pod than the issuer."""
         declare = False
         with self._lock:
             ns = self._namespace_of.get(flow_id)
@@ -101,7 +111,11 @@ class RoutingTokenClient(TokenService):
             ping = getattr(client, "ping", None)
             if ping is not None:
                 ping(namespace=ns)
-        return client
+        return client, pod_id
+
+    def _client_for(self, flow_id: int) -> Optional[TokenService]:
+        route = self._route_for(flow_id)
+        return None if route is None else route[0]
 
     # -- TokenService -------------------------------------------------------
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
@@ -118,23 +132,52 @@ class RoutingTokenClient(TokenService):
             return TokenResult(TokenStatus.NO_RULE_EXISTS)
         return client.request_params_token(flow_id, acquire, param_hashes)
 
+    # pod number lives in bits 48+ of the caller-visible token id; pod-local
+    # ids below 2^48 (a per-pod counter would take >8900 years at 1M acq/s)
+    _POD_ID_SHIFT = 48
+    _LOCAL_ID_MASK = (1 << 48) - 1
+
     def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
-        client = self._client_for(flow_id)
-        if client is None:
+        route = self._route_for(flow_id)
+        if route is None:
             return TokenResult(TokenStatus.NO_RULE_EXISTS)
-        return client.request_concurrent_token(flow_id, acquire, prioritized)
+        client, pod_id = route
+        result = client.request_concurrent_token(flow_id, acquire, prioritized)
+        if (
+            result.ok and result.token_id
+            and result.token_id <= self._LOCAL_ID_MASK
+        ):
+            with self._lock:
+                num = self._pod_nums.get(pod_id)
+                if num is None:
+                    num = len(self._pod_nums) + 1
+                    self._pod_nums[pod_id] = num
+                    self._pods_by_num[num] = pod_id
+            return TokenResult(
+                result.status, result.remaining, result.wait_ms,
+                (num << self._POD_ID_SHIFT) | result.token_id,
+            )
+        return result
 
     def release_concurrent_token(self, token_id):
-        # token ids don't carry the flow — broadcast the release; exactly
-        # one pod holds the token (reference releases against the issuing
-        # server; a router must fan out or remember issuance — we fan out)
+        token_id = int(token_id)
+        num = token_id >> self._POD_ID_SHIFT
+        local_id = token_id & self._LOCAL_ID_MASK
         with self._lock:
-            clients = list(self._clients.values())
+            pod_id = self._pods_by_num.get(num)
+            if pod_id is not None and pod_id in self._clients:
+                clients = [self._clients[pod_id]]
+            else:
+                # unprefixed id (issued elsewhere) or pod since removed:
+                # degrade to first-success fan-out with the raw id
+                clients = list(self._clients.values())
+                local_id = token_id
         result = TokenResult(TokenStatus.FAIL)
         for client in clients:
-            r = client.release_concurrent_token(token_id)
-            if r.status == TokenStatus.OK:
-                result = r
+            r = client.release_concurrent_token(local_id)
+            if r.ok:  # RELEASE_OK — a release never answers plain OK
+                return r
+            result = r
         return result
 
     def close(self) -> None:

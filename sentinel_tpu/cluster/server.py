@@ -142,6 +142,10 @@ class _LoopWorker:
         frames = P.FrameReader()
         peer = writer.get_extra_info("peername")
         address = f"{peer[0]}:{peer[1]}" if peer else repr(writer)
+        loop = asyncio.get_running_loop()
+        srv.connections.attach_closer(
+            address, lambda: loop.call_soon_threadsafe(writer.close)
+        )
         try:
             while True:
                 data = await reader.read(65536)
@@ -365,14 +369,15 @@ class _LoopWorker:
         for i, (item, writer) in enumerate(batch):
             try:
                 if isinstance(item, _BatchFrame):
-                    status, remaining, wait = frame_slices.get(
-                        i,
-                        (
-                            np.full(len(item.flow_ids), int(TokenStatus.FAIL), np.int8),
-                            np.zeros(len(item.flow_ids), np.int32),
-                            np.zeros(len(item.flow_ids), np.int32),
-                        ),
-                    )
+                    sliced = frame_slices.get(i)
+                    if sliced is None:  # only when the frame was empty
+                        k = len(item.flow_ids)
+                        sliced = (
+                            np.full(k, int(TokenStatus.FAIL), np.int8),
+                            np.zeros(k, np.int32),
+                            np.zeros(k, np.int32),
+                        )
+                    status, remaining, wait = sliced
                     writer.write(
                         P.encode_batch_response(item.xid, status, remaining, wait)
                     )
@@ -447,7 +452,11 @@ class TokenServer:
             ok = worker.started.wait(timeout=5)
             if worker.start_error is not None or not ok:
                 err = worker.start_error
-                self.stop()
+                # unwind ONLY what this failed start created — the caller's
+                # service stays usable (its close() is for a started server)
+                workers, self._workers = self._workers, []
+                for w in workers:
+                    w.stop()
                 raise RuntimeError(f"token server failed to start: {err}") from err
         if self.idle_ttl_s:
             from sentinel_tpu.cluster.connection import IdleConnectionSweeper

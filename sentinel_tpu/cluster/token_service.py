@@ -91,6 +91,26 @@ class TokenService:
         """Vectorized form: list of (flow_id, acquire, prioritized)."""
         return [self.request_token(f, a, p) for f, a, p in requests]
 
+    def request_batch_arrays(self, flow_ids, acquires=None, prios=None):
+        """Array form: (status int8[N], remaining int32[N], wait_ms int32[N])
+        in request order. The transport speaks this; the default delegates to
+        ``request_batch`` so any SPI implementation serves batch frames."""
+        n = len(flow_ids)
+        results = self.request_batch(
+            [
+                (
+                    int(flow_ids[i]),
+                    1 if acquires is None else int(acquires[i]),
+                    False if prios is None else bool(prios[i]),
+                )
+                for i in range(n)
+            ]
+        )
+        status = np.fromiter((int(r.status) for r in results), np.int8, n)
+        remaining = np.fromiter((r.remaining for r in results), np.int32, n)
+        wait = np.fromiter((r.wait_ms for r in results), np.int32, n)
+        return status, remaining, wait
+
     def request_concurrent_token(
         self, flow_id: int, acquire: int = 1, prioritized: bool = False
     ) -> TokenResult:
@@ -148,6 +168,12 @@ class DefaultTokenService(TokenService):
         self.mesh = mesh
         self._sharded_steps: Dict[Tuple[int, bool], object] = {}
         self._lock = threading.Lock()
+        # outer mutex for rule read-modify-write sequences: a namespace
+        # replacement (merge current rules + load) must be atomic against a
+        # concurrent replacement of ANOTHER namespace, or the later load
+        # silently drops the earlier one's rules. Reentrant so
+        # load_namespace_rules → load_rules nests.
+        self._rules_mutex = threading.RLock()
         self._state = self._place_state(make_state(self.config))
         table, self._index = build_rule_table(self.config, [])
         self._table = self._place_rules(table)
@@ -219,7 +245,7 @@ class DefaultTokenService(TokenService):
         ns_max_qps: Optional[float] = None,
         connected: Optional[Dict[str, int]] = None,
     ) -> None:
-        with self._lock:
+        with self._rules_mutex, self._lock:
             if ns_max_qps is not None:
                 self._ns_max_qps = ns_max_qps
             if connected is not None:
@@ -256,15 +282,16 @@ class DefaultTokenService(TokenService):
             else ClusterFlowRule(r.flow_id, r.count, r.mode, namespace)
             for r in rules
         ]
-        with self._lock:
-            merged = {
-                ns: dict(m) for ns, m in self._rules_by_ns.items()
-                if ns != namespace
-            }
-            if fixed:
-                merged[namespace] = {r.flow_id: r for r in fixed}
-            flat = [r for m in merged.values() for r in m.values()]
-        self.load_rules(flat)
+        with self._rules_mutex:
+            with self._lock:
+                merged = {
+                    ns: dict(m) for ns, m in self._rules_by_ns.items()
+                    if ns != namespace
+                }
+                if fixed:
+                    merged[namespace] = {r.flow_id: r for r in fixed}
+                flat = [r for m in merged.values() for r in m.values()]
+            self.load_rules(flat)
 
     def current_rules(
         self, namespace: Optional[str] = None
@@ -284,7 +311,8 @@ class DefaultTokenService(TokenService):
     def set_max_allowed_qps(self, qps: float) -> None:
         """Dynamic ``ServerFlowConfig.maxAllowedQps`` update — rebuilds the
         namespace-guard row of the rule table without retracing."""
-        self.load_rules(self.current_rules(), ns_max_qps=float(qps))
+        with self._rules_mutex:
+            self.load_rules(self.current_rules(), ns_max_qps=float(qps))
 
     def config_snapshot(self) -> Dict[str, object]:
         """Flow-config view (cluster/server/fetchConfig shape)."""
@@ -513,7 +541,7 @@ class DefaultTokenService(TokenService):
     def load_param_rules(self, rules: List[ClusterParamFlowRule]) -> None:
         """``ClusterParamFlowRuleManager`` analog; slots stable across
         reloads, freed slots cleared."""
-        with self._lock:
+        with self._rules_mutex, self._lock:
             live = {r.flow_id for r in rules}
             # validate capacity BEFORE mutating so a failed load cannot leave
             # a half-applied rule set
@@ -554,12 +582,13 @@ class DefaultTokenService(TokenService):
                                       namespace)
             for r in rules
         ]
-        with self._lock:
-            keep = [
-                r for r in self._param_rules_src.values()
-                if r.namespace != namespace
-            ]
-        self.load_param_rules(keep + fixed)
+        with self._rules_mutex:
+            with self._lock:
+                keep = [
+                    r for r in self._param_rules_src.values()
+                    if r.namespace != namespace
+                ]
+            self.load_param_rules(keep + fixed)
 
     def current_param_rules(
         self, namespace: Optional[str] = None

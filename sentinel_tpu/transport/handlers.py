@@ -266,6 +266,9 @@ def cmd_set_cluster_mode(params, body):
         if prev is not None:
             _EMBEDDED_SERVER["server"] = None
             prev.stop()
+            # the demoted server's service must not keep answering
+            # cluster/server/* commands as if this were still a token server
+            cluster_api.clear_embedded_server()
         cluster_api.set_mode(cluster_api.ClusterMode(mode))
         return "success"
 

@@ -415,6 +415,37 @@ class TestIdleReaping:
         assert int((status == int(TokenStatus.OK)).sum()) == 7
         assert int((status == int(TokenStatus.BLOCKED)).sum()) == 3
 
+    def test_sweep_closes_transport_and_client_recovers(self, manual_clock):
+        # reaping must CLOSE the connection (reference closes the channel),
+        # so a merely-quiet client reconnects + re-PINGs and is counted again
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=5.0, mode=G)])
+        server = TokenServer(svc, port=0)
+        server.start()
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            assert client.ping()
+            assert server.connections.connected_count("default") == 1
+            manual_clock.advance(700_000)
+            reaped = server.connections.sweep_idle(ttl_ms=600_000)
+            assert len(reaped) == 1
+            assert server.connections.connected_count("default") == 0
+            # client sees EOF and drops its socket
+            deadline = time.time() + 5
+            while client._sock is not None and time.time() < deadline:
+                time.sleep(0.02)
+            assert client._sock is None
+            client._last_connect_attempt = 0.0  # skip reconnect backoff
+            assert client.request_token(1).status is not TokenStatus.FAIL
+            deadline = time.time() + 5  # ctor-namespace ping re-registers
+            while (server.connections.connected_count("default") == 0
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            assert server.connections.connected_count("default") == 1
+        finally:
+            client.close()
+            server.stop()
+
     def test_wedged_client_threshold_deflates(self, manual_clock):
         # end-to-end: AVG_LOCAL threshold = count × connected; a wedged
         # client's share must be reclaimed by the sweep

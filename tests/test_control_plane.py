@@ -81,8 +81,10 @@ def command_center():
     cc.stop()
 
 
-def http_get(cc, path):
-    with urllib.request.urlopen(f"http://127.0.0.1:{cc.port}/{path}", timeout=5) as r:
+def http_get(cc, path, timeout=5):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{cc.port}/{path}", timeout=timeout
+    ) as r:
         return r.status, r.read().decode()
 
 
@@ -170,6 +172,28 @@ class TestCommandCenter:
         from sentinel_tpu.cluster import api as cluster_api
 
         cluster_api.reset_for_tests()
+
+    def test_demotion_clears_embedded_service(self, command_center):
+        # promote to SERVER (ephemeral port), then demote: the stopped
+        # server's service must not keep answering cluster/server/* commands
+        from sentinel_tpu.cluster import api as cluster_api
+
+        try:
+            # promotion warms up every serve-bucket kernel variant — allow
+            # for the compiles
+            status, body = http_get(
+                command_center, "setClusterMode?mode=1&tokenPort=0", timeout=120
+            )
+            assert "success" in body
+            assert cluster_api.get_embedded_server() is not None
+            status, body = http_get(command_center, "cluster/server/info")
+            assert status == 200 and "error" not in body
+            http_get(command_center, "setClusterMode?mode=-1")
+            assert cluster_api.get_embedded_server() is None
+            status, body = http_get(command_center, "cluster/server/info")
+            assert "error" in body  # 'not a token server'
+        finally:
+            cluster_api.reset_for_tests()
 
 
 class TestMetricLog:

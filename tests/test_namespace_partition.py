@@ -243,3 +243,66 @@ class TestPartitionIsolationE2E:
         finally:
             server.stop()
             svc.close()
+
+
+class _ConcurrentStubClient(_StubClient):
+    """Pod-local concurrent-token semantics: ids count from 1 PER POD, so
+    cross-pod collisions are real (the advisor's round-2 finding)."""
+
+    def __init__(self, host, port, timeout_ms=20, namespace="default"):
+        super().__init__(host, port, timeout_ms, namespace)
+        self._next = 1
+        self.held = {}
+
+    def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
+        tid = self._next
+        self._next += 1
+        self.held[tid] = flow_id
+        return TokenResult(TokenStatus.OK, remaining=5, token_id=tid)
+
+    def release_concurrent_token(self, token_id):
+        if int(token_id) in self.held:
+            del self.held[int(token_id)]
+            return TokenResult(TokenStatus.RELEASE_OK)
+        return TokenResult(TokenStatus.ALREADY_RELEASE)
+
+
+class TestConcurrentTokenRouting:
+    def _router(self):
+        return RoutingTokenClient(
+            namespace_of={1: "a", 2: "b"},
+            pod_of={"a": "pod0", "b": "pod1"},
+            endpoints={"pod0": ("h0", 10), "pod1": ("h1", 11)},
+            client_factory=_ConcurrentStubClient,
+        )
+
+    def test_release_targets_issuing_pod_only(self):
+        router = self._router()
+        ra = router.request_concurrent_token(1)  # pod0 issues local id 1
+        rb = router.request_concurrent_token(2)  # pod1 ALSO issues local id 1
+        assert ra.ok and rb.ok
+        # caller-visible ids are pod-namespaced → no collision
+        assert ra.token_id != rb.token_id
+        pod0 = router._clients["pod0"]
+        pod1 = router._clients["pod1"]
+        assert pod0.held and pod1.held
+        out = router.release_concurrent_token(ra.token_id)
+        assert out.ok
+        # ONLY pod0's token released; pod1's same-local-id token survives
+        assert not pod0.held
+        assert pod1.held == {1: 2}
+
+    def test_release_unprefixed_id_falls_back_to_fanout(self):
+        router = self._router()
+        r = router.request_concurrent_token(1)
+        raw_local = r.token_id & ((1 << 48) - 1)
+        # a raw pod-local id (issued before the router, or by another path)
+        out = router.release_concurrent_token(raw_local)
+        assert out.ok  # found via first-success fan-out
+
+    def test_release_result_is_release_ok(self):
+        # round-2 code compared against OK and always reported FAIL
+        router = self._router()
+        r = router.request_concurrent_token(1)
+        out = router.release_concurrent_token(r.token_id)
+        assert out.status == TokenStatus.RELEASE_OK

@@ -26,11 +26,23 @@ from sentinel_tpu.adapters.wsgi import SentinelWsgiMiddleware
 from sentinel_tpu.adapters.asgi import SentinelAsgiMiddleware
 from sentinel_tpu.adapters.gateway import (
     GatewayFlowRule,
+    GatewayGuard,
     GatewayParamFlowItem,
     GatewayRuleManager,
     MatchStrategy,
     ParseStrategy,
     RequestAdapter,
+    ResourceMode,
+    SentinelGatewayAsgiMiddleware,
+    SentinelGatewayWsgiMiddleware,
+)
+from sentinel_tpu.adapters.gateway_api import (
+    ApiDefinition,
+    ApiPathPredicateItem,
+    ApiPredicateGroupItem,
+    GatewayApiDefinitionManager,
+    GatewayApiMatcherManager,
+    UrlMatchStrategy,
 )
 
 __all__ = [
@@ -38,9 +50,19 @@ __all__ = [
     "SentinelWsgiMiddleware",
     "SentinelAsgiMiddleware",
     "GatewayFlowRule",
+    "GatewayGuard",
     "GatewayParamFlowItem",
     "GatewayRuleManager",
     "MatchStrategy",
     "ParseStrategy",
     "RequestAdapter",
+    "ResourceMode",
+    "SentinelGatewayAsgiMiddleware",
+    "SentinelGatewayWsgiMiddleware",
+    "ApiDefinition",
+    "ApiPathPredicateItem",
+    "ApiPredicateGroupItem",
+    "GatewayApiDefinitionManager",
+    "GatewayApiMatcherManager",
+    "UrlMatchStrategy",
 ]

@@ -19,6 +19,21 @@ from sentinel_tpu.local.sph import entry as _entry
 DEFAULT_BLOCK_BODY = b'{"error": "Blocked by Sentinel (flow limiting)"}'
 
 
+async def send_block_response(send, status: int, body: bytes) -> None:
+    """One canonical 429 response pair (shared with the gateway middleware)."""
+    await send(
+        {
+            "type": "http.response.start",
+            "status": status,
+            "headers": [
+                (b"content-type", b"application/json"),
+                (b"content-length", str(len(body)).encode()),
+            ],
+        }
+    )
+    await send({"type": "http.response.body", "body": body})
+
+
 def default_resource(scope) -> str:
     return f"{scope.get('method', 'GET')}:{scope.get('path', '/')}"
 
@@ -56,20 +71,7 @@ class SentinelAsgiMiddleware:
             try:
                 entry = _entry(resource, EntryType.IN)
             except BlockException:
-                await send(
-                    {
-                        "type": "http.response.start",
-                        "status": self.block_status,
-                        "headers": [
-                            (b"content-type", b"application/json"),
-                            (b"content-length",
-                             str(len(self.block_body)).encode()),
-                        ],
-                    }
-                )
-                await send(
-                    {"type": "http.response.body", "body": self.block_body}
-                )
+                await send_block_response(send, self.block_status, self.block_body)
                 return
             try:
                 await self.app(scope, receive, send)

@@ -127,7 +127,16 @@ class DictRequestAdapter(RequestAdapter):
         return self.host_name
 
     def header(self, name: str) -> Optional[str]:
-        return self.headers.get(name)
+        # case-insensitive like HTTP headers: adapters normalize to
+        # lowercase, rules are usually written canonically ("X-Api-Key")
+        value = self.headers.get(name)
+        if value is not None:
+            return value
+        lname = name.lower()
+        for key, val in self.headers.items():
+            if key.lower() == lname:
+                return val
+        return None
 
     def url_param(self, name: str) -> Optional[str]:
         return self.params.get(name)
@@ -244,3 +253,207 @@ class GatewayRuleManager:
     def reset_for_tests(cls) -> None:
         with cls._lock:
             cls._rules = {}
+
+
+class GatewayGuard:
+    """Guard one gateway request: the route resource PLUS every custom API
+    whose path predicates match (``GatewayApiMatcherManager`` pick), each
+    entered with its own parsed params — the reference adapters' doSentinelEntry
+    sequence (route entry, then one entry per matching ApiDefinition).
+
+    Use as a context manager; raises ``BlockException`` from ``__enter__``
+    with nothing left entered if ANY resource blocks.
+    """
+
+    def __init__(self, route: str, request: RequestAdapter, path: str = "",
+                 origin: str = ""):
+        from sentinel_tpu.adapters.gateway_api import GatewayApiMatcherManager
+
+        self.route = route
+        self.request = request
+        self.path = path
+        self.origin = origin
+        self._matcher = GatewayApiMatcherManager
+        self._entries = []
+        self._ctx_entered = False
+
+    def __enter__(self):
+        _ctx.enter(name=f"gateway_context:{self.route}", origin=self.origin)
+        self._ctx_entered = True
+        try:
+            resources = [self.route]
+            if self.path:
+                resources.extend(
+                    self._matcher.pick_matching_api_names(self.path)
+                )
+            for resource in resources:
+                args = GatewayRuleManager.parse(resource, self.request)
+                self._entries.append(_entry(resource, EntryType.IN, 1, args))
+        except BaseException:
+            self._unwind()
+            raise
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and not isinstance(exc, BlockException):
+            self.trace(exc)
+        self._unwind()
+        return False
+
+    def trace(self, exc: BaseException) -> None:
+        """Record an app error on the ROUTE entry (entered first) — an
+        exception-ratio rule on the route must see errors regardless of
+        which custom APIs happened to match."""
+        if self._entries:
+            try:
+                self._entries[0].trace(exc)
+            except Exception:
+                pass
+
+    def _unwind(self) -> None:
+        while self._entries:
+            try:
+                self._entries.pop().exit()
+            except Exception:
+                pass
+        if self._ctx_entered:
+            _ctx.exit()
+            self._ctx_entered = False
+
+
+def _parse_cookies(header_value: str) -> Dict[str, str]:
+    cookies = {}
+    for part in header_value.split(";"):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            cookies[k.strip()] = v.strip()
+    return cookies
+
+
+def _wsgi_request_adapter(environ) -> "DictRequestAdapter":
+    from urllib.parse import parse_qsl
+
+    headers = {
+        k[5:].replace("_", "-").lower(): v
+        for k, v in environ.items() if k.startswith("HTTP_")
+    }
+    return DictRequestAdapter(
+        ip=environ.get("REMOTE_ADDR", ""),
+        host_name=environ.get("HTTP_HOST", environ.get("SERVER_NAME", "")),
+        headers=headers,
+        params=dict(parse_qsl(environ.get("QUERY_STRING", ""))),
+        cookies=_parse_cookies(headers.get("cookie", "")),
+    )
+
+
+def _asgi_request_adapter(scope) -> "DictRequestAdapter":
+    from urllib.parse import parse_qsl
+
+    headers = {
+        k.decode("latin-1").lower(): v.decode("latin-1")
+        for k, v in scope.get("headers", [])
+    }
+    client = scope.get("client")
+    return DictRequestAdapter(
+        ip=client[0] if client else "",
+        host_name=headers.get("host", ""),
+        headers=headers,
+        params=dict(
+            parse_qsl(scope.get("query_string", b"").decode("latin-1"))
+        ),
+        cookies=_parse_cookies(headers.get("cookie", "")),
+    )
+
+
+class SentinelGatewayWsgiMiddleware:
+    """WSGI front for the gateway pipeline: route extraction → custom-API
+    matching → per-resource param parsing → gateway entries. The analog of
+    mounting the reference's Zuul/SCG filter at the edge."""
+
+    def __init__(self, app, route_extractor=None, origin_parser=None,
+                 block_handler=None):
+        self.app = app
+        self.route_extractor = route_extractor or (
+            lambda environ: environ.get("PATH_INFO", "/")
+        )
+        self.origin_parser = origin_parser or (
+            lambda environ: environ.get("REMOTE_ADDR", "")
+        )
+        self.block_handler = block_handler
+
+    def __call__(self, environ, start_response):
+        route = self.route_extractor(environ)
+        if not route:
+            return self.app(environ, start_response)
+        request = _wsgi_request_adapter(environ)
+        path = environ.get("PATH_INFO", "/")
+        guard = GatewayGuard(route, request, path, self.origin_parser(environ))
+        try:
+            # only the guard's own admission block is answered with 429 —
+            # a BlockException raised by the app (nested entry) propagates,
+            # because the app may already have called start_response
+            guard.__enter__()
+        except BlockException as e:
+            if self.block_handler is not None:
+                return self.block_handler(environ, start_response, e)
+            body = b"Blocked by Sentinel (gateway flow limiting)"
+            start_response(
+                "429 Too Many Requests",
+                [("Content-Type", "text/plain"),
+                 ("Content-Length", str(len(body)))],
+            )
+            return [body]
+        try:
+            body = self.app(environ, start_response)
+        except BaseException as err:
+            guard.trace(err)
+            guard._unwind()
+            raise
+        # exit only after the body is consumed (mirrors SentinelWsgiMiddleware):
+        # streaming responses hold the entries open for their full duration
+        from sentinel_tpu.adapters.wsgi import _GuardedBody
+
+        return _GuardedBody(body, guard._entries[0], guard._unwind)
+
+
+class SentinelGatewayAsgiMiddleware:
+    """ASGI twin of ``SentinelGatewayWsgiMiddleware``."""
+
+    def __init__(self, app, route_extractor=None, origin_parser=None,
+                 block_status: int = 429,
+                 block_body: bytes = b'{"error": "Blocked by Sentinel (gateway flow limiting)"}'):
+        self.app = app
+        self.route_extractor = route_extractor or (
+            lambda scope: scope.get("path", "/")
+        )
+        self.origin_parser = origin_parser or (
+            lambda scope: (scope.get("client") or ("",))[0]
+        )
+        self.block_status = block_status
+        self.block_body = block_body
+
+    async def __call__(self, scope, receive, send):
+        if scope.get("type") != "http":
+            await self.app(scope, receive, send)
+            return
+        route = self.route_extractor(scope)
+        if not route:
+            await self.app(scope, receive, send)
+            return
+        request = _asgi_request_adapter(scope)
+        path = scope.get("path", "/")
+        try:
+            guard = GatewayGuard(route, request, path, self.origin_parser(scope))
+            guard.__enter__()
+        except BlockException:
+            from sentinel_tpu.adapters.asgi import send_block_response
+
+            await send_block_response(send, self.block_status, self.block_body)
+            return
+        try:
+            await self.app(scope, receive, send)
+        except BaseException as exc:
+            guard.__exit__(type(exc), exc, exc.__traceback__)
+            raise
+        else:
+            guard.__exit__(None, None, None)

@@ -21,7 +21,12 @@ from sentinel_tpu.adapters import (
     SentinelWsgiMiddleware,
     sentinel_resource,
 )
-from sentinel_tpu.adapters.gateway import ABSENT, NOT_MATCH, DictRequestAdapter
+from sentinel_tpu.adapters.gateway import (
+    ABSENT,
+    NOT_MATCH,
+    DictRequestAdapter,
+    ResourceMode,
+)
 from sentinel_tpu.local import BlockException, FlowRule, FlowRuleManager
 
 
@@ -501,3 +506,214 @@ class TestHttpClient:
         )
         with pytest.raises(BlockException):
             session.request("GET", "http://127.0.0.1:1/x")
+
+
+class TestGatewayApiDefinitions:
+    """ApiDefinition / matcher semantics (ApiDefinition.java,
+    ApiPathPredicateItem.java, GatewayApiMatcherManager.java)."""
+
+    @pytest.fixture(autouse=True)
+    def clean_api(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager, GatewayApiMatcherManager,
+        )
+
+        GatewayApiDefinitionManager.reset_for_tests()
+        GatewayApiMatcherManager.reset_for_tests()
+        yield
+        GatewayApiMatcherManager.reset_for_tests()
+        GatewayApiDefinitionManager.reset_for_tests()
+
+    def _defs(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            ApiDefinition, ApiPathPredicateItem, ApiPredicateGroupItem,
+            UrlMatchStrategy,
+        )
+
+        return [
+            ApiDefinition("orders_api", (
+                ApiPathPredicateItem("/orders", UrlMatchStrategy.EXACT),
+                ApiPathPredicateItem("/orders/", UrlMatchStrategy.PREFIX),
+            )),
+            ApiDefinition("catalog_api", (
+                ApiPredicateGroupItem((
+                    ApiPathPredicateItem(r"^/catalog/\d+$",
+                                         UrlMatchStrategy.REGEX),
+                    ApiPathPredicateItem("/sku", UrlMatchStrategy.EXACT),
+                )),
+            )),
+        ]
+
+    def test_match_strategies(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager, GatewayApiMatcherManager,
+        )
+
+        GatewayApiDefinitionManager.load_api_definitions(self._defs())
+        pick = GatewayApiMatcherManager.pick_matching_api_names
+        assert pick("/orders") == ["orders_api"]
+        assert pick("/orders/42/items") == ["orders_api"]
+        assert pick("/catalog/17") == ["catalog_api"]
+        assert pick("/sku") == ["catalog_api"]
+        assert pick("/other") == []
+
+    def test_invalid_definitions_rejected(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            ApiDefinition, GatewayApiDefinitionManager,
+        )
+
+        GatewayApiDefinitionManager.load_api_definitions(
+            [ApiDefinition("", ()), ApiDefinition("empty", ())]
+        )
+        assert GatewayApiDefinitionManager.get_api_definitions() == []
+
+    def test_json_roundtrip(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager, api_definition_to_dict,
+            parse_api_definition,
+        )
+
+        for d in self._defs():
+            assert parse_api_definition(api_definition_to_dict(d)) == d
+
+    def test_property_driven_updates(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager, GatewayApiMatcherManager,
+        )
+        from sentinel_tpu.core.property import DynamicProperty
+
+        prop = DynamicProperty()
+        GatewayApiDefinitionManager.register_property(prop)
+        prop.update_value(
+            [{"apiName": "v_api",
+              "predicateItems": [{"pattern": "/v/", "matchStrategy": 1}]}]
+        )
+        assert GatewayApiMatcherManager.pick_matching_api_names(
+            "/v/x") == ["v_api"]
+        prop.update_value([])
+        assert GatewayApiMatcherManager.pick_matching_api_names("/v/x") == []
+
+    def test_guard_enters_route_and_matching_apis(self, manual_clock):
+        from sentinel_tpu.adapters.gateway import GatewayGuard
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager,
+        )
+
+        GatewayApiDefinitionManager.load_api_definitions(self._defs())
+        # rule on the CUSTOM API, not the route: only reachable through the
+        # API-matching layer
+        GatewayRuleManager.load_rules(
+            [GatewayFlowRule(resource="orders_api", count=1,
+                             resource_mode=ResourceMode.CUSTOM_API_NAME)]
+        )
+        req = DictRequestAdapter(ip="9.9.9.9")
+        with GatewayGuard("route_orders", req, path="/orders/1"):
+            pass
+        with pytest.raises(BlockException):
+            with GatewayGuard("route_orders", req, path="/orders/2"):
+                pass
+        # a path outside the API is not limited
+        with GatewayGuard("route_orders", req, path="/other"):
+            pass
+
+    def test_gateway_wsgi_middleware_maps_path_to_api(self, manual_clock):
+        from sentinel_tpu.adapters.gateway import SentinelGatewayWsgiMiddleware
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager,
+        )
+
+        GatewayApiDefinitionManager.load_api_definitions(self._defs())
+        GatewayRuleManager.load_rules(
+            [GatewayFlowRule(resource="catalog_api", count=1,
+                             resource_mode=ResourceMode.CUSTOM_API_NAME)]
+        )
+
+        def app(environ, start_response):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"ok"]
+
+        mw = SentinelGatewayWsgiMiddleware(app)
+        statuses = []
+
+        def capture(status, headers):
+            statuses.append(status)
+
+        env = {"PATH_INFO": "/catalog/5", "REMOTE_ADDR": "1.2.3.4",
+               "QUERY_STRING": ""}
+        assert list(mw(dict(env), capture)) == [b"ok"]
+        body = list(mw(dict(env), capture))
+        assert statuses[-1].startswith("429")
+        assert b"Blocked" in body[0]
+        # non-matching path sails through
+        env2 = {"PATH_INFO": "/healthz", "REMOTE_ADDR": "1.2.3.4",
+                "QUERY_STRING": ""}
+        assert list(mw(dict(env2), capture)) == [b"ok"]
+
+    def test_regex_predicate_is_full_match(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            ApiDefinition, ApiPathPredicateItem, GatewayApiDefinitionManager,
+            GatewayApiMatcherManager, UrlMatchStrategy,
+        )
+
+        GatewayApiDefinitionManager.load_api_definitions(
+            [ApiDefinition("v1_api", (
+                ApiPathPredicateItem(r"/v1/(orders|users)",
+                                     UrlMatchStrategy.REGEX),
+            ))]
+        )
+        pick = GatewayApiMatcherManager.pick_matching_api_names
+        assert pick("/v1/orders") == ["v1_api"]
+        # unanchored fragment must NOT over-match containing paths
+        assert pick("/internal/v1/orders-export") == []
+        assert pick("/v1/orders/extra") == []
+
+    def test_header_rule_matches_canonical_case_behind_adapter(self, manual_clock):
+        from sentinel_tpu.adapters.gateway import _wsgi_request_adapter
+
+        GatewayRuleManager.load_rules(
+            [
+                GatewayFlowRule(
+                    resource="r_hdr", count=5,
+                    param_item=GatewayParamFlowItem(
+                        ParseStrategy.HEADER, field_name="X-Api-Key",
+                    ),
+                )
+            ]
+        )
+        env = {"PATH_INFO": "/x", "HTTP_X_API_KEY": "k123"}
+        req = _wsgi_request_adapter(env)
+        # adapter lowercases; canonical-cased rule must still see the value
+        assert GatewayRuleManager.parse("r_hdr", req) == ("k123",)
+
+    def test_gateway_wsgi_streaming_holds_entries_open(self, manual_clock):
+        from sentinel_tpu.adapters.gateway import SentinelGatewayWsgiMiddleware
+        from sentinel_tpu.local.flow import FlowGrade
+
+        GatewayRuleManager.load_rules(
+            [GatewayFlowRule(resource="/stream", count=1,
+                             grade=FlowGrade.THREAD)]
+        )
+
+        def app(environ, start_response):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return iter([b"a", b"b"])
+
+        mw = SentinelGatewayWsgiMiddleware(app)
+        statuses = []
+
+        def capture(status, headers):
+            statuses.append(status)
+
+        env = {"PATH_INFO": "/stream", "REMOTE_ADDR": "1.1.1.1",
+               "QUERY_STRING": ""}
+        body1 = mw(dict(env), capture)
+        assert statuses[-1].startswith("200")
+        # first body still streaming → concurrency slot held → second blocks
+        body2 = mw(dict(env), capture)
+        assert statuses[-1].startswith("429")
+        body1.close()  # releases the entries
+        body3 = mw(dict(env), capture)
+        assert statuses[-1].startswith("200")
+        list(body3)  # consume to completion also releases
+        body4 = mw(dict(env), capture)
+        assert statuses[-1].startswith("200")

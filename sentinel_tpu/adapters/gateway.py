@@ -127,9 +127,13 @@ class DictRequestAdapter(RequestAdapter):
         return self.host_name
 
     def header(self, name: str) -> Optional[str]:
-        # case-insensitive like HTTP headers: adapters normalize to
-        # lowercase, rules are usually written canonically ("X-Api-Key")
+        # case-insensitive like HTTP headers: adapters normalize keys to
+        # lowercase, rules are usually written canonically ("X-Api-Key") —
+        # two dict gets cover both; the scan is only for hand-built dicts
+        # with exotic casing
         value = self.headers.get(name)
+        if value is None:
+            value = self.headers.get(name.lower())
         if value is not None:
             return value
         lname = name.lower()
@@ -246,7 +250,7 @@ class GatewayRuleManager:
         """Guard a gateway route: parse params, enter the slot chain.
         Raises ``BlockException`` on a block verdict."""
         args = cls.parse(resource, request)
-        _ctx.enter(name=f"gateway_context:{resource}", origin=origin)
+        _ctx.enter(name=GatewayGuard.CONTEXT_NAME, origin=origin)
         return _entry(resource, EntryType.IN, count, args)
 
     @classmethod
@@ -277,8 +281,15 @@ class GatewayGuard:
         self._entries = []
         self._ctx_entered = False
 
+    # One fixed entrance-context for all gateway traffic (the reference's
+    # GATEWAY_CONTEXT prefix is bounded by ROUTE IDS; a WSGI/ASGI front only
+    # has raw paths, whose cardinality would exhaust the context-name cap
+    # and silently disable flow control past it). Per-route stats still
+    # exist — resources are per-route; only the entrance node is shared.
+    CONTEXT_NAME = "sentinel_gateway_context"
+
     def __enter__(self):
-        _ctx.enter(name=f"gateway_context:{self.route}", origin=self.origin)
+        _ctx.enter(name=self.CONTEXT_NAME, origin=self.origin)
         self._ctx_entered = True
         try:
             resources = [self.route]
@@ -368,7 +379,13 @@ def _asgi_request_adapter(scope) -> "DictRequestAdapter":
 class SentinelGatewayWsgiMiddleware:
     """WSGI front for the gateway pipeline: route extraction → custom-API
     matching → per-resource param parsing → gateway entries. The analog of
-    mounting the reference's Zuul/SCG filter at the edge."""
+    mounting the reference's Zuul/SCG filter at the edge.
+
+    ``route_extractor`` should return a BOUNDED set of route ids (the
+    reference's routes come from gateway config). The default — the raw
+    path — is fine behind a router that normalizes paths, but a front
+    serving unbounded distinct paths (REST ids in the path) must map them
+    to route ids or per-resource stats grow without bound."""
 
     def __init__(self, app, route_extractor=None, origin_parser=None,
                  block_handler=None):

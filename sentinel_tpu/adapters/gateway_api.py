@@ -135,11 +135,14 @@ class GatewayApiDefinitionManager:
     @classmethod
     def add_observer(cls, observer: Callable[[List[ApiDefinition]], None]) -> None:
         """``ApiDefinitionChangeObserver`` analog; called with the full
-        definition list on every load."""
-        with cls._lock:
-            cls._observers.append(observer)
-            snapshot = list(cls._definitions.values())
-        observer(snapshot)
+        definition list on every load. Serialized with loads under
+        ``_load_lock`` so the registration snapshot can't race a concurrent
+        load and overwrite its (newer) delivery."""
+        with cls._load_lock:
+            with cls._lock:
+                cls._observers.append(observer)
+                snapshot = list(cls._definitions.values())
+            observer(snapshot)
 
     @classmethod
     def register_property(cls, prop: DynamicProperty) -> None:
@@ -151,11 +154,18 @@ class GatewayApiDefinitionManager:
             if cls._property is not None and cls._listener is not None:
                 cls._property.remove_listener(cls._listener)
             cls._property = prop
-            cls._listener = prop.listen(
-                lambda value: cls.load_api_definitions(
-                    [parse_api_definition(v) for v in (value or [])]
-                )
+        # listen() takes the property's lock and fires the first load
+        # synchronously — must happen OUTSIDE cls._lock or a concurrent
+        # update_value (property lock → load → cls._lock) deadlocks against
+        # us (cls._lock → property lock). Same discipline as the other rule
+        # managers' register_property.
+        listener = prop.listen(
+            lambda value: cls.load_api_definitions(
+                [parse_api_definition(v) for v in (value or [])]
             )
+        )
+        with cls._lock:
+            cls._listener = listener
 
     @classmethod
     def reset_for_tests(cls) -> None:

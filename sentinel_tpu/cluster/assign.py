@@ -1,0 +1,101 @@
+"""Datasource-driven cluster assignment.
+
+The reference's cluster client/server configuration is property-driven:
+``ClusterClientConfigManager`` registers ``SentinelProperty`` instances for
+the client assignment and config (``sentinel-cluster-client-default/.../
+config/ClusterClientConfigManager.java``), and ``ClusterStateManager``
+applies mode switches from properties too — the HTTP commands are just one
+writer of those properties. Round 2 only had the command path; this module
+adds the property path with the SAME payloads the commands accept, so a
+fleet re-points itself from any datasource (file, nacos, etcd, …) without a
+dashboard in the loop.
+
+Usage::
+
+    ds = FileRefreshableDataSource(path, converter=json.loads).start()
+    register_client_assign_property(ds.property)
+    # file contents: {"serverHost": "10.0.0.5", "serverPort": 18730,
+    #                 "requestTimeout": 20, "namespace": "ns1"}
+
+    register_cluster_mode_property(mode_ds.property)
+    # contents: 0 | 1 | -1, or {"mode": 1, "tokenPort": 18730}
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.core.property import DynamicProperty
+
+_lock = threading.Lock()
+_assign_property: Optional[DynamicProperty] = None
+_assign_listener = None
+_mode_property: Optional[DynamicProperty] = None
+_mode_listener = None
+
+
+def _on_assignment(value) -> None:
+    if value is None:
+        return
+    from sentinel_tpu.transport.handlers import apply_client_assignment
+
+    try:
+        error = apply_client_assignment(dict(value))
+        if error:
+            record_log.warning("cluster assignment rejected: %s", error)
+    except Exception:
+        record_log.exception("cluster assignment failed")
+
+
+def _on_mode(value) -> None:
+    if value is None:
+        return
+    from sentinel_tpu.transport.handlers import apply_cluster_mode
+
+    try:
+        if isinstance(value, dict):
+            mode = int(value.get("mode", -1))
+            port = int(value.get("tokenPort", 18730))
+        else:
+            mode, port = int(value), 18730
+        apply_cluster_mode(mode, port)
+    except Exception:
+        record_log.exception("cluster mode switch failed")
+
+
+def register_client_assign_property(prop: DynamicProperty) -> None:
+    """Subscribe the token-client assignment to a property
+    (``ClusterClientConfigManager.registerServerAssignProperty`` analog).
+    The property's value is the modifyConfig payload:
+    ``{serverHost, serverPort[, requestTimeout][, namespace]}``."""
+    global _assign_property, _assign_listener
+    with _lock:
+        if _assign_property is not None and _assign_listener is not None:
+            _assign_property.remove_listener(_assign_listener)
+        _assign_property = prop
+        _assign_listener = prop.listen(_on_assignment)
+
+
+def register_cluster_mode_property(prop: DynamicProperty) -> None:
+    """Subscribe this agent's cluster mode to a property
+    (``ClusterStateManager.registerProperty`` analog). The value is the
+    setClusterMode payload: an int mode, or ``{mode, tokenPort}``."""
+    global _mode_property, _mode_listener
+    with _lock:
+        if _mode_property is not None and _mode_listener is not None:
+            _mode_property.remove_listener(_mode_listener)
+        _mode_property = prop
+        _mode_listener = prop.listen(_on_mode)
+
+
+def reset_for_tests() -> None:
+    global _assign_property, _assign_listener, _mode_property, _mode_listener
+    with _lock:
+        if _assign_property is not None and _assign_listener is not None:
+            _assign_property.remove_listener(_assign_listener)
+        if _mode_property is not None and _mode_listener is not None:
+            _mode_property.remove_listener(_mode_listener)
+        _assign_property = _assign_listener = None
+        _mode_property = _mode_listener = None

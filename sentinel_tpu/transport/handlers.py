@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from typing import Optional
 
 import sentinel_tpu
 from sentinel_tpu.core import clock as _clock
@@ -228,32 +229,39 @@ _EMBEDDED_SERVER = {"server": None}
 _EMBEDDED_LOCK = threading.Lock()
 
 
-@command_mapping(
-    "setClusterMode", "switch cluster state; mode=-1|0|1 [&tokenPort=18730]"
-)
-def cmd_set_cluster_mode(params, body):
-    """Mode 1 actually provisions the embedded token server (transport +
-    device service) and registers it — the analog of
-    ``ModifyClusterModeCommandHandler`` → ``DefaultEmbeddedTokenServer``
+def apply_cluster_mode(mode: int, token_port: int = 18730) -> None:
+    """Switch this agent's cluster state. Mode 1 provisions the embedded
+    token server (transport + device service) and registers it — the analog
+    of ``ModifyClusterModeCommandHandler`` → ``DefaultEmbeddedTokenServer``
     start. Leaving server mode stops it. Idempotent: repeating the current
     mode (e.g. a dashboard retry after a slow first promote) reconciles
-    instead of double-starting."""
+    instead of double-starting. Shared by the setClusterMode command and the
+    datasource-driven path (``cluster.assign``)."""
     from sentinel_tpu.cluster import api as cluster_api
 
-    mode = int(params.get("mode", -1))
     with _EMBEDDED_LOCK:
         prev = _EMBEDDED_SERVER["server"]
         if mode == int(cluster_api.ClusterMode.SERVER):
-            if prev is None:
+            if prev is not None and token_port not in (0, prev.port):
+                # port reconfiguration (e.g. a datasource edit): the running
+                # server must move, not silently keep the old port. The
+                # service (rules, counters) is preserved across the move.
+                from sentinel_tpu.cluster.server import TokenServer
+
+                _EMBEDDED_SERVER["server"] = None
+                service = prev.service
+                prev.stop()
+                server = TokenServer(service, host="0.0.0.0", port=token_port)
+                server.start()
+                _EMBEDDED_SERVER["server"] = server
+            elif prev is None:
                 from sentinel_tpu.cluster.server import TokenServer
                 from sentinel_tpu.cluster.token_service import (
                     DefaultTokenService,
                 )
 
                 server = TokenServer(
-                    DefaultTokenService(),
-                    host="0.0.0.0",
-                    port=int(params.get("tokenPort", 18730)),
+                    DefaultTokenService(), host="0.0.0.0", port=token_port
                 )
                 try:
                     server.start()
@@ -262,7 +270,7 @@ def cmd_set_cluster_mode(params, body):
                     raise
                 _EMBEDDED_SERVER["server"] = server
             cluster_api.set_embedded_server(_EMBEDDED_SERVER["server"].service)
-            return "success"
+            return
         if prev is not None:
             _EMBEDDED_SERVER["server"] = None
             prev.stop()
@@ -270,36 +278,66 @@ def cmd_set_cluster_mode(params, body):
             # cluster/server/* commands as if this were still a token server
             cluster_api.clear_embedded_server()
         cluster_api.set_mode(cluster_api.ClusterMode(mode))
-        return "success"
+
+
+@command_mapping(
+    "setClusterMode", "switch cluster state; mode=-1|0|1 [&tokenPort=18730]"
+)
+def cmd_set_cluster_mode(params, body):
+    apply_cluster_mode(
+        int(params.get("mode", -1)), int(params.get("tokenPort", 18730))
+    )
+    return "success"
+
+
+def apply_client_assignment(data) -> Optional[str]:
+    """(Re)install the global token client against an assigned server
+    address (``ClusterClientConfigManager`` applying
+    ``ClusterClientAssignConfig``). Returns an error string or None. Shared
+    by the modifyConfig command and the datasource-driven path
+    (``cluster.assign``). Idempotent on identical assignments so a polling
+    datasource doesn't churn connections."""
+    from sentinel_tpu.cluster import api as cluster_api
+    from sentinel_tpu.cluster.client import TokenClient
+
+    host = data.get("serverHost")
+    port = int(data.get("serverPort", 0))
+    if not host or not port:
+        return "serverHost and serverPort required"
+    timeout_ms = int(data.get("requestTimeout", 20))
+    # the namespace this agent declares in its PING handshake — the server
+    # scopes connection counts (AVG_LOCAL scaling) by it
+    # (ClusterClientConfigManager's namespace config)
+    namespace = str(data.get("namespace", "default") or "default")
+    assignment = dict(
+        serverHost=host, serverPort=port, requestTimeout=timeout_ms,
+        namespace=namespace,
+    )
+    # idempotent ONLY while actually operating as a client: a repeated
+    # assignment after a mode switch (or reset) must reinstall the client
+    # and restore CLIENT mode, not silently no-op
+    if (
+        assignment == _CLUSTER_CLIENT_CONFIG
+        and cluster_api.get_mode() == cluster_api.ClusterMode.CLIENT
+        and cluster_api._client is not None
+    ):
+        return None
+    cluster_api.set_client(
+        TokenClient(host, port, timeout_ms=timeout_ms, namespace=namespace)
+    )
+    _CLUSTER_CLIENT_CONFIG.clear()
+    _CLUSTER_CLIENT_CONFIG.update(assignment)
+    return None
 
 
 @command_mapping(
     "cluster/client/modifyConfig", "point the token client at a server; data={serverHost, serverPort}"
 )
 def cmd_cluster_client_modify_config(params, body):
-    """``ModifyClusterClientConfigHandler`` analog: (re)install the global
-    token client against the assigned server address."""
-    from sentinel_tpu.cluster import api as cluster_api
-    from sentinel_tpu.cluster.client import TokenClient
-
+    """``ModifyClusterClientConfigHandler`` analog."""
     data = json.loads(body) if body else params
-    host = data.get("serverHost")
-    port = int(data.get("serverPort", 0))
-    if not host or not port:
-        return {"error": "serverHost and serverPort required"}
-    timeout_ms = int(data.get("requestTimeout", 20))
-    # the namespace this agent declares in its PING handshake — the server
-    # scopes connection counts (AVG_LOCAL scaling) by it
-    # (ClusterClientConfigManager's namespace config)
-    namespace = str(data.get("namespace", "default") or "default")
-    cluster_api.set_client(
-        TokenClient(host, port, timeout_ms=timeout_ms, namespace=namespace)
-    )
-    _CLUSTER_CLIENT_CONFIG.update(
-        serverHost=host, serverPort=port, requestTimeout=timeout_ms,
-        namespace=namespace,
-    )
-    return "success"
+    error = apply_client_assignment(data)
+    return {"error": error} if error else "success"
 
 
 _CLUSTER_CLIENT_CONFIG: dict = {}

@@ -304,3 +304,133 @@ class TestSwitchCommands:
         from sentinel_tpu.local.sph import is_enabled
 
         assert is_enabled() is True
+
+
+class TestDatasourceClusterAssignment:
+    """Property/datasource-driven cluster reconfiguration
+    (ClusterClientConfigManager / ClusterStateManager property path)."""
+
+    @pytest.fixture(autouse=True)
+    def clean(self):
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.cluster import assign
+        from sentinel_tpu.transport import handlers as H
+
+        yield
+        assign.reset_for_tests()
+        H.apply_cluster_mode(-1)  # stop any promoted server
+        H._CLUSTER_CLIENT_CONFIG.clear()
+        cluster_api.reset_for_tests()
+
+    def test_file_assignment_repoints_client(self, tmp_path):
+        import jax  # noqa: F401  (conftest pinned CPU)
+
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.cluster import assign
+        from sentinel_tpu.cluster.server import TokenServer
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.datasource.file import FileRefreshableDataSource
+        from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+        from sentinel_tpu.engine.rules import ThresholdMode
+        from sentinel_tpu.transport import handlers as H
+
+        cfg = EngineConfig(max_flows=16, max_namespaces=4, batch_size=64)
+        svc = DefaultTokenService(cfg)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=1, count=3.0,
+                             mode=ThresholdMode.GLOBAL)]
+        )
+        server = TokenServer(svc, port=0)
+        server.start()
+        try:
+            path = tmp_path / "assign.json"
+            path.write_text(json.dumps(
+                {"serverHost": "127.0.0.1", "serverPort": server.port,
+                 "requestTimeout": 2000, "namespace": "nsX"}
+            ))
+            ds = FileRefreshableDataSource(str(path), converter=json.loads)
+            assign.register_client_assign_property(ds.property)
+            ds.refresh()
+            assert H._CLUSTER_CLIENT_CONFIG["serverPort"] == server.port
+            assert H._CLUSTER_CLIENT_CONFIG["namespace"] == "nsX"
+            assert cluster_api.get_mode() == cluster_api.ClusterMode.CLIENT
+            # the installed client really serves verdicts from that server
+            oks = sum(
+                cluster_api._pick_service().request_token(1).ok
+                for _ in range(5)
+            )
+            assert oks == 3
+            # flip the file → client re-points (new port recorded)
+            path.write_text(json.dumps(
+                {"serverHost": "127.0.0.1", "serverPort": server.port,
+                 "requestTimeout": 50, "namespace": "nsY"}
+            ))
+            ds.refresh()
+            assert H._CLUSTER_CLIENT_CONFIG["namespace"] == "nsY"
+        finally:
+            server.stop()
+
+    def test_mode_property_promotes_and_demotes(self):
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.cluster import assign
+        from sentinel_tpu.core.property import DynamicProperty
+
+        prop = DynamicProperty()
+        assign.register_cluster_mode_property(prop)
+        prop.update_value({"mode": 1, "tokenPort": 0})
+        assert cluster_api.get_embedded_server() is not None
+        assert cluster_api.get_mode() == cluster_api.ClusterMode.SERVER
+        prop.update_value(-1)
+        assert cluster_api.get_embedded_server() is None
+
+    def test_identical_assignment_does_not_churn_connection(self, tmp_path):
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.cluster import assign
+        from sentinel_tpu.core.property import DynamicProperty
+
+        prop = DynamicProperty()
+        assign.register_client_assign_property(prop)
+        payload = {"serverHost": "127.0.0.1", "serverPort": 19999}
+        prop.update_value(dict(payload))
+        first = cluster_api._client
+        assert first is not None
+        # same assignment again (datasource poll) → same client object
+        prop.update_value({**payload, "_noise": 1})  # dict differs, config same
+        assert cluster_api._client is first
+
+    def test_mode_property_port_change_moves_server(self):
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.cluster import assign
+        from sentinel_tpu.core.property import DynamicProperty
+        from sentinel_tpu.transport import handlers as H
+
+        prop = DynamicProperty()
+        assign.register_cluster_mode_property(prop)
+        prop.update_value({"mode": 1, "tokenPort": 0})
+        first = H._EMBEDDED_SERVER["server"]
+        port1 = first.port
+        # pick a different concrete port and push it
+        import socket as s
+
+        sock = s.socket()
+        sock.bind(("127.0.0.1", 0))
+        port2 = sock.getsockname()[1]
+        sock.close()
+        prop.update_value({"mode": 1, "tokenPort": port2})
+        second = H._EMBEDDED_SERVER["server"]
+        assert second.port == port2
+        assert second.service is first.service  # rules/counters preserved
+
+    def test_reassignment_after_demotion_restores_client_mode(self):
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.transport import handlers as H
+
+        payload = {"serverHost": "127.0.0.1", "serverPort": 19998}
+        assert H.apply_client_assignment(payload) is None
+        assert cluster_api.get_mode() == cluster_api.ClusterMode.CLIENT
+        H.apply_cluster_mode(-1)  # fleet ops switch the agent off
+        assert cluster_api.get_mode() == cluster_api.ClusterMode.NOT_STARTED
+        # identical re-assignment must restore CLIENT mode, not no-op
+        assert H.apply_client_assignment(payload) is None
+        assert cluster_api.get_mode() == cluster_api.ClusterMode.CLIENT
+        assert cluster_api._pick_service() is not None

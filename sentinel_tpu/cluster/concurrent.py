@@ -84,6 +84,10 @@ class ConcurrencyManager:
             self._rules = {r.flow_id: r for r in rules}
             # permits for deleted rules drain naturally via release/expiry
 
+    def has_rules(self) -> bool:
+        with self._lock:
+            return bool(self._rules)
+
     def set_connected_count(self, n: int, namespace: str = "default") -> None:
         """ConnectionManager callback, scoped per namespace
         (``ConnectionManager.java:30-58``)."""

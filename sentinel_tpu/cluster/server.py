@@ -440,6 +440,9 @@ class TokenServer:
         warmup = getattr(self.service, "warmup", None)
         if warmup is not None:
             warmup()  # compile the decision kernels before accepting traffic
+        reopen = getattr(self.service, "reopen", None)
+        if reopen is not None:
+            reopen()  # re-arm background sweeps a prior stop() released
         if self.n_loops > 1 and not hasattr(socket, "SO_REUSEPORT"):
             record_log.warning("SO_REUSEPORT unavailable; forcing n_loops=1")
             self.n_loops = 1

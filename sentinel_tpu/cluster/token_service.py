@@ -662,6 +662,17 @@ class DefaultTokenService(TokenService):
             self._expiry.stop()
             self._expiry = None
 
+    def reopen(self) -> None:
+        """Re-arm background resources after a close() when the service is
+        put back behind a transport (e.g. a token-server port move reuses
+        the service): without this, concurrent-mode tokens held by crashed
+        clients would only be reclaimed by the bounded acquire-path sweep."""
+        if self._expiry is None and self.concurrency.has_rules():
+            from sentinel_tpu.cluster.concurrent import ExpiryTask
+
+            self._expiry = ExpiryTask(self.concurrency)
+            self._expiry.start()
+
     def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
         r = self.concurrency.acquire(flow_id, acquire, prioritized)
         return TokenResult(r.status, r.remaining, 0, r.token_id)

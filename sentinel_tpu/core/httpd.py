@@ -102,14 +102,26 @@ class HttpService:
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET", "")
 
-            def do_POST(self):  # noqa: N802
+            def _read_body(self) -> Optional[str]:
                 length = int(self.headers.get("Content-Length") or 0)
                 if length > MAX_BODY_BYTES:
                     self.send_response(413)
                     self.end_headers()
-                    return
-                body = self.rfile.read(length).decode() if length else ""
-                self._dispatch("POST", body)
+                    return None
+                return self.rfile.read(length).decode() if length else ""
+
+            def do_POST(self):  # noqa: N802
+                body = self._read_body()
+                if body is not None:
+                    self._dispatch("POST", body)
+
+            def do_PUT(self):  # noqa: N802
+                body = self._read_body()
+                if body is not None:
+                    self._dispatch("PUT", body)
+
+            def do_DELETE(self):  # noqa: N802
+                self._dispatch("DELETE", "")
 
             def log_message(self, fmt, *args):  # record_log has the failures
                 pass

@@ -1,0 +1,73 @@
+"""Id-keyed rule repository backing the per-rule-type console views.
+
+Analog of the reference dashboard's ``InMemoryRuleRepositoryAdapter``
+(``sentinel-dashboard/.../repository/rule/InMemoryRuleRepositoryAdapter.java``)
+behind ``FlowControllerV1`` and its siblings: the console edits individual
+rules by id; the dashboard keeps the id ↔ rule mapping (agents only ever see
+whole lists) and pushes the assembled list to every healthy machine after
+each mutation.
+
+Rules are plain dicts in the agent's JSON schema (the same payloads
+``setRules`` accepts) — the repository is storage + identity, not parsing.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class InMemoryRuleRepository:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        # (app, rule_type) → {id: rule-dict}
+        self._rules: Dict[Tuple[str, str], Dict[int, dict]] = {}
+
+    def sync(self, app: str, rule_type: str, rules: List[dict]) -> List[dict]:
+        """Replace the stored set from a live fetch, assigning fresh ids
+        (the reference re-saves on every page load too). Returns the stored
+        entries with ids attached."""
+        with self._lock:
+            entries = {next(self._ids): dict(rule) for rule in rules}
+            self._rules[(app, rule_type)] = entries
+            return [{"id": i, **r} for i, r in sorted(entries.items())]
+
+    def known(self, app: str, rule_type: str) -> bool:
+        """Whether this (app, type) has ever been synced/mutated — a fresh
+        dashboard must sync from the live agent before its first mutation or
+        the push would overwrite rules the agent already holds."""
+        with self._lock:
+            return (app, rule_type) in self._rules
+
+    def list(self, app: str, rule_type: str) -> List[dict]:
+        with self._lock:
+            entries = self._rules.get((app, rule_type), {})
+            return [{"id": i, **r} for i, r in sorted(entries.items())]
+
+    def add(self, app: str, rule_type: str, rule: dict) -> int:
+        with self._lock:
+            rule_id = next(self._ids)
+            self._rules.setdefault((app, rule_type), {})[rule_id] = dict(rule)
+            return rule_id
+
+    def update(self, app: str, rule_type: str, rule_id: int,
+               rule: dict) -> bool:
+        with self._lock:
+            entries = self._rules.get((app, rule_type), {})
+            if rule_id not in entries:
+                return False
+            entries[rule_id] = dict(rule)
+            return True
+
+    def delete(self, app: str, rule_type: str, rule_id: int) -> bool:
+        with self._lock:
+            entries = self._rules.get((app, rule_type), {})
+            return entries.pop(rule_id, None) is not None
+
+    def plain_rules(self, app: str, rule_type: str) -> List[dict]:
+        """The id-less list an agent's setRules expects."""
+        with self._lock:
+            entries = self._rules.get((app, rule_type), {})
+            return [dict(r) for _, r in sorted(entries.items())]

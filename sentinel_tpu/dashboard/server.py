@@ -26,6 +26,7 @@ from sentinel_tpu.dashboard.api_client import ApiClient
 from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
 from sentinel_tpu.dashboard.fetcher import MetricFetcher
 from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
+from sentinel_tpu.dashboard.rules_repo import InMemoryRuleRepository
 
 RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow", "gateway")
 
@@ -38,12 +39,31 @@ AUTH_EXEMPT = {"registry/machine", "auth/login", "", "index.html"}
 _INDEX_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>sentinel-tpu console</title>
 <style>
- body{font-family:system-ui,sans-serif;margin:2rem;color:#222}
+ :root{color-scheme:light;
+  --surface-1:#fcfcfb; --text-primary:#0b0b0b; --text-secondary:#52514e;
+  --series-1:#2a78d6; --series-2:#eb6834; --grid:#e4e3df; --border:#ccc}
+ @media (prefers-color-scheme: dark){
+  :root{color-scheme:dark;
+   --surface-1:#1a1a19; --text-primary:#ffffff; --text-secondary:#c3c2b7;
+   --series-1:#3987e5; --series-2:#d95926; --grid:#33332f; --border:#444}}
+ body{font-family:system-ui,sans-serif;margin:2rem;color:var(--text-primary);
+  background:var(--surface-1)}
  h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ h3{font-size:.95rem;margin:.8rem 0 .3rem}
  table{border-collapse:collapse;min-width:40rem}
- th,td{border:1px solid #ccc;padding:.35rem .6rem;text-align:left;font-size:.9rem}
- th{background:#f5f5f5} .dead{color:#b00} .ok{color:#070}
- code{background:#f0f0f0;padding:0 .3rem}
+ th,td{border:1px solid var(--border);padding:.35rem .6rem;text-align:left;
+  font-size:.9rem}
+ th{background:color-mix(in srgb, var(--text-primary) 6%, var(--surface-1))}
+ .dead{color:#b00} .ok{color:#070}
+ input,select{margin:.1rem .2rem .1rem 0}
+ .tab{margin-right:.3rem} .tab.on{font-weight:bold;text-decoration:underline}
+ #chartwrap{margin-top:1rem} .legend{font-size:.85rem;color:var(--text-secondary)}
+ .legend b{font-weight:600;color:var(--text-primary)}
+ .sw{display:inline-block;width:10px;height:10px;border-radius:2px;
+  vertical-align:baseline;margin:0 .3rem 0 .8rem}
+ #tip{position:absolute;pointer-events:none;background:var(--surface-1);
+  border:1px solid var(--border);padding:.25rem .5rem;font-size:.8rem;
+  display:none;border-radius:4px}
 </style></head><body>
 <h1>sentinel-tpu console</h1>
 <div id="login" style="display:none">
@@ -55,23 +75,47 @@ _INDEX_HTML = """<!doctype html>
 <div id="apps"></div>
 <div id="ruled" style="display:none">
  <h2>rules: <span id="ruleapp"></span></h2>
- <select id="ruletype"></select>
- <button onclick="loadRules()">load</button>
- <button onclick="pushRules()">push to app</button>
- <span id="rulemsg"></span><br>
- <textarea id="rulebox" rows="14" cols="100" spellcheck="false"></textarea>
+ <div id="ruletabs"></div>
+ <div id="ruleview"></div>
+ <span id="rulemsg" class="legend"></span>
+</div>
+<div id="chartwrap" style="display:none">
+ <h2>qps timeline: <span id="chartres"></span></h2>
+ <div class="legend"><span class="sw" style="background:var(--series-1)"></span>
+  <b>pass qps</b><span class="sw" style="background:var(--series-2)"></span>
+  <b>block qps</b></div>
+ <svg id="chart" width="720" height="220" role="img"
+  aria-label="pass and block qps over time"></svg>
+ <div id="tip"></div>
 </div>
 <script>
 // resource names and machine fields are attacker-influenced (a resource is
 // often a raw request path) — build rows with textContent only, never
 // string-interpolated HTML
 const RULE_TYPES = ['flow','degrade','system','authority','paramFlow','gateway'];
+// editable fields per rule type, in the agent's JSON schema
+const SCHEMAS = {
+ flow: ['resource','count','grade','limitApp','strategy','refResource',
+        'controlBehavior','warmUpPeriodSec','maxQueueingTimeMs','clusterMode'],
+ degrade: ['resource','grade','count','timeWindow','minRequestAmount',
+           'statIntervalMs','slowRatioThreshold','limitApp'],
+ system: ['highestSystemLoad','highestCpuUsage','qps','avgRt','maxThread'],
+ authority: ['resource','limitApp','strategy'],
+ paramFlow: ['resource','paramIdx','count','grade','durationInSec',
+             'burstCount','controlBehavior','maxQueueingTimeMs',
+             'paramFlowItemList'],
+ gateway: ['resource','resourceMode','count','grade','intervalSec',
+           'controlBehavior','burst','maxQueueingTimeoutMs','paramItem'],
+};
+let curApp = '', curType = 'flow', editId = null;
 function row(table, cells, tag){
   const tr = document.createElement('tr');
   for (const c of cells){
     const td = document.createElement(tag || 'td');
     if (c && c.nodeType) td.appendChild(c);
-    else if (c && c.cls) { td.textContent = c.text; td.className = c.cls; }
+    else if (c && typeof c === 'object' && c.cls !== undefined){
+      td.textContent = c.text; td.className = c.cls;
+    }
     else td.textContent = c;
     tr.appendChild(td);
   }
@@ -91,35 +135,191 @@ async function login(){
 }
 function login_el(){ return document.getElementById('login'); }
 function openRules(app){
+  curApp = app;
   document.getElementById('ruled').style.display='';
   document.getElementById('ruleapp').textContent = app;
-  const sel = document.getElementById('ruletype');
-  if (!sel.options.length)
-    for (const t of RULE_TYPES){
-      const o = document.createElement('option'); o.textContent = t; sel.appendChild(o);
-    }
+  const tabs = document.getElementById('ruletabs');
+  tabs.innerHTML = '';
+  for (const t of RULE_TYPES){
+    const b = document.createElement('button');
+    b.textContent = t; b.className = 'tab' + (t===curType?' on':'');
+    b.onclick = () => { curType = t; editId = null; openRules(curApp); };
+    tabs.appendChild(b);
+  }
   loadRules();
 }
-async function loadRules(){
-  const app = document.getElementById('ruleapp').textContent;
-  const t = document.getElementById('ruletype').value;
-  const rules = await api(`rules?app=${encodeURIComponent(app)}&type=${encodeURIComponent(t)}`);
-  document.getElementById('rulebox').value = JSON.stringify(rules, null, 2);
+function coerce(text){
+  if (text === '') return undefined;
+  if (text === 'true') return true;
+  if (text === 'false') return false;
+  if (text[0] === '{' || text[0] === '[') {
+    try { return JSON.parse(text); } catch(e) { return text; }
+  }
+  const n = Number(text);
+  return Number.isNaN(n) ? text : n;
 }
-async function pushRules(){
-  const app = document.getElementById('ruleapp').textContent;
-  const t = document.getElementById('ruletype').value;
-  let parsed;
-  try { parsed = JSON.parse(document.getElementById('rulebox').value); }
-  catch(e){ document.getElementById('rulemsg').textContent = 'invalid JSON'; return; }
-  const r = await fetch(`rules?app=${encodeURIComponent(app)}&type=${encodeURIComponent(t)}`,
-    {method:'POST', body: JSON.stringify(parsed)});
-  document.getElementById('rulemsg').textContent = JSON.stringify(await r.json());
+function fieldValue(rule, f){
+  const v = rule[f];
+  if (v === undefined || v === null) return '';
+  return (typeof v === 'object') ? JSON.stringify(v) : String(v);
+}
+let lastRules = [];
+async function loadRules(){
+  const qs = `app=${encodeURIComponent(curApp)}&type=${encodeURIComponent(curType)}`;
+  let rules = [];
+  try { rules = await api('v1/rules?' + qs); } catch(e){}
+  if (!Array.isArray(rules)) rules = [];
+  lastRules = rules;
+  renderView();
+}
+// render from lastRules WITHOUT re-fetching: a v1/rules fetch re-syncs the
+// dashboard repository and assigns fresh ids, which would orphan the id an
+// in-progress edit captured
+function renderView(fill){
+  const fields = SCHEMAS[curType];
+  const qs = `app=${encodeURIComponent(curApp)}&type=${encodeURIComponent(curType)}`;
+  const view = document.getElementById('ruleview');
+  view.innerHTML = '';
+  const table = document.createElement('table');
+  row(table, ['id', ...fields, '', ''], 'th');
+  for (const r of lastRules){
+    const eb = document.createElement('button'); eb.textContent = 'edit';
+    eb.onclick = () => { editId = r.id; renderView(r); };
+    const db = document.createElement('button'); db.textContent = 'delete';
+    db.onclick = async () => {
+      const resp = await fetch(`v1/rule?${qs}&id=${r.id}`, {method:'DELETE'});
+      msg(await resp.json()); loadRules();
+    };
+    row(table, [String(r.id), ...fields.map(f => fieldValue(r, f)), eb, db]);
+  }
+  view.appendChild(table);
+  const form = document.createElement('div');
+  const title = document.createElement('h3');
+  title.textContent = editId === null ? 'add rule' : `edit rule ${editId}`;
+  form.appendChild(title);
+  for (const f of fields){
+    const inp = document.createElement('input');
+    inp.id = 'f_' + f; inp.placeholder = f; inp.size = Math.max(f.length, 8);
+    if (fill) inp.value = fieldValue(fill, f);
+    form.appendChild(inp);
+  }
+  const save = document.createElement('button');
+  save.textContent = editId === null ? 'add' : 'save';
+  save.onclick = async () => {
+    const rule = {};
+    for (const f of fields){
+      const v = coerce(document.getElementById('f_' + f).value);
+      if (v !== undefined) rule[f] = v;
+    }
+    const url = editId === null ? `v1/rule?${qs}`
+      : `v1/rule?${qs}&id=${editId}`;
+    const resp = await fetch(url, {
+      method: editId === null ? 'POST' : 'PUT', body: JSON.stringify(rule)});
+    msg(await resp.json()); editId = null; loadRules();
+  };
+  form.appendChild(save);
+  if (editId !== null){
+    const cancel = document.createElement('button');
+    cancel.textContent = 'cancel';
+    cancel.onclick = () => { editId = null; renderView(); };
+    form.appendChild(cancel);
+  }
+  view.appendChild(form);
+}
+function msg(obj){
+  document.getElementById('rulemsg').textContent = JSON.stringify(obj);
 }
 async function assign(app, machine){
   const r = await fetch(`cluster/assign?app=${encodeURIComponent(app)}`,
     {method:'POST', body: JSON.stringify({server: machine})});
   alert(JSON.stringify(await r.json())); refresh();
+}
+// ---- qps timeline (two series: pass, block — slots 1/2 of the palette) ----
+let chartData = null;
+async function openChart(app, resource){
+  document.getElementById('chartwrap').style.display = '';
+  document.getElementById('chartres').textContent = resource;
+  const now = Date.now();
+  const ms = await api(`metric?app=${encodeURIComponent(app)}` +
+    `&identity=${encodeURIComponent(resource)}` +
+    `&startTime=${now-300000}&endTime=${now}`);
+  chartData = ms.map(e => ({t: e.timestamp, pass: e.passQps, block: e.blockQps}));
+  drawChart();
+}
+function drawChart(){
+  const svg = document.getElementById('chart');
+  svg.innerHTML = '';
+  const NS = 'http://www.w3.org/2000/svg';
+  const W = 720, H = 220, L = 48, R = 10, T = 10, B = 24;
+  const data = chartData || [];
+  if (!data.length){
+    const t = document.createElementNS(NS, 'text');
+    t.setAttribute('x', W/2); t.setAttribute('y', H/2);
+    t.setAttribute('text-anchor', 'middle');
+    t.setAttribute('fill', 'var(--text-secondary)');
+    t.textContent = 'no samples in the last 5 minutes';
+    svg.appendChild(t); return;
+  }
+  const t0 = data[0].t, t1 = data[data.length-1].t || t0 + 1;
+  const ymax = Math.max(1, ...data.map(d => Math.max(d.pass, d.block)));
+  const x = t => L + (W-L-R) * (t1 === t0 ? 0.5 : (t - t0)/(t1 - t0));
+  const y = v => T + (H-T-B) * (1 - v/ymax);
+  // recessive grid: 3 horizontal lines + y labels in secondary ink
+  for (const f of [0, .5, 1]){
+    const g = document.createElementNS(NS, 'line');
+    g.setAttribute('x1', L); g.setAttribute('x2', W-R);
+    g.setAttribute('y1', y(ymax*f)); g.setAttribute('y2', y(ymax*f));
+    g.setAttribute('stroke', 'var(--grid)'); svg.appendChild(g);
+    const lab = document.createElementNS(NS, 'text');
+    lab.setAttribute('x', L-6); lab.setAttribute('y', y(ymax*f)+4);
+    lab.setAttribute('text-anchor', 'end');
+    lab.setAttribute('font-size', '11');
+    lab.setAttribute('fill', 'var(--text-secondary)');
+    lab.textContent = Math.round(ymax*f); svg.appendChild(lab);
+  }
+  for (const [key, color] of [['pass','var(--series-1)'],
+                              ['block','var(--series-2)']]){
+    const pl = document.createElementNS(NS, 'polyline');
+    pl.setAttribute('points',
+      data.map(d => `${x(d.t)},${y(d[key])}`).join(' '));
+    pl.setAttribute('fill', 'none');
+    pl.setAttribute('stroke', color);
+    pl.setAttribute('stroke-width', '2');
+    pl.setAttribute('stroke-linejoin', 'round');
+    svg.appendChild(pl);
+  }
+  // hover layer: nearest-sample crosshair + tooltip
+  const hover = document.createElementNS(NS, 'rect');
+  hover.setAttribute('x', L); hover.setAttribute('y', T);
+  hover.setAttribute('width', W-L-R); hover.setAttribute('height', H-T-B);
+  hover.setAttribute('fill', 'transparent');
+  const cross = document.createElementNS(NS, 'line');
+  cross.setAttribute('y1', T); cross.setAttribute('y2', H-B);
+  cross.setAttribute('stroke', 'var(--text-secondary)');
+  cross.setAttribute('stroke-dasharray', '3,3');
+  cross.style.display = 'none';
+  svg.appendChild(cross);
+  const tip = document.getElementById('tip');
+  hover.onmousemove = (ev) => {
+    const rect = svg.getBoundingClientRect();
+    const px = ev.clientX - rect.left;
+    let best = data[0], bd = Infinity;
+    for (const d of data){
+      const dd = Math.abs(x(d.t) - px);
+      if (dd < bd){ bd = dd; best = d; }
+    }
+    cross.setAttribute('x1', x(best.t)); cross.setAttribute('x2', x(best.t));
+    cross.style.display = '';
+    tip.style.display = 'block';
+    tip.style.left = (ev.pageX + 12) + 'px';
+    tip.style.top = (ev.pageY - 10) + 'px';
+    tip.textContent = new Date(best.t).toLocaleTimeString() +
+      '  pass ' + best.pass + '  block ' + best.block;
+  };
+  hover.onmouseleave = () => {
+    cross.style.display = 'none'; tip.style.display = 'none';
+  };
+  svg.appendChild(hover);
 }
 const MODES = {'-1':'off','0':'client','1':'server'};
 async function refresh(){
@@ -152,13 +352,16 @@ async function refresh(){
     root.appendChild(mt);
     const res = await api('resources?app='+encodeURIComponent(app.name));
     const rt = document.createElement('table');
-    row(rt, ['resource', 'pass qps', 'block qps', 'rt ms'], 'th');
+    row(rt, ['resource', 'pass qps', 'block qps', 'rt ms', ''], 'th');
     const now = Date.now();
     for (const r of res){
       const ms = await api(`metric?app=${encodeURIComponent(app.name)}` +
         `&identity=${encodeURIComponent(r)}&startTime=${now-15000}&endTime=${now}`);
       const last = ms[ms.length-1] || {};
-      row(rt, [r, last.passQps??'', last.blockQps??'', last.rt??'']);
+      const cbtn = document.createElement('button');
+      cbtn.textContent = 'timeline';
+      cbtn.onclick = () => openChart(app.name, r);
+      row(rt, [r, last.passQps??'', last.blockQps??'', last.rt??'', cbtn]);
     }
     root.appendChild(rt);
   }
@@ -181,6 +384,7 @@ class DashboardServer:
         stance for dev use."""
         self.apps = AppManagement()
         self.repository = InMemoryMetricsRepository()
+        self.rules = InMemoryRuleRepository()
         self.client = ApiClient()
         self.fetcher = MetricFetcher(
             self.apps, self.repository, self.client, fetch_interval_s
@@ -315,6 +519,60 @@ class DashboardServer:
                 )
                 return {"pushed": pushed, "machines": len(machines)}
             return self.client.fetch_rules(machines[0], rule_type)
+        if path == "v1/rules":
+            # per-rule-type console view: fetch live, sync ids, return
+            # entities (FlowControllerV1.apiQueryMachineRules analog)
+            app = params.get("app", "")
+            rule_type = params.get("type", "flow")
+            if rule_type not in RULE_TYPES:
+                return {"error": f"unknown rule type {rule_type}"}
+            machines = self.apps.healthy_machines(app)
+            if not machines:
+                return {"error": f"no healthy machine for app {app}"}
+            live = self.client.fetch_rules(machines[0], rule_type)
+            if live is None:
+                return {"error": "fetch from app failed"}
+            return self.rules.sync(app, rule_type, live)
+        if path == "v1/rule":
+            # single-rule CRUD (apiAddFlowRule / apiUpdateFlowRule /
+            # apiDeleteRule): mutate the id-keyed repository, then publish
+            # the assembled list to every healthy machine
+            app = params.get("app", "")
+            rule_type = params.get("type", "flow")
+            if rule_type not in RULE_TYPES:
+                return {"error": f"unknown rule type {rule_type}"}
+            machines = self.apps.healthy_machines(app)
+            if not machines:
+                return {"error": f"no healthy machine for app {app}"}
+            if not self.rules.known(app, rule_type):
+                # never synced (fresh dashboard): seed from the live agent
+                # first, or this mutation's push would overwrite whatever
+                # rules the agent already holds
+                live = self.client.fetch_rules(machines[0], rule_type)
+                if live is None:
+                    return {"error": "fetch from app failed"}
+                self.rules.sync(app, rule_type, live)
+            if method == "POST":
+                rule = json.loads(body)
+                rule.pop("id", None)
+                rule_id = self.rules.add(app, rule_type, rule)
+            elif method == "PUT":
+                rule_id = int(params.get("id", 0))
+                rule = json.loads(body)
+                rule.pop("id", None)
+                if not self.rules.update(app, rule_type, rule_id, rule):
+                    return {"error": f"no rule with id {rule_id}"}
+            elif method == "DELETE":
+                rule_id = int(params.get("id", 0))
+                if not self.rules.delete(app, rule_type, rule_id):
+                    return {"error": f"no rule with id {rule_id}"}
+            else:
+                return {"error": "POST/PUT/DELETE only"}
+            plain = self.rules.plain_rules(app, rule_type)
+            pushed = sum(
+                self.client.push_rules(m, rule_type, plain) for m in machines
+            )
+            return {"id": rule_id, "pushed": pushed, "machines": len(machines)}
         if method == "POST" and path == "machine/remove":
             # per-machine deregistration; ip+port name the machine
             removed = self.apps.remove_machine(

@@ -250,9 +250,23 @@ def apply_cluster_mode(mode: int, token_port: int = 18730) -> None:
 
                 _EMBEDDED_SERVER["server"] = None
                 service = prev.service
+                old_port = prev.port
                 prev.stop()
-                server = TokenServer(service, host="0.0.0.0", port=token_port)
-                server.start()
+                try:
+                    server = TokenServer(
+                        service, host="0.0.0.0", port=token_port
+                    )
+                    server.start()
+                except Exception:
+                    # roll back onto the old port (we just freed it) so the
+                    # fleet keeps a token server and rules/counters survive;
+                    # if even that fails, surface the original error
+                    rollback = TokenServer(
+                        service, host="0.0.0.0", port=old_port
+                    )
+                    rollback.start()
+                    _EMBEDDED_SERVER["server"] = rollback
+                    raise
                 _EMBEDDED_SERVER["server"] = server
             elif prev is None:
                 from sentinel_tpu.cluster.server import TokenServer

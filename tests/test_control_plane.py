@@ -434,3 +434,59 @@ class TestDatasourceClusterAssignment:
         assert H.apply_client_assignment(payload) is None
         assert cluster_api.get_mode() == cluster_api.ClusterMode.CLIENT
         assert cluster_api._pick_service() is not None
+
+    def test_mode_port_move_rolls_back_on_bind_failure(self):
+        import socket as s
+
+        from sentinel_tpu.cluster import api as cluster_api
+        from sentinel_tpu.engine import ClusterFlowRule
+        from sentinel_tpu.engine.rules import ThresholdMode
+        from sentinel_tpu.transport import handlers as H
+
+        H.apply_cluster_mode(1, 0)
+        server = H._EMBEDDED_SERVER["server"]
+        old_port = server.port
+        service = server.service
+        service.load_rules(
+            [ClusterFlowRule(flow_id=9, count=5.0, mode=ThresholdMode.GLOBAL)]
+        )
+        # a port that is already bound → the move must fail...
+        blocker = s.socket()
+        blocker.bind(("0.0.0.0", 0))
+        blocker.listen(1)
+        busy_port = blocker.getsockname()[1]
+        try:
+            with pytest.raises(Exception):
+                H.apply_cluster_mode(1, busy_port)
+            # ...and roll back: a server still runs on the old port with the
+            # SAME service (rules preserved)
+            rolled = H._EMBEDDED_SERVER["server"]
+            assert rolled is not None
+            assert rolled.port == old_port
+            assert rolled.service is service
+            assert [r.flow_id for r in rolled.service.current_rules()] == [9]
+        finally:
+            blocker.close()
+
+    def test_port_move_rearms_concurrent_expiry(self):
+        import socket as s
+
+        from sentinel_tpu.cluster.concurrent import ConcurrentFlowRule
+        from sentinel_tpu.transport import handlers as H
+
+        H.apply_cluster_mode(1, 0)
+        service = H._EMBEDDED_SERVER["server"].service
+        service.load_concurrent_rules(
+            [ConcurrentFlowRule(flow_id=4, concurrency_level=2)]
+        )
+        assert service._expiry is not None
+        sock = s.socket()
+        sock.bind(("0.0.0.0", 0))
+        new_port = sock.getsockname()[1]
+        sock.close()
+        H.apply_cluster_mode(1, new_port)
+        moved = H._EMBEDDED_SERVER["server"]
+        assert moved.port == new_port
+        assert moved.service is service
+        # stop() closed the expiry sweeper; the restart must re-arm it
+        assert service._expiry is not None

@@ -456,3 +456,157 @@ class TestEndToEnd:
             assert "sentinel-tpu console" in html
         finally:
             dash.stop()
+
+
+def _req(port, path, method="GET", payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{path}", data=data, method=method,
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+class TestRuleCrudViews:
+    """Per-rule-type create→edit→delete round-trips through the v1 CRUD
+    endpoints against a LIVE agent (FlowControllerV1 & siblings over
+    InMemoryRuleRepositoryAdapter, through the SentinelApiClient analog).
+    Verification reads the rules BACK from the agent via the fetch proxy, so
+    the whole push→agent→fetch loop is exercised."""
+
+    # rule_type → (create payload, update payload, key(dict))
+    CASES = {
+        "flow": (
+            {"resource": "crud_res", "count": 5, "grade": 1},
+            {"resource": "crud_res", "count": 9, "grade": 1},
+            lambda d: (d.get("resource"), d.get("count")),
+        ),
+        "degrade": (
+            {"resource": "crud_deg", "grade": 0, "count": 100, "timeWindow": 10},
+            {"resource": "crud_deg", "grade": 0, "count": 250, "timeWindow": 10},
+            lambda d: (d.get("resource"), d.get("count")),
+        ),
+        "system": (
+            {"qps": 1000},
+            {"qps": 2000},
+            lambda d: ("system", d.get("qps")),
+        ),
+        "authority": (
+            {"resource": "crud_auth", "limitApp": "appA", "strategy": 0},
+            {"resource": "crud_auth", "limitApp": "appB", "strategy": 0},
+            lambda d: (d.get("resource"), d.get("limitApp")),
+        ),
+        "paramFlow": (
+            {"resource": "crud_param", "paramIdx": 0, "count": 50},
+            {"resource": "crud_param", "paramIdx": 0, "count": 75},
+            lambda d: (d.get("resource"), d.get("count")),
+        ),
+        "gateway": (
+            {"resource": "crud_gw", "count": 30, "resourceMode": 0},
+            {"resource": "crud_gw", "count": 60, "resourceMode": 0},
+            lambda d: (d.get("resource"), d.get("count")),
+        ),
+    }
+    EXPECT = {
+        "flow": (("crud_res", 5.0), ("crud_res", 9.0)),
+        "degrade": (("crud_deg", 100.0), ("crud_deg", 250.0)),
+        "system": (("system", 1000.0), ("system", 2000.0)),
+        "authority": (("crud_auth", "appA"), ("crud_auth", "appB")),
+        "paramFlow": (("crud_param", 50.0), ("crud_param", 75.0)),
+        "gateway": (("crud_gw", 30.0), ("crud_gw", 60.0)),
+    }
+
+    @pytest.mark.parametrize("rule_type", list(CASES))
+    def test_create_edit_delete_roundtrip(self, rule_type):
+        from sentinel_tpu.adapters.gateway import GatewayRuleManager
+        from sentinel_tpu.transport.command import CommandCenter
+
+        create, update, key_of = self.CASES[rule_type]
+        expect_created, expect_updated = self.EXPECT[rule_type]
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            qs = f"app=svc&type={rule_type}"
+
+            def live_keys():
+                fetched = _req(dash.port, f"rules?{qs}")  # live agent fetch
+                return [key_of(d) for d in fetched]
+
+            # CREATE: pushed to the live agent
+            out = _req(dash.port, f"v1/rule?{qs}", "POST", create)
+            assert out.get("pushed") == 1, out
+            assert expect_created in live_keys()
+            # LIST: the console view sees the rule with an id
+            listed = _req(dash.port, f"v1/rules?{qs}")
+            assert listed and all("id" in e for e in listed)
+            rule_id = listed[-1]["id"]
+            # EDIT
+            out = _req(dash.port, f"v1/rule?{qs}&id={rule_id}", "PUT", update)
+            assert out.get("pushed") == 1, out
+            assert expect_updated in live_keys()
+            assert expect_created not in live_keys()
+            # DELETE
+            out = _req(dash.port, f"v1/rule?{qs}&id={rule_id}", "DELETE")
+            assert out.get("pushed") == 1, out
+            assert expect_updated not in live_keys()
+        finally:
+            cc.stop()
+            dash.stop()
+            GatewayRuleManager.reset_for_tests()
+
+    def test_update_unknown_id_errors(self):
+        from sentinel_tpu.transport.command import CommandCenter
+
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0).start()
+        try:
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            out = _req(dash.port, "v1/rule?app=svc&type=flow&id=424242",
+                       "PUT", {"resource": "x", "count": 1})
+            assert "error" in out
+        finally:
+            cc.stop()
+            dash.stop()
+
+    def test_console_page_has_rule_views_and_chart(self):
+        dash = DashboardServer(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/", timeout=5
+            ) as r:
+                html = r.read().decode()
+            for marker in ("SCHEMAS", "paramFlow", "gateway", "openChart",
+                           "qps timeline", "--series-1", "polyline"):
+                assert marker in html, marker
+        finally:
+            dash.stop()
+
+    def test_mutation_on_fresh_dashboard_preserves_agent_rules(self):
+        # a restarted dashboard (empty repo) must not overwrite the rules an
+        # agent already holds when a single-rule mutation arrives
+        from sentinel_tpu.local import FlowRule, FlowRuleManager
+        from sentinel_tpu.transport.command import CommandCenter
+
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0).start()
+        try:
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            FlowRuleManager.load_rules(
+                [FlowRule(resource="pre_existing", count=11)]
+            )
+            out = _req(dash.port, "v1/rule?app=svc&type=flow", "POST",
+                       {"resource": "added_later", "count": 3})
+            assert out.get("pushed") == 1
+            resources = {r.resource for r in FlowRuleManager.all_rules()}
+            assert resources == {"pre_existing", "added_later"}
+        finally:
+            cc.stop()
+            dash.stop()

@@ -10,6 +10,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Route platform selection through jax.config: the axon environment resolves
+# JAX_PLATFORMS at backend-init inside its register hook, which can block on
+# a down tunnel; an explicit config.update pins the platform up front.
+import jax  # noqa: E402
+
+_p = os.environ.get("JAX_PLATFORMS")
+if _p:
+    jax.config.update("jax_platforms", _p.split(",")[0])
+
+
 from sentinel_tpu.core import clock as clock_mod
 from sentinel_tpu.core.clock import ManualClock
 from sentinel_tpu.local import BlockException

@@ -29,6 +29,7 @@ keeps pumping frames while XLA executes.
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -414,6 +415,7 @@ class TokenServer:
         inline_below: int = 64,
         n_loops: int = 1,
         idle_ttl_s: Optional[float] = 600.0,
+        profile_dir: Optional[str] = None,
     ):
         self.service = service
         self.host = host
@@ -432,6 +434,15 @@ class TokenServer:
         notify = getattr(self.service, "connected_count_changed", None)
         self.connections = ConnectionManager(on_count_changed=notify)
         self._idle_task = None
+        # optional serving-loop profiling (SURVEY §5 tracing row): a
+        # jax.profiler trace spanning start()→stop() captures every device
+        # step the micro-batchers dispatch, viewable in TensorBoard/XProf.
+        # Also honored from the env so an operator can profile a live
+        # deployment without code changes.
+        self.profile_dir = profile_dir or os.environ.get(
+            "SENTINEL_PROFILE_DIR"
+        ) or None
+        self._profiling = False
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -443,6 +454,15 @@ class TokenServer:
         reopen = getattr(self.service, "reopen", None)
         if reopen is not None:
             reopen()  # re-arm background sweeps a prior stop() released
+        if self.profile_dir:
+            try:
+                import jax.profiler
+
+                jax.profiler.start_trace(self.profile_dir)
+                self._profiling = True
+                record_log.info("profiling serve loop to %s", self.profile_dir)
+            except Exception:
+                record_log.exception("profiler start failed; serving anyway")
         if self.n_loops > 1 and not hasattr(socket, "SO_REUSEPORT"):
             record_log.warning("SO_REUSEPORT unavailable; forcing n_loops=1")
             self.n_loops = 1
@@ -470,6 +490,14 @@ class TokenServer:
             self._idle_task.start()
 
     def stop(self) -> None:
+        if self._profiling:
+            self._profiling = False
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+            except Exception:
+                record_log.exception("profiler stop failed")
         if self._idle_task is not None:
             self._idle_task.stop()
             self._idle_task = None

@@ -519,3 +519,19 @@ class TestEmbeddedClusterFlow:
             except BlockException:
                 blocked += 1
         assert (ok, blocked) == (3, 2)
+
+
+class TestProfilingHook:
+    def test_profile_dir_produces_trace(self, tmp_path):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=100.0, mode=G)])
+        server = TokenServer(svc, port=0, profile_dir=str(tmp_path))
+        server.start()
+        try:
+            client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+            assert client.request_token(1).ok
+            client.close()
+        finally:
+            server.stop()
+        produced = list(tmp_path.rglob("*"))
+        assert any(p.is_file() for p in produced), produced

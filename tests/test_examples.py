@@ -15,6 +15,8 @@ FAST_EXAMPLES = [
     "param_flow.py",
     "system_guard.py",
     "async_entry_demo.py",
+    "namespace_partition_demo.py",
+    "envoy_rls_scale_demo.py",
 ]
 
 
@@ -25,11 +27,33 @@ def test_example_runs(script):
         [sys.executable, os.path.join(_REPO, "examples", script)],
         capture_output=True,
         text=True,
-        timeout=120,
+        timeout=300,
         env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout.strip(), "example produced no output"
+
+
+def test_namespace_partition_demo_shows_movement():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples", "namespace_partition_demo.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+    ).stdout
+    assert "independent budgets" in out
+    assert "after moving 'search' to pod0" in out
+
+
+def test_envoy_rls_scale_demo_enforces_at_10k():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples", "envoy_rls_scale_demo.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+    ).stdout
+    assert "loaded 10000 RLS descriptors" in out
+    assert "100 of 150 allowed" in out
 
 
 def test_warm_up_shows_ramp():

@@ -1,0 +1,83 @@
+"""Analytic FLOPs/bytes model for the token-verdict device step.
+
+Gives BENCH's roofline context (VERDICT r3 #5): how much of a v5e chip the
+measured step time actually uses, so "is X decisions/s good?" has an
+engineering answer. The model covers the uniform+grouped serving path of
+``engine/decide._decide_core`` — the variant the token service dispatches
+for sorted, uniform-acquire batches (its common case and the bench headline).
+
+The counts below follow the kernel source: every matmul/einsum contributes
+``2·M·K·N`` FLOPs, cummax contributes comparisons at the same shape as the
+cumsum matmuls, and elementwise work is folded into a small constant per
+row. Bytes count HBM traffic touched per batch: state gathers/scatters,
+rule-table gathers, batch in / verdicts out, plus the materialized one-hot
+and blocked-cumsum intermediates (upper bound — XLA fusion only shrinks it).
+
+Conclusion the numbers support (recorded in BENCH extra): the step is
+neither MXU- nor HBM-saturated at serving shapes — it is dispatch/op-count
+bound, so throughput scales with batch size until the [N, NS] one-hot work
+reaches MXU scale. That is the design's headroom, not a defect: at N=16k the
+whole step is ~0.3 GFLOP against a 49-TFLOP/s f32 ceiling.
+"""
+
+from __future__ import annotations
+
+_CUMSUM_BLOCK = 128  # ops/scan_mm.py blocked_cumsum default
+
+
+def _cumsum_flops(n: int, k: int) -> float:
+    """blocked_cumsum on [n, k]: per-block [C,C]@[C,k] einsum + [R,R]@[R,k]."""
+    c = _CUMSUM_BLOCK
+    r = -(-n // c)
+    within = 2.0 * r * c * c * k
+    offsets = 2.0 * r * r * k
+    return within + offsets
+
+
+def decide_step_model(
+    batch: int, n_namespaces: int = 64, n_buckets: int = 10,
+    n_events: int = 5,
+) -> dict:
+    """FLOPs and HBM bytes per uniform+grouped decide step at ``batch`` N."""
+    n, ns, b = batch, n_namespaces, n_buckets
+
+    flops = 0.0
+    # namespace one-hot inclusive cumsum over [N, NS] (decide.py step 1)
+    flops += _cumsum_flops(n, ns)
+    # ns one-hot build + take_along_axis + guard-counter einsum [N,NS]·[N]
+    flops += 3.0 * n * ns
+    # grouped flow prefix: cumsum [N] + cummax [N] (comparisons ~ matmul
+    # shape), used twice (admission rank + admitted_prefix)
+    flops += 2.0 * (_cumsum_flops(n, 1) * 2)
+    # thresholds, closed-form admission, verdict selects: ~40 elementwise
+    # ops per row
+    flops += 40.0 * n
+
+    i32 = 4
+    bytes_ = 0.0
+    # window reads: PASS rows [N, B] + occupy rows [N, B]
+    bytes_ += 2.0 * n * b * i32
+    # occupy-path expiring read is cond-gated off (no prioritized traffic in
+    # the serving common case)
+    # scatter updates: 4 event channels, read+write per touched cell
+    bytes_ += 2.0 * 4.0 * n * i32
+    # rule-table gathers: count, mode, namespace_id, valid
+    bytes_ += 4.0 * n * i32
+    # batch in (slot, acquire, prio, valid) + verdicts out (status, wait,
+    # remaining)
+    bytes_ += n * (i32 * 2 + 2) + n * (1 + i32 * 2)
+    # materialized intermediates (upper bound): ns one-hot [N, NS] f32
+    # written+read by the cumsum einsum, plus the blocked within/offsets
+    bytes_ += 3.0 * n * ns * i32
+    # window starts vectors + ns window (small, counted once)
+    bytes_ += (b * i32) * 3 + ns * b * i32
+
+    return {"flops": round(flops), "bytes": round(bytes_)}
+
+
+if __name__ == "__main__":
+    import json
+
+    for n in (64, 1024, 16384):
+        m = decide_step_model(n)
+        print(json.dumps({"batch": n, **m}))

@@ -31,7 +31,10 @@ def default_resource(request) -> str:
 
 
 def default_origin(request) -> str:
-    return request.headers.get("S-User", "") or (request.remote or "")
+    """``X-Sentinel-Origin`` → ``S-User`` → peer IP (adapters/origin.py)."""
+    from sentinel_tpu.adapters.origin import from_headers
+
+    return from_headers(request.headers, request.remote or "")
 
 
 def sentinel_middleware(

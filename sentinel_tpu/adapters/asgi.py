@@ -39,8 +39,12 @@ def default_resource(scope) -> str:
 
 
 def default_origin(scope) -> str:
-    client = scope.get("client")
-    return client[0] if client else ""
+    """Cross-service convention: ``X-Sentinel-Origin`` (set by the
+    ``http_client`` wrappers), then the legacy ``S-User`` identity header,
+    then the peer IP — see ``adapters/origin.py``."""
+    from sentinel_tpu.adapters.origin import from_asgi_scope
+
+    return from_asgi_scope(scope)
 
 
 class SentinelAsgiMiddleware:

@@ -4,6 +4,11 @@ Analog of ``sentinel-okhttp-adapter`` / ``sentinel-apache-httpclient-adapter``:
 the outbound URL (normalized to ``METHOD:scheme://host/path``) is an OUT-type
 resource; blocks raise ``BlockException`` before any connection is made;
 HTTP errors are traced. Gated on the respective client library.
+
+Both wrappers also attach ``X-Sentinel-Origin: <app name>`` so the callee's
+Sentinel adapter sees the calling *application* as the request origin — the
+dubbo consumer→provider attachment idiom for plain HTTP (see
+``adapters/origin.py``). Pass ``propagate_origin=False`` to opt out.
 """
 
 from __future__ import annotations
@@ -34,15 +39,23 @@ def guarded_call(fn: Callable, method: str, url: str,
 # -- requests ---------------------------------------------------------------
 
 def guarded_requests_session(
-    session=None, resource_extractor: Callable = default_resource
+    session=None, resource_extractor: Callable = default_resource,
+    propagate_origin: bool = True,
 ):
     """Wrap a ``requests.Session`` so every request is guarded."""
     import requests
+
+    from sentinel_tpu.adapters.origin import inject as _inject_origin
 
     session = session or requests.Session()
     inner = session.request
 
     def request(method, url, *args, **kwargs):
+        # requests.Session.request takes headers as its 5th positional arg
+        # (after params, data) — only inject via kwargs when the caller
+        # didn't already pass it positionally
+        if propagate_origin and len(args) < 3:
+            kwargs["headers"] = _inject_origin(kwargs.get("headers"))
         with _entry(resource_extractor(method, url), EntryType.OUT) as e:
             resp = inner(method, url, *args, **kwargs)
             if resp.status_code >= 500:
@@ -58,13 +71,22 @@ def guarded_requests_session(
 class SentinelHttpxTransport:
     """``httpx`` custom transport wrapper: ``httpx.Client(transport=...)``."""
 
-    def __init__(self, inner=None, resource_extractor: Callable = default_resource):
+    def __init__(self, inner=None, resource_extractor: Callable = default_resource,
+                 propagate_origin: bool = True):
         import httpx
 
         self._inner = inner or httpx.HTTPTransport()
         self._extract = resource_extractor
+        self._propagate_origin = propagate_origin
 
     def handle_request(self, request):
+        if self._propagate_origin:
+            from sentinel_tpu.adapters.origin import ORIGIN_HEADER, origin_value
+
+            if ORIGIN_HEADER not in request.headers:
+                value = origin_value()
+                if value:
+                    request.headers[ORIGIN_HEADER] = value
         resource = self._extract(request.method, str(request.url))
         with _entry(resource, EntryType.OUT) as e:
             response = self._inner.handle_request(request)

@@ -33,9 +33,11 @@ class SentinelRequestHandlerMixin:
         return f"{self.request.method}:{self.request.path}"
 
     def sentinel_origin(self) -> str:
-        return (
-            self.request.headers.get("S-User", "")
-            or (self.request.remote_ip or "")
+        """``X-Sentinel-Origin`` → ``S-User`` → peer IP (adapters/origin.py)."""
+        from sentinel_tpu.adapters.origin import from_headers
+
+        return from_headers(
+            self.request.headers, self.request.remote_ip or ""
         )
 
     def prepare(self):

@@ -23,7 +23,10 @@ def default_resource(environ) -> str:
 
 
 def default_origin(environ) -> str:
-    return environ.get("HTTP_S_USER", "") or environ.get("REMOTE_ADDR", "")
+    """``X-Sentinel-Origin`` → ``S-User`` → peer IP (adapters/origin.py)."""
+    from sentinel_tpu.adapters.origin import from_wsgi
+
+    return from_wsgi(environ)
 
 
 class SentinelWsgiMiddleware:

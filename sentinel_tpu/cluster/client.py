@@ -225,7 +225,13 @@ class TokenClient(TokenService):
                 payload = pending.response
                 if not isinstance(payload, (bytes, bytearray)):
                     return None  # connection died mid-batch
-                _, st, rem, wt = P.decode_batch_response(payload)
+                try:
+                    _, st, rem, wt = P.decode_batch_response(payload)
+                except Exception:
+                    # truncated/malformed server frame degrades to the
+                    # documented None contract, never an exception out of
+                    # the caller (the local-fallback path handles None)
+                    return None
                 if st.shape[0] != hi - lo:
                     return None
                 status[lo:hi] = st

@@ -39,9 +39,15 @@ class ConnectionManager:
 
     def attach_closer(self, address: str, closer: Callable[[], None]) -> None:
         """Register the transport-close hook for a connection (thread-safe
-        callable; the server passes a loop.call_soon_threadsafe wrapper)."""
+        callable; the server passes a loop.call_soon_threadsafe wrapper).
+
+        Also seeds the liveness stamp: the reference tracks every accepted
+        channel from its first activity, so a socket that connects but never
+        PINGs must still age out of the idle sweep instead of being held
+        open forever."""
         with self._lock:
             self._closers[address] = closer
+            self._last_active_ms.setdefault(address, _clock.now_ms())
 
     def add(self, namespace: str, address: str) -> int:
         """Register; returns the group's connected count (PING response)."""
@@ -58,9 +64,9 @@ class ConnectionManager:
     def touch(self, address: str) -> None:
         """Refresh a connection's liveness (any request counts, like the
         reference updating ``Connection.lastReadTime`` per channelRead)."""
-        if address in self._by_address:  # racy pre-check is fine: worst case
-            with self._lock:  # a just-removed address gets a stale stamp
-                if address in self._by_address:
+        if address in self._last_active_ms:  # racy pre-check is fine: worst
+            with self._lock:  # case a just-removed address gets a stale stamp
+                if address in self._last_active_ms:
                     self._last_active_ms[address] = _clock.now_ms()
 
     def sweep_idle(self, ttl_ms: float) -> List[str]:

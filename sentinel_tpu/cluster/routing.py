@@ -167,11 +167,18 @@ class RoutingTokenClient(TokenService):
             pod_id = self._pods_by_num.get(num)
             if pod_id is not None and pod_id in self._clients:
                 clients = [self._clients[pod_id]]
+            elif num:
+                # prefixed id whose issuing pod left the routing table: only
+                # that pod could hold the token (ids are pod-scoped), and its
+                # counters died with it — fail fast as already-released.
+                # Broadcasting the masked local id could wrongly release an
+                # UNRELATED token that another pod issued under the same
+                # local counter value (round-3 advisor finding).
+                return TokenResult(TokenStatus.ALREADY_RELEASE)
             else:
-                # unprefixed id (issued elsewhere) or pod since removed:
+                # genuinely unprefixed id (issued outside the router):
                 # degrade to first-success fan-out with the raw id
                 clients = list(self._clients.values())
-                local_id = token_id
         result = TokenResult(TokenStatus.FAIL)
         for client in clients:
             r = client.release_concurrent_token(local_id)

@@ -444,6 +444,19 @@ class TokenServer:
         ) or None
         self._profiling = False
 
+    def tuning_kwargs(self) -> dict:
+        """Operator-tunable constructor kwargs, for rebuilding this server on
+        a port move (command or datasource driven) without silently resetting
+        live tuning to defaults."""
+        return dict(
+            batch_window_ms=self.batch_window_ms,
+            max_batch=self.max_batch,
+            inline_below=self.inline_below,
+            n_loops=self.n_loops,
+            idle_ttl_s=self.idle_ttl_s,
+            profile_dir=self.profile_dir,
+        )
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         if self._workers:

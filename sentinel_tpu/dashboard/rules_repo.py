@@ -14,6 +14,7 @@ Rules are plain dicts in the agent's JSON schema (the same payloads
 from __future__ import annotations
 
 import itertools
+import json
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -25,12 +26,29 @@ class InMemoryRuleRepository:
         # (app, rule_type) → {id: rule-dict}
         self._rules: Dict[Tuple[str, str], Dict[int, dict]] = {}
 
+    @staticmethod
+    def _content_key(rule: dict) -> str:
+        return json.dumps(rule, sort_keys=True, default=str)
+
     def sync(self, app: str, rule_type: str, rules: List[dict]) -> List[dict]:
-        """Replace the stored set from a live fetch, assigning fresh ids
-        (the reference re-saves on every page load too). Returns the stored
-        entries with ids attached."""
+        """Replace the stored set from a live fetch. Ids are STABLE across
+        syncs: a fetched rule whose content matches an existing entry keeps
+        that entry's id (like the reference's ``InMemoryRuleRepositoryAdapter``
+        keeping ids server-side), so concurrent console tabs and page reloads
+        don't orphan an in-flight edit's id. Only genuinely new rules get
+        fresh ids. Returns the stored entries with ids attached."""
         with self._lock:
-            entries = {next(self._ids): dict(rule) for rule in rules}
+            prev = self._rules.get((app, rule_type), {})
+            # content → ids of previous entries, consumed first-come (stable
+            # for duplicates: N identical rules keep N distinct ids)
+            by_content: Dict[str, List[int]] = {}
+            for rule_id, rule in sorted(prev.items()):
+                by_content.setdefault(self._content_key(rule), []).append(rule_id)
+            entries: Dict[int, dict] = {}
+            for rule in rules:
+                pool = by_content.get(self._content_key(rule))
+                rule_id = pool.pop(0) if pool else next(self._ids)
+                entries[rule_id] = dict(rule)
             self._rules[(app, rule_type)] = entries
             return [{"id": i, **r} for i, r in sorted(entries.items())]
 

@@ -261,3 +261,46 @@ def gateway_flow_rules_to_json(rules) -> str:
         ],
         indent=2,
     )
+
+
+def cluster_flow_rules_from_json(text: str):
+    """Cluster (token-server) rule schema: the ``FlowRule`` +
+    ``ClusterFlowConfig`` subset the device engine consumes
+    (``ClusterFlowRuleManager`` parses the same shape from its namespace
+    datasources — ``flowId``/``count``/``thresholdType``/``namespace``)."""
+    from sentinel_tpu.engine import ClusterFlowRule
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    return [
+        ClusterFlowRule(
+            flow_id=int(
+                d.get("flowId", (d.get("clusterConfig") or {}).get("flowId", 0))
+            ),
+            count=float(d.get("count", 0)),
+            mode=ThresholdMode(
+                int(
+                    d.get(
+                        "thresholdType",
+                        (d.get("clusterConfig") or {}).get("thresholdType", 0),
+                    )
+                )
+            ),
+            namespace=str(d.get("namespace", "default") or "default"),
+        )
+        for d in json.loads(text) or []
+    ]
+
+
+def cluster_flow_rules_to_json(rules) -> str:
+    return json.dumps(
+        [
+            {
+                "flowId": r.flow_id,
+                "count": r.count,
+                "thresholdType": int(r.mode),
+                "namespace": r.namespace,
+            }
+            for r in rules
+        ],
+        indent=2,
+    )

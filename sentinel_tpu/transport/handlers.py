@@ -251,10 +251,14 @@ def apply_cluster_mode(mode: int, token_port: int = 18730) -> None:
                 _EMBEDDED_SERVER["server"] = None
                 service = prev.service
                 old_port = prev.port
+                # carry the live server's tuning across the move — a rebuild
+                # with constructor defaults would silently drop operator
+                # settings like batch_window_ms/n_loops on a port change
+                tuning = prev.tuning_kwargs()
                 prev.stop()
                 try:
                     server = TokenServer(
-                        service, host="0.0.0.0", port=token_port
+                        service, host="0.0.0.0", port=token_port, **tuning
                     )
                     server.start()
                 except Exception:
@@ -262,7 +266,7 @@ def apply_cluster_mode(mode: int, token_port: int = 18730) -> None:
                     # fleet keeps a token server and rules/counters survive;
                     # if even that fails, surface the original error
                     rollback = TokenServer(
-                        service, host="0.0.0.0", port=old_port
+                        service, host="0.0.0.0", port=old_port, **tuning
                     )
                     rollback.start()
                     _EMBEDDED_SERVER["server"] = rollback
@@ -633,7 +637,10 @@ def cmd_cluster_server_modify_transport_config(params, body):
         from sentinel_tpu.cluster.server import TokenServer
 
         server.stop()
-        replacement = TokenServer(server.service, host=server.host, port=port)
+        replacement = TokenServer(
+            server.service, host=server.host, port=port,
+            **server.tuning_kwargs(),
+        )
         replacement.start()  # kernels already warm; this is just a rebind
         _EMBEDDED_SERVER["server"] = replacement
     return "success"

@@ -508,6 +508,88 @@ class TestHttpClient:
             session.request("GET", "http://127.0.0.1:1/x")
 
 
+class TestOriginPropagation:
+    """Cross-service origin convention (adapters/origin.py): the outbound
+    wrappers attach ``X-Sentinel-Origin: <app name>`` and the inbound
+    adapters parse it into the context origin, so authority rules gate
+    callers by *application* across an HTTP hop — the dubbo
+    consumer→provider attachment idiom (``SentinelDubboProviderFilter``)."""
+
+    @pytest.fixture()
+    def app_name(self):
+        from sentinel_tpu.core.config import SentinelConfig
+
+        SentinelConfig.set("csp.sentinel.app.name", "svc-a")
+        yield "svc-a"
+        SentinelConfig.reset_for_tests()
+
+    def test_authority_rule_across_http_hop(self, manual_clock, app_name):
+        # real wire hop: requests session → wsgiref server → wsgi middleware
+        pytest.importorskip("requests")
+        import threading
+        from wsgiref.simple_server import WSGIServer, make_server
+
+        import requests
+
+        from sentinel_tpu.adapters.http_client import guarded_requests_session
+        from sentinel_tpu.local.authority import (
+            AuthorityRule,
+            AuthorityRuleManager,
+        )
+
+        AuthorityRuleManager.load_rules(
+            [AuthorityRule(resource="GET:/api", limit_app="svc-a")]
+        )
+        app = SentinelWsgiMiddleware(_wsgi_app)
+        httpd = make_server("127.0.0.1", 0, app, server_class=WSGIServer)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            session = guarded_requests_session()
+            ok = session.request("GET", f"http://127.0.0.1:{port}/api")
+            assert ok.status_code == 200  # origin svc-a is whitelisted
+            bare = requests.get(f"http://127.0.0.1:{port}/api")
+            assert bare.status_code == 429  # peer-IP origin is not
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            t.join(timeout=5)
+
+    def test_httpx_transport_attaches_origin(self, manual_clock, app_name):
+        httpx = pytest.importorskip("httpx")
+        from sentinel_tpu.adapters.http_client import SentinelHttpxTransport
+
+        seen = {}
+
+        def app(request):
+            seen.update(request.headers)
+            return httpx.Response(200, text="ok")
+
+        client = httpx.Client(
+            transport=SentinelHttpxTransport(inner=httpx.MockTransport(app))
+        )
+        assert client.get("http://svc/api").status_code == 200
+        assert seen.get("x-sentinel-origin") == "svc-a"
+
+    def test_asgi_scope_prefers_origin_header(self):
+        from sentinel_tpu.adapters.asgi import default_origin
+
+        scope = {
+            "client": ("10.1.2.3", 1234),
+            "headers": [
+                (b"host", b"svc"),
+                (b"x-sentinel-origin", b"svc-a"),
+                (b"s-user", b"alice"),
+            ],
+        }
+        assert default_origin(scope) == "svc-a"
+        scope["headers"] = [(b"s-user", b"alice")]
+        assert default_origin(scope) == "alice"
+        scope["headers"] = []
+        assert default_origin(scope) == "10.1.2.3"
+
+
 class TestGatewayApiDefinitions:
     """ApiDefinition / matcher semantics (ApiDefinition.java,
     ApiPathPredicateItem.java, GatewayApiMatcherManager.java)."""

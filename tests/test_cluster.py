@@ -246,6 +246,30 @@ class TestTransport:
         finally:
             client.close()
 
+    def test_malformed_batch_response_degrades_to_none(self, live_server,
+                                                       monkeypatch):
+        # a truncated/corrupt server frame must surface as the documented
+        # None (degrade-to-local) contract, not raise out of the caller
+        import numpy as np
+
+        from sentinel_tpu.cluster import client as client_mod
+
+        server, svc = live_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+        try:
+            assert client.ping()
+
+            def _bad_decode(payload):
+                raise ValueError("truncated frame")
+
+            monkeypatch.setattr(
+                client_mod.P, "decode_batch_response", _bad_decode
+            )
+            out = client.request_batch_arrays(np.array([1, 1], np.int64))
+            assert out is None
+        finally:
+            client.close()
+
     def test_concurrent_clients_share_budget(self, live_server):
         server, svc = live_server
         results = []
@@ -358,6 +382,30 @@ class TestIdleReaping:
         assert reaped == ["10.0.0.1:1000"]
         assert counts["default"] == 1
         assert cm.connected_count("default") == 1
+
+    def test_never_pinged_connection_is_reaped(self, manual_clock):
+        # a socket that connects (attach_closer) but never PINGs must still
+        # age out — the reference tracks every channel from accept, not from
+        # its first request (round-3 advisor finding)
+        from sentinel_tpu.cluster.connection import ConnectionManager
+
+        cm = ConnectionManager()
+        closed = []
+        cm.attach_closer("10.0.0.9:4242", lambda: closed.append(True))
+        manual_clock.advance(900_000)
+        reaped = cm.sweep_idle(ttl_ms=600_000)
+        assert reaped == ["10.0.0.9:4242"]
+        assert closed == [True]
+
+    def test_touch_refreshes_never_pinged_connection(self, manual_clock):
+        from sentinel_tpu.cluster.connection import ConnectionManager
+
+        cm = ConnectionManager()
+        cm.attach_closer("10.0.0.9:4242", lambda: None)
+        manual_clock.advance(500_000)
+        cm.touch("10.0.0.9:4242")  # request traffic without a PING
+        manual_clock.advance(400_000)
+        assert cm.sweep_idle(ttl_ms=600_000) == []
 
     def test_batch_traffic_refreshes_liveness(self, live_server, manual_clock):
         # a batch-only client (the high-throughput path) must not be reaped

@@ -468,6 +468,32 @@ class TestDatasourceClusterAssignment:
         finally:
             blocker.close()
 
+    def test_port_move_preserves_server_tuning(self):
+        # a datasource-driven port change rebuilds the TokenServer; operator
+        # tuning (batch window, loop count, …) must survive the move instead
+        # of resetting to constructor defaults (round-3 advisor finding)
+        import socket as s
+
+        from sentinel_tpu.transport import handlers as H
+
+        H.apply_cluster_mode(1, 0)
+        server = H._EMBEDDED_SERVER["server"]
+        server.batch_window_ms = 0.7
+        server.max_batch = 512
+        server.inline_below = 16
+        server.idle_ttl_s = 123.0
+        sock = s.socket()
+        sock.bind(("0.0.0.0", 0))
+        new_port = sock.getsockname()[1]
+        sock.close()
+        H.apply_cluster_mode(1, new_port)
+        moved = H._EMBEDDED_SERVER["server"]
+        assert moved is not server and moved.port == new_port
+        assert moved.batch_window_ms == 0.7
+        assert moved.max_batch == 512
+        assert moved.inline_below == 16
+        assert moved.idle_ttl_s == 123.0
+
     def test_port_move_rearms_concurrent_expiry(self):
         import socket as s
 

@@ -558,6 +558,32 @@ class TestRuleCrudViews:
             dash.stop()
             GatewayRuleManager.reset_for_tests()
 
+    def test_sync_keeps_ids_stable_across_fetches(self):
+        # re-syncing the same live rule set must keep each rule's id (the
+        # reference's InMemoryRuleRepositoryAdapter holds ids server-side):
+        # unstable ids let one console tab orphan another's in-flight edit
+        # (round-3 advisor finding)
+        from sentinel_tpu.dashboard.rules_repo import InMemoryRuleRepository
+
+        repo = InMemoryRuleRepository()
+        rules = [
+            {"resource": "a", "count": 5},
+            {"resource": "b", "count": 9},
+            {"resource": "a", "count": 5},  # duplicate content
+        ]
+        first = repo.sync("app", "flow", rules)
+        again = repo.sync("app", "flow", list(rules))
+        assert [e["id"] for e in first] == [e["id"] for e in again]
+        # a changed rule gets a fresh id; untouched ones keep theirs
+        rules[1] = {"resource": "b", "count": 42}
+        third = repo.sync("app", "flow", rules)
+        a_ids = lambda entries: sorted(  # noqa: E731
+            e["id"] for e in entries if e["resource"] == "a"
+        )
+        assert a_ids(first) == a_ids(third)
+        b_ids = [e["id"] for e in third if e["resource"] == "b"]
+        assert b_ids and b_ids[0] not in [e["id"] for e in first]
+
     def test_update_unknown_id_errors(self):
         from sentinel_tpu.transport.command import CommandCenter
 

@@ -17,6 +17,8 @@ FAST_EXAMPLES = [
     "async_entry_demo.py",
     "namespace_partition_demo.py",
     "envoy_rls_scale_demo.py",
+    "decorator_degrade_demo.py",
+    "datasource_cluster_demo.py",
 ]
 
 

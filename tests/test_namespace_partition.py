@@ -300,6 +300,25 @@ class TestConcurrentTokenRouting:
         out = router.release_concurrent_token(raw_local)
         assert out.ok  # found via first-success fan-out
 
+    def test_release_after_pod_removed_fails_fast(self):
+        # a prefixed token whose issuing pod left the routing table can only
+        # have been held by that pod — the release must NOT fan out with the
+        # masked local id (it could release another pod's same-local-id
+        # token) and must answer already-released (round-3 advisor finding)
+        router = self._router()
+        ra = router.request_concurrent_token(1)  # pod0 local id 1
+        rb = router.request_concurrent_token(2)  # pod1 ALSO local id 1
+        assert ra.ok and rb.ok
+        pod1 = router._clients["pod1"]
+        router.update(
+            pod_of={"b": "pod1"},
+            endpoints={"pod1": ("h1", 11)},  # pod0 removed
+        )
+        out = router.release_concurrent_token(ra.token_id)
+        assert out.status == TokenStatus.ALREADY_RELEASE
+        # pod1's same-local-id token is untouched
+        assert pod1.held == {1: 2}
+
     def test_release_result_is_release_ok(self):
         # round-2 code compared against OK and always reported FAIL
         router = self._router()

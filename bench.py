@@ -91,12 +91,21 @@ def _measure(cfg: dict) -> None:
 
     t_init0 = time.perf_counter()
     last = None
-    for _ in range(3):
+    for attempt in range(3):
         try:
             dev = jax.devices()[0]
             break
         except Exception as e:  # pragma: no cover - env dependent
             last = e
+            # surface each failure immediately — backend claims through the
+            # dev tunnel can block for many minutes before raising, and a
+            # silent retry loop makes the eventual timeout undiagnosable
+            print(
+                f"backend init attempt {attempt + 1} failed after "
+                f"{time.perf_counter() - t_init0:.0f}s: {type(e).__name__}: "
+                f"{str(e)[:300]}",
+                file=sys.stderr, flush=True,
+            )
             time.sleep(5.0)
     else:
         raise RuntimeError(f"backend init failed after retries: {last}")
@@ -517,7 +526,7 @@ def _served_rate() -> dict:
         proc = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "benchmarks", "throughput_bench.py"),
-             "--cpu", "--seconds", "5"],
+             "--cpu", "--native", "--seconds", "5"],
             capture_output=True, text=True, timeout=240, env=env,
         )
         line = next(
@@ -526,11 +535,19 @@ def _served_rate() -> dict:
         )
         if line:
             parsed = json.loads(line)
+            extra = parsed.get("extra", {})
             return {
                 "verdicts_per_sec": parsed.get("value"),
-                "errors": parsed.get("extra", {}).get("error_or_timeout"),
-                "harness": parsed.get("extra", {}).get("harness")
-                or "8 fork clients, pipelined 1024-batch frames, CPU backend",
+                "errors": extra.get("error_or_timeout"),
+                "front_door": extra.get("front_door"),
+                "service_ceiling_vps": extra.get("service_ceiling_vps"),
+                "served_over_ceiling": extra.get("served_over_ceiling"),
+                "host_cores": extra.get("host_cores"),
+                "harness": (
+                    f"{extra.get('clients', '?')} fork clients, pipelined "
+                    f"{extra.get('batch_per_frame', '?')}-batch frames, "
+                    "CPU backend"
+                ),
             }
     except Exception:
         pass

@@ -1,0 +1,680 @@
+// Native TCP front door for the token server: the netty-pipeline analog
+// (``NettyTransportServer.java:73-101``: LengthFieldBasedFrameDecoder →
+// request decoder → handler → writeAndFlush) re-expressed as an epoll loop
+// that decodes BATCH_FLOW/FLOW frames STRAIGHT into a shared request arena
+// and encodes verdict frames back without Python touching a single byte of
+// the data plane. Python's role shrinks to one call per *device step*:
+// ``wait_batch`` (blocks, GIL released) → run the jitted decision kernel →
+// ``submit`` (verdict arrays in, frames out).
+//
+// Round-3 review: the asyncio front door served ~1/8 of the device kernel's
+// ceiling — per-frame Python costs (frame splitting, queue hops, slicing,
+// drain) dominated. This moves the whole per-frame path into C++.
+//
+// Data plane (handled here):
+//   BATCH_FLOW (type 5): n×(flow_id:i64, count:i32, prio:u8) rows → arena
+//   FLOW       (type 1): single request → arena as a 1-row frame
+// Control plane (forwarded to Python, rare): PING, PARAM_FLOW,
+//   CONCURRENT_ACQUIRE/RELEASE, plus open/close connection events so the
+//   host keeps its ConnectionManager (namespace groups, idle sweep) exact.
+//
+// Threading: one IO thread owns epoll, all sockets, and all writes. Python
+// threads call wait_batch/submit/control APIs guarded by a mutex + eventfd
+// wakeups; they never touch a socket. Back-pressure: when the arena is
+// full, a connection's remaining bytes stay in its read buffer and its
+// EPOLLIN is parked until the next arena swap (the kernel's TCP window then
+// back-pressures the client, like netty's autoRead=false).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <chrono>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#if defined(_WIN32)
+#define SN_EXPORT extern "C" __declspec(dllexport)
+#else
+#define SN_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace {
+
+constexpr int kHead = 5;           // xid:i32 + type:u8
+constexpr int kReqRow = 13;        // flow_id:i64 + count:i32 + prio:u8
+constexpr int kRspRow = 9;         // status:i8 + remaining:i32 + wait:i32
+constexpr uint8_t kTypeFlow = 1;
+constexpr uint8_t kTypeBatchFlow = 5;
+constexpr size_t kMaxFrame = 65535;
+constexpr size_t kReadChunk = 1 << 16;
+
+inline uint16_t be16(const uint8_t *p) {
+  return uint16_t(p[0]) << 8 | uint16_t(p[1]);
+}
+inline int32_t be32(const uint8_t *p) {
+  return int32_t(uint32_t(p[0]) << 24 | uint32_t(p[1]) << 16 |
+                 uint32_t(p[2]) << 8 | uint32_t(p[3]));
+}
+inline int64_t be64(const uint8_t *p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = v << 8 | p[i];
+  return int64_t(v);
+}
+inline void put16(uint8_t *p, uint16_t v) {
+  p[0] = uint8_t(v >> 8);
+  p[1] = uint8_t(v);
+}
+inline void put32(uint8_t *p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+struct Conn {
+  int fd = -1;
+  uint32_t gen = 0;
+  int64_t last_active_ms = 0;  // CLOCK_MONOTONIC, for the idle sweep
+  std::vector<uint8_t> rbuf;   // unparsed inbound bytes
+  size_t rpos = 0;             // parse cursor into rbuf
+  std::deque<std::string> wq;  // queued outbound frames
+  size_t woff = 0;             // offset into wq.front()
+  bool want_write = false;     // EPOLLOUT armed
+  bool paused = false;         // EPOLLIN parked (arena full)
+  bool open = true;
+  std::string peer;
+};
+
+// one decoded data-plane frame awaiting verdicts
+struct FrameMeta {
+  int32_t fd;
+  uint32_t gen;
+  int32_t xid;
+  int32_t n;       // requests in this frame
+  uint8_t type;    // kTypeFlow | kTypeBatchFlow
+};
+
+// control event forwarded to Python
+struct Control {
+  int32_t kind;  // 0 = frame, 1 = open, 2 = close
+  int32_t fd;
+  uint32_t gen;
+  std::string payload;  // frame bytes (kind 0) or peer address (kind 1)
+};
+
+struct Frontdoor {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;  // eventfd: submit()/stop()/swap wakeups
+  uint16_t port = 0;
+  std::thread io;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;
+  std::condition_variable cv;  // signaled when arena/control non-empty
+
+  // request arena (guarded by mu)
+  size_t cap;
+  std::vector<int64_t> flow_ids;
+  std::vector<int32_t> counts;
+  std::vector<uint8_t> prios;
+  std::vector<FrameMeta> frames;
+  size_t n_requests = 0;
+  bool arena_was_full = false;
+
+  std::deque<Control> controls;  // guarded by mu
+
+  // outbound handoff: Python-side submit() parks encoded frames here; the
+  // IO thread moves them onto the conn write queues (guarded by mu)
+  std::vector<std::pair<std::pair<int32_t, uint32_t>, std::string>> outbox;
+
+  std::unordered_map<int, Conn> conns;  // IO thread only
+
+  // stats (relaxed)
+  std::atomic<uint64_t> frames_in{0}, requests_in{0}, bytes_in{0},
+      bytes_out{0};
+
+  // idle reaping (ScanIdleConnectionTask analog), 0 = disabled
+  std::atomic<int64_t> idle_ttl_ms{0};
+  int64_t last_sweep_ms = 0;
+
+  explicit Frontdoor(size_t arena_cap) : cap(arena_cap) {
+    flow_ids.resize(cap);
+    counts.resize(cap);
+    prios.resize(cap);
+    frames.reserve(4096);
+  }
+};
+
+int64_t mono_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+void epoll_mod(Frontdoor *s, Conn &c) {
+  epoll_event ev{};
+  ev.events = (c.paused ? 0u : EPOLLIN) | (c.want_write ? EPOLLOUT : 0u);
+  ev.data.fd = c.fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+void close_conn(Frontdoor *s, Conn &c) {
+  if (!c.open) return;
+  c.open = false;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c.fd, nullptr);
+  ::close(c.fd);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->controls.push_back({2, c.fd, c.gen, std::string()});
+  }
+  s->cv.notify_all();
+}
+
+// Parse as many frames as the arena allows; returns false if the conn
+// should be closed (protocol error).
+bool parse_frames(Frontdoor *s, Conn &c) {
+  bool notify = false;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (;;) {
+      size_t avail = c.rbuf.size() - c.rpos;
+      if (avail < 2) break;
+      const uint8_t *p = c.rbuf.data() + c.rpos;
+      size_t flen = be16(p);
+      if (flen < size_t(kHead)) return false;  // runt frame
+      if (avail < 2 + flen) break;
+      const uint8_t *payload = p + 2;
+      uint8_t type = payload[4];
+      if (type == kTypeBatchFlow || type == kTypeFlow) {
+        int32_t n;
+        const uint8_t *rows;
+        if (type == kTypeBatchFlow) {
+          if (flen < size_t(kHead + 2)) return false;
+          n = be16(payload + kHead);
+          if (flen < size_t(kHead + 2) + size_t(n) * kReqRow) return false;
+          rows = payload + kHead + 2;
+        } else {
+          if (flen < size_t(kHead + kReqRow)) return false;
+          n = 1;
+          rows = payload + kHead;
+        }
+        if (s->n_requests + size_t(n) > s->cap) {
+          // arena full: park this conn; bytes stay buffered
+          c.paused = true;
+          s->arena_was_full = true;
+          epoll_mod(s, c);
+          break;
+        }
+        int32_t xid = be32(payload);
+        size_t base = s->n_requests;
+        for (int32_t i = 0; i < n; ++i, rows += kReqRow) {
+          s->flow_ids[base + i] = be64(rows);
+          s->counts[base + i] = be32(rows + 8);
+          s->prios[base + i] = rows[12];
+        }
+        s->n_requests += size_t(n);
+        s->frames.push_back({c.fd, c.gen, xid, n, type});
+        s->frames_in.fetch_add(1, std::memory_order_relaxed);
+        s->requests_in.fetch_add(uint64_t(n), std::memory_order_relaxed);
+        notify = true;
+      } else {
+        // control plane: hand the raw payload to Python
+        s->controls.push_back(
+            {0, c.fd, c.gen,
+             std::string(reinterpret_cast<const char *>(payload), flen)});
+        notify = true;
+      }
+      c.rpos += 2 + flen;
+    }
+  }
+  if (c.rpos > 0 && c.rpos == c.rbuf.size()) {
+    c.rbuf.clear();
+    c.rpos = 0;
+  } else if (c.rpos > (1 << 20)) {
+    c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + c.rpos);
+    c.rpos = 0;
+  }
+  if (notify) s->cv.notify_all();
+  return true;
+}
+
+void flush_writes(Frontdoor *s, Conn &c) {
+  while (!c.wq.empty()) {
+    const std::string &buf = c.wq.front();
+    ssize_t w = ::send(c.fd, buf.data() + c.woff, buf.size() - c.woff,
+                       MSG_NOSIGNAL);
+    if (w > 0) {
+      s->bytes_out.fetch_add(uint64_t(w), std::memory_order_relaxed);
+      c.woff += size_t(w);
+      if (c.woff == buf.size()) {
+        c.wq.pop_front();
+        c.woff = 0;
+      }
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (!c.want_write) {
+        c.want_write = true;
+        epoll_mod(s, c);
+      }
+      return;
+    }
+    close_conn(s, c);
+    return;
+  }
+  if (c.want_write) {
+    c.want_write = false;
+    epoll_mod(s, c);
+  }
+}
+
+void io_loop(Frontdoor *s) {
+  epoll_event evs[256];
+  while (!s->stopping.load(std::memory_order_acquire)) {
+    int n = epoll_wait(s->epoll_fd, evs, 256, 100);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    bool drain_outbox = false;
+    for (int i = 0; i < n; ++i) {
+      int fd = evs[i].data.fd;
+      if (fd == s->listen_fd) {
+        for (;;) {
+          sockaddr_in addr{};
+          socklen_t alen = sizeof(addr);
+          int cfd = accept4(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
+                            &alen, SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn &c = s->conns[cfd];
+          c = Conn{};
+          c.fd = cfd;
+          c.last_active_ms = mono_ms();
+          static std::atomic<uint32_t> gen_counter{1};
+          c.gen = gen_counter.fetch_add(1);
+          char ip[64];
+          inet_ntop(AF_INET, &addr.sin_addr, ip, sizeof(ip));
+          c.peer = std::string(ip) + ":" + std::to_string(ntohs(addr.sin_port));
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.fd = cfd;
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+          {
+            std::lock_guard<std::mutex> lk(s->mu);
+            s->controls.push_back({1, cfd, c.gen, c.peer});
+          }
+          s->cv.notify_all();
+        }
+        continue;
+      }
+      if (fd == s->wake_fd) {
+        uint64_t tok;
+        while (read(s->wake_fd, &tok, sizeof(tok)) > 0) {
+        }
+        drain_outbox = true;
+        continue;
+      }
+      auto it = s->conns.find(fd);
+      if (it == s->conns.end()) continue;
+      Conn &c = it->second;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(s, c);
+        s->conns.erase(it);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) flush_writes(s, c);
+      if (!c.open) {
+        s->conns.erase(it);
+        continue;
+      }
+      if (evs[i].events & EPOLLIN) {
+        bool closed = false;
+        for (;;) {
+          size_t old = c.rbuf.size();
+          c.rbuf.resize(old + kReadChunk);
+          ssize_t r = ::recv(fd, c.rbuf.data() + old, kReadChunk, 0);
+          if (r > 0) {
+            c.rbuf.resize(old + size_t(r));
+            c.last_active_ms = mono_ms();
+            s->bytes_in.fetch_add(uint64_t(r), std::memory_order_relaxed);
+            if (!parse_frames(s, c)) {
+              closed = true;
+              close_conn(s, c);
+              break;
+            }
+            if (size_t(r) < kReadChunk || c.paused) break;
+          } else if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            c.rbuf.resize(old);
+            break;
+          } else {
+            c.rbuf.resize(old);
+            closed = true;
+            close_conn(s, c);
+            break;
+          }
+        }
+        if (closed) {
+          s->conns.erase(it);
+          continue;
+        }
+      }
+    }
+    // idle sweep: close connections quiet past the ttl (the reference's
+    // ScanIdleConnectionTask); checked at most once a second
+    int64_t ttl = s->idle_ttl_ms.load(std::memory_order_relaxed);
+    if (ttl > 0) {
+      int64_t now = mono_ms();
+      if (now - s->last_sweep_ms >= 1000) {
+        s->last_sweep_ms = now;
+        std::vector<int> stale;
+        for (auto &kv : s->conns)
+          if (kv.second.open && now - kv.second.last_active_ms > ttl)
+            stale.push_back(kv.first);
+        for (int fd : stale) {
+          auto it = s->conns.find(fd);
+          if (it != s->conns.end()) {
+            close_conn(s, it->second);
+            s->conns.erase(it);
+          }
+        }
+      }
+    }
+    // move submitted frames onto conn write queues + flush; also resume
+    // parked conns after an arena swap
+    if (drain_outbox) {
+      std::vector<std::pair<std::pair<int32_t, uint32_t>, std::string>> out;
+      bool resume;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        out.swap(s->outbox);
+        resume = s->arena_was_full && s->n_requests < s->cap;
+        if (resume) s->arena_was_full = false;
+      }
+      for (auto &item : out) {
+        auto it = s->conns.find(item.first.first);
+        if (it == s->conns.end() || it->second.gen != item.first.second ||
+            !it->second.open)
+          continue;
+        if (item.second.empty()) {  // zero-length = host-requested close
+          close_conn(s, it->second);
+          s->conns.erase(it);
+          continue;
+        }
+        it->second.wq.push_back(std::move(item.second));
+        flush_writes(s, it->second);
+      }
+      if (resume) {
+        for (auto &kv : s->conns) {
+          Conn &c = kv.second;
+          if (c.paused && c.open) {
+            c.paused = false;
+            epoll_mod(s, c);
+            if (!parse_frames(s, c)) close_conn(s, c);
+          }
+        }
+      }
+    }
+  }
+  // shutdown: close everything
+  for (auto &kv : s->conns) {
+    if (kv.second.open) {
+      ::close(kv.second.fd);
+      kv.second.open = false;
+    }
+  }
+  s->conns.clear();
+}
+
+void wake(Frontdoor *s) {
+  uint64_t one = 1;
+  ssize_t unused = write(s->wake_fd, &one, sizeof(one));
+  (void)unused;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API (ctypes)
+// ---------------------------------------------------------------------------
+
+SN_EXPORT void *sn_fd_create(const char *host, int32_t port,
+                             int32_t arena_cap) {
+  auto *s = new (std::nothrow) Frontdoor(size_t(arena_cap));
+  if (!s) return nullptr;
+  s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  addr.sin_addr.s_addr = host && *host ? inet_addr(host) : htonl(INADDR_ANY);
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) <
+          0 ||
+      listen(s->listen_fd, 1024) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = s->listen_fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.events = EPOLLIN;
+  ev.data.fd = s->wake_fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &ev);
+  s->io = std::thread(io_loop, s);
+  return s;
+}
+
+SN_EXPORT int32_t sn_fd_port(void *h) {
+  return int32_t(static_cast<Frontdoor *>(h)->port);
+}
+
+SN_EXPORT void sn_fd_stop(void *h) {
+  auto *s = static_cast<Frontdoor *>(h);
+  s->stopping.store(true, std::memory_order_release);
+  wake(s);
+  if (s->io.joinable()) s->io.join();
+  ::close(s->listen_fd);
+  ::close(s->epoll_fd);
+  ::close(s->wake_fd);
+  s->cv.notify_all();
+}
+
+SN_EXPORT void sn_fd_destroy(void *h) { delete static_cast<Frontdoor *>(h); }
+
+// Block until data-plane requests are queued (or timeout/stop). Copies up
+// to max_n requests + their frame list into the caller's arrays and resets
+// the arena. Returns the request count (0 on timeout/stop); *n_frames_out
+// receives the frame count. Whole frames only — a frame never splits
+// across two batches.
+SN_EXPORT int32_t sn_fd_wait_batch(void *h, int32_t timeout_ms, int64_t *ids,
+                                   int32_t *counts, uint8_t *prios,
+                                   int32_t max_n, int32_t *f_fd,
+                                   int32_t *f_gen, int32_t *f_xid,
+                                   int32_t *f_n, uint8_t *f_type,
+                                   int32_t max_frames,
+                                   int32_t *n_frames_out) {
+  auto *s = static_cast<Frontdoor *>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  if (s->n_requests == 0) {
+    s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [s] {
+      return s->n_requests > 0 || s->stopping.load(std::memory_order_acquire);
+    });
+  }
+  if (s->n_requests == 0) {
+    *n_frames_out = 0;
+    return 0;
+  }
+  // take whole frames up to the caller's capacity
+  size_t take_req = 0, take_frames = 0;
+  for (const FrameMeta &fm : s->frames) {
+    if (take_frames + 1 > size_t(max_frames) ||
+        take_req + size_t(fm.n) > size_t(max_n))
+      break;
+    take_req += size_t(fm.n);
+    take_frames += 1;
+  }
+  if (take_frames == 0) {
+    *n_frames_out = 0;
+    return 0;  // caller buffers too small for even one frame (misuse)
+  }
+  memcpy(ids, s->flow_ids.data(), take_req * sizeof(int64_t));
+  memcpy(counts, s->counts.data(), take_req * sizeof(int32_t));
+  memcpy(prios, s->prios.data(), take_req);
+  for (size_t i = 0; i < take_frames; ++i) {
+    f_fd[i] = s->frames[i].fd;
+    f_gen[i] = int32_t(s->frames[i].gen);
+    f_xid[i] = s->frames[i].xid;
+    f_n[i] = s->frames[i].n;
+    f_type[i] = s->frames[i].type;
+  }
+  *n_frames_out = int32_t(take_frames);
+  // compact the remainder (rare: only when a burst exceeds caller capacity)
+  size_t rest_req = s->n_requests - take_req;
+  if (rest_req > 0) {
+    memmove(s->flow_ids.data(), s->flow_ids.data() + take_req,
+            rest_req * sizeof(int64_t));
+    memmove(s->counts.data(), s->counts.data() + take_req,
+            rest_req * sizeof(int32_t));
+    memmove(s->prios.data(), s->prios.data() + take_req, rest_req);
+  }
+  s->frames.erase(s->frames.begin(), s->frames.begin() + take_frames);
+  s->n_requests = rest_req;
+  bool resume = s->arena_was_full;
+  lk.unlock();
+  if (resume) wake(s);  // unpark conns the full arena throttled
+  return int32_t(take_req);
+}
+
+// Encode + enqueue verdict frames for the frames returned by wait_batch.
+// status/remaining/wait are request-order arrays covering all frames
+// back-to-back (same order wait_batch returned them).
+SN_EXPORT void sn_fd_submit(void *h, int32_t n_frames, const int32_t *f_fd,
+                            const int32_t *f_gen, const int32_t *f_xid,
+                            const int32_t *f_n, const uint8_t *f_type,
+                            const int8_t *status, const int32_t *remaining,
+                            const int32_t *wait_ms) {
+  auto *s = static_cast<Frontdoor *>(h);
+  std::vector<std::pair<std::pair<int32_t, uint32_t>, std::string>> staged;
+  staged.reserve(size_t(n_frames));
+  size_t off = 0;
+  for (int32_t i = 0; i < n_frames; ++i) {
+    int32_t n = f_n[i];
+    std::string frame;
+    if (f_type[i] == kTypeBatchFlow) {
+      size_t payload = size_t(kHead) + 2 + size_t(n) * kRspRow;
+      frame.resize(2 + payload);
+      uint8_t *p = reinterpret_cast<uint8_t *>(&frame[0]);
+      put16(p, uint16_t(payload));
+      put32(p + 2, uint32_t(f_xid[i]));
+      p[6] = kTypeBatchFlow;
+      put16(p + 7, uint16_t(n));
+      uint8_t *row = p + 9;
+      for (int32_t j = 0; j < n; ++j, row += kRspRow) {
+        row[0] = uint8_t(status[off + size_t(j)]);
+        put32(row + 1, uint32_t(remaining[off + size_t(j)]));
+        put32(row + 5, uint32_t(wait_ms[off + size_t(j)]));
+      }
+    } else {  // single FLOW response
+      size_t payload = size_t(kHead) + kRspRow;
+      frame.resize(2 + payload);
+      uint8_t *p = reinterpret_cast<uint8_t *>(&frame[0]);
+      put16(p, uint16_t(payload));
+      put32(p + 2, uint32_t(f_xid[i]));
+      p[6] = kTypeFlow;
+      p[7] = uint8_t(status[off]);
+      put32(p + 8, uint32_t(remaining[off]));
+      put32(p + 12, uint32_t(wait_ms[off]));
+    }
+    staged.emplace_back(
+        std::make_pair(f_fd[i], uint32_t(f_gen[i])), std::move(frame));
+    off += size_t(n);
+  }
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (auto &item : staged) s->outbox.push_back(std::move(item));
+  }
+  wake(s);
+}
+
+// Enqueue an arbitrary pre-encoded frame (control-plane responses: PING
+// replies, param/concurrent verdicts — Python encodes those).
+SN_EXPORT void sn_fd_send(void *h, int32_t fd, int32_t gen,
+                          const uint8_t *data, int32_t len) {
+  auto *s = static_cast<Frontdoor *>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->outbox.emplace_back(
+        std::make_pair(fd, uint32_t(gen)),
+        std::string(reinterpret_cast<const char *>(data), size_t(len)));
+  }
+  wake(s);
+}
+
+// Pop one control event. Returns its kind (0 frame, 1 open, 2 close) or -1
+// if none. payload_out receives up to max_len bytes; *len_out the true size.
+SN_EXPORT int32_t sn_fd_next_control(void *h, int32_t *fd_out,
+                                     int32_t *gen_out, uint8_t *payload_out,
+                                     int32_t max_len, int32_t *len_out) {
+  auto *s = static_cast<Frontdoor *>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (s->controls.empty()) return -1;
+  Control c = std::move(s->controls.front());
+  s->controls.pop_front();
+  *fd_out = c.fd;
+  *gen_out = int32_t(c.gen);
+  int32_t n = int32_t(c.payload.size());
+  *len_out = n;
+  if (n > 0 && n <= max_len) memcpy(payload_out, c.payload.data(), size_t(n));
+  return c.kind;
+}
+
+SN_EXPORT void sn_fd_set_idle_ttl(void *h, int64_t ttl_ms) {
+  static_cast<Frontdoor *>(h)->idle_ttl_ms.store(ttl_ms,
+                                                 std::memory_order_relaxed);
+}
+
+// Close one connection from the host side (e.g. an operator kick).
+SN_EXPORT void sn_fd_close_conn(void *h, int32_t fd, int32_t gen) {
+  auto *s = static_cast<Frontdoor *>(h);
+  // executed on the IO thread via the outbox: an empty frame with a close
+  // marker would complicate the protocol — instead reuse the outbox with a
+  // zero-length payload the drain loop interprets as "close".
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->outbox.emplace_back(std::make_pair(fd, uint32_t(gen)), std::string());
+  }
+  wake(s);
+}
+
+SN_EXPORT void sn_fd_stats(void *h, uint64_t *out4) {
+  auto *s = static_cast<Frontdoor *>(h);
+  out4[0] = s->frames_in.load(std::memory_order_relaxed);
+  out4[1] = s->requests_in.load(std::memory_order_relaxed);
+  out4[2] = s->bytes_in.load(std::memory_order_relaxed);
+  out4[3] = s->bytes_out.load(std::memory_order_relaxed);
+}

@@ -94,6 +94,14 @@ class TokenClient(TokenService):
             if was_active:
                 self._sock = None
         try:
+            # shutdown BEFORE close: the reader thread is blocked in recv on
+            # this socket, and CPython defers the real fd close until that
+            # call returns — without the shutdown no FIN ever reaches the
+            # server and the connection lingers until the idle sweep
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             sock.close()
         except OSError:
             pass

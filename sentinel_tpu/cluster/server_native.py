@@ -1,0 +1,245 @@
+"""Native-front-door token server: C++ epoll data plane, Python device loop.
+
+The round-3 gap: the asyncio front door served ~1/8 of the device kernel's
+ceiling — per-frame Python costs dominated. Here the whole per-frame path
+(socket reads, length-prefixed framing, BATCH_FLOW/FLOW decode, verdict
+frame encode, socket writes, idle reaping) lives in
+``native/src/sentinel_frontdoor.cpp``; Python's serving loop is one blocking
+``wait_batch`` → ``TokenService.request_batch_arrays`` → ``submit`` cycle
+per DEVICE STEP, regardless of how many frames or connections fed it.
+This is the netty-pipeline analog (``NettyTransportServer.java:73-101``)
+taken to its TPU conclusion: the host's job is to keep the device fed.
+
+Control-plane frames (PING handshake, PARAM_FLOW, CONCURRENT_*) and
+open/close events surface through a low-rate poll thread so namespace
+connection groups (AVG_LOCAL scaling) and the host-side paths stay exactly
+as in the asyncio server. API-compatible with ``TokenServer`` (start/stop/
+port/connections/tuning_kwargs) so ``apply_cluster_mode`` and the benches
+can switch via ``native=True``.
+
+Dispatcher concurrency: ``n_dispatchers`` threads run the wait→step→submit
+cycle. The service lock serializes only device dispatch, so with 2 threads
+one batch's host prep and verdict materialization overlap the other's
+device step (the same overlap the asyncio server got from ``to_thread``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.connection import ConnectionManager
+from sentinel_tpu.cluster.token_service import TokenService
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.engine import TokenStatus
+
+
+def native_available() -> bool:
+    try:
+        from sentinel_tpu.native import lib as native_lib
+
+        return native_lib.available()
+    except Exception:
+        return False
+
+
+class NativeTokenServer:
+    def __init__(
+        self,
+        service: TokenService,
+        host: str = "127.0.0.1",
+        port: int = 18730,
+        max_batch: int = 16384,
+        n_dispatchers: int = 2,
+        idle_ttl_s: Optional[float] = 600.0,
+        arena_cap: int = 65536,
+    ):
+        from sentinel_tpu.native.lib import Frontdoor  # raises if unbuilt
+
+        self._Frontdoor = Frontdoor
+        self.service = service
+        self.host = host
+        self.port = port
+        self.max_batch = max_batch
+        self.n_dispatchers = max(1, int(n_dispatchers))
+        self.idle_ttl_s = idle_ttl_s
+        self.arena_cap = arena_cap
+        self._door = None
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        notify = getattr(service, "connected_count_changed", None)
+        self.connections = ConnectionManager(on_count_changed=notify)
+        self._addr_by_conn = {}  # (fd, gen) → address
+        self._addr_lock = threading.Lock()
+
+    def tuning_kwargs(self) -> dict:
+        return dict(
+            max_batch=self.max_batch,
+            n_dispatchers=self.n_dispatchers,
+            idle_ttl_s=self.idle_ttl_s,
+            arena_cap=self.arena_cap,
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._door is not None:
+            return
+        warmup = getattr(self.service, "warmup", None)
+        if warmup is not None:
+            warmup()
+        reopen = getattr(self.service, "reopen", None)
+        if reopen is not None:
+            reopen()
+        self._stop.clear()
+        self._door = self._Frontdoor(
+            self.host, self.port, arena_cap=self.arena_cap
+        )
+        self.port = self._door.port
+        if self.idle_ttl_s:
+            self._door.set_idle_ttl(int(self.idle_ttl_s * 1000))
+        for i in range(self.n_dispatchers):
+            t = threading.Thread(
+                target=self._dispatch_loop,
+                name=f"sentinel-native-dispatch-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(
+            target=self._control_loop, name="sentinel-native-control",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+        record_log.info(
+            "native token server listening on %s:%d (%d dispatchers)",
+            self.host, self.port, self.n_dispatchers,
+        )
+
+    def stop(self) -> None:
+        if self._door is None:
+            return
+        self._stop.set()
+        self._door.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+        self._door = None
+        close = getattr(self.service, "close", None)
+        if close is not None:
+            close()
+
+    # -- data plane ---------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        door = self._door
+        service = self.service
+        while not self._stop.is_set():
+            try:
+                # max_batch bounds one pull (clamped to >= one max frame);
+                # the remainder stays queued for the other dispatchers
+                got = door.wait_batch(timeout_ms=100, max_n=self.max_batch)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                raise
+            if got is None:
+                continue
+            ids, counts, prios, frames = got
+            try:
+                # pulls larger than the engine batch size are chunked inside
+                # request_batch_arrays — one pull may span device steps
+                status, remaining, wait = service.request_batch_arrays(
+                    ids, counts, prios
+                )
+            except Exception:
+                record_log.exception("device step failed; failing batch")
+                n = len(ids)
+                status = np.full(n, int(TokenStatus.FAIL), np.int8)
+                remaining = np.zeros(n, np.int32)
+                wait = np.zeros(n, np.int32)
+            try:
+                door.submit(frames, status, remaining, wait)
+            except Exception:
+                if not self._stop.is_set():
+                    record_log.exception("native submit failed")
+
+    # -- control plane ------------------------------------------------------
+    def _control_loop(self) -> None:
+        door = self._door
+        service = self.service
+        while not self._stop.is_set():
+            try:
+                item = door.next_control()
+            except Exception:
+                if self._stop.is_set():
+                    return
+                raise
+            if item is None:
+                self._stop.wait(0.002)
+                continue
+            kind, fd, gen, payload = item
+            if kind == door.CTRL_OPEN:
+                with self._addr_lock:
+                    self._addr_by_conn[(fd, gen)] = payload.decode("latin-1")
+                address = payload.decode("latin-1")
+                self.connections.attach_closer(
+                    address,
+                    lambda fd=fd, gen=gen: door.close_conn(fd, gen),
+                )
+                continue
+            if kind == door.CTRL_CLOSE:
+                with self._addr_lock:
+                    address = self._addr_by_conn.pop((fd, gen), None)
+                if address:
+                    self.connections.remove_address(address)
+                continue
+            # kind == CTRL_FRAME: a non-data-plane request
+            with self._addr_lock:
+                address = self._addr_by_conn.get((fd, gen), f"fd{fd}")
+            try:
+                req = P.decode_request(payload)
+            except Exception:
+                record_log.warning("bad control frame; closing %s", address)
+                door.close_conn(fd, gen)
+                continue
+            try:
+                rsp = self._handle_control(req, address)
+            except Exception:
+                record_log.exception("%s control request failed",
+                                     type(req).__name__)
+                rsp = P.FlowResponse(
+                    req.xid, getattr(req, "msg_type", P.MsgType.PING),
+                    int(TokenStatus.FAIL),
+                )
+            door.send(fd, gen, P.encode_response(rsp))
+
+    def _handle_control(self, req, address: str) -> P.FlowResponse:
+        service = self.service
+        if isinstance(req, P.Ping):
+            count = self.connections.add(req.namespace, address)
+            return P.FlowResponse(req.xid, P.MsgType.PING, 0, remaining=count)
+        self.connections.touch(address)
+        if req.msg_type == P.MsgType.PARAM_FLOW:
+            r = service.request_params_token(
+                req.flow_id, req.count, req.param_hashes
+            )
+            return P.FlowResponse(
+                req.xid, req.msg_type, int(r.status), r.remaining, r.wait_ms
+            )
+        if req.msg_type == P.MsgType.CONCURRENT_ACQUIRE:
+            r = service.request_concurrent_token(
+                req.flow_id, req.count, req.prioritized
+            )
+            return P.FlowResponse(
+                req.xid, req.msg_type, int(r.status), r.remaining, r.wait_ms,
+                r.token_id,
+            )
+        if req.msg_type == P.MsgType.CONCURRENT_RELEASE:
+            r = service.release_concurrent_token(req.flow_id)
+            return P.FlowResponse(req.xid, req.msg_type, int(r.status))
+        return P.FlowResponse(req.xid, req.msg_type, int(TokenStatus.FAIL))
+
+    def stats(self) -> dict:
+        return self._door.stats() if self._door is not None else {}

@@ -1,6 +1,6 @@
 """Build the native runtime: ``python -m sentinel_tpu.native.build``.
 
-Compiles ``native/src/sentinel_native.cpp`` into
+Compiles ``native/src/*.cpp`` into
 ``sentinel_tpu/native/_sentinel_native.so`` with the ambient C++ compiler.
 """
 
@@ -13,7 +13,10 @@ import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
-SOURCE = os.path.join(_REPO, "native", "src", "sentinel_native.cpp")
+SOURCES = [
+    os.path.join(_REPO, "native", "src", "sentinel_native.cpp"),
+    os.path.join(_REPO, "native", "src", "sentinel_frontdoor.cpp"),
+]
 OUTPUT = os.path.join(_HERE, "_sentinel_native.so")
 
 
@@ -32,7 +35,7 @@ def build(verbose: bool = True) -> str:
         "-pthread",
         "-o",
         OUTPUT,
-        SOURCE,
+        *SOURCES,
     ]
     if verbose:
         print("+", " ".join(cmd), file=sys.stderr)

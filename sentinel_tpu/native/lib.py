@@ -64,6 +64,42 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
             ],
             I32,
         ),
+        # native TCP front door (sentinel_frontdoor.cpp)
+        "sn_fd_create": ([ctypes.c_char_p, I32, I32], P),
+        "sn_fd_port": ([P], I32),
+        "sn_fd_stop": ([P], None),
+        "sn_fd_destroy": ([P], None),
+        "sn_fd_wait_batch": (
+            [
+                P, I32, ctypes.POINTER(I64), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8), I32, ctypes.POINTER(I32),
+                ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(I32), ctypes.POINTER(ctypes.c_uint8), I32,
+                ctypes.POINTER(I32),
+            ],
+            I32,
+        ),
+        "sn_fd_submit": (
+            [
+                P, I32, ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(I32),
+                ctypes.POINTER(I32),
+            ],
+            None,
+        ),
+        "sn_fd_send": ([P, I32, I32, ctypes.c_char_p, I32], None),
+        "sn_fd_next_control": (
+            [
+                P, ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8), I32, ctypes.POINTER(I32),
+            ],
+            I32,
+        ),
+        "sn_fd_stats": ([P, ctypes.POINTER(ctypes.c_uint64)], None),
+        "sn_fd_set_idle_ttl": ([P, I64], None),
+        "sn_fd_close_conn": ([P, I32, I32], None),
     }
     for name, (argtypes, restype) in sig.items():
         fn = getattr(lib, name)
@@ -290,3 +326,179 @@ class NativePacerArray:
                 self._h, slot, now, acquire, count_per_sec, max_queue_ms
             )
         )
+
+
+class Frontdoor:
+    """The native epoll TCP front door (``sentinel_frontdoor.cpp``).
+
+    One IO thread owns sockets, framing, decode, and response writes; Python
+    pulls whole request batches with :meth:`wait_batch` (GIL released while
+    blocked), runs the device step, and answers with :meth:`submit`.
+    Control-plane frames (PING, param, concurrent) surface through
+    :meth:`next_control`; replies go back via :meth:`send`.
+    """
+
+    CTRL_FRAME, CTRL_OPEN, CTRL_CLOSE = 0, 1, 2
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 arena_cap: int = 65536):
+        import numpy as np
+
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        self._lib = lib
+        # the arena must fit at least one max-size frame ((65535-7)//13
+        # rows) or a full frame could never be admitted and its connection
+        # would park forever
+        arena_cap = max(arena_cap, (65535 - 7) // 13)
+        # the C side binds with inet_addr (IPv4 literals only) — resolve
+        # names like "localhost" here so the API matches the asyncio server
+        if host:
+            import socket as _socket
+
+            host = _socket.gethostbyname(host)
+        self._h = lib.sn_fd_create(host.encode(), port, arena_cap)
+        if not self._h:
+            raise OSError(f"native front door failed to bind {host}:{port}")
+        self.port = int(lib.sn_fd_port(self._h))
+        self.arena_cap = arena_cap
+        # batch buffers are per-THREAD (threading.local): multiple
+        # dispatcher threads may call wait_batch concurrently, and each
+        # result stays valid until that same thread's next call
+        self._tls = threading.local()
+        self._ctrl_buf = ctypes.create_string_buffer(70000)
+        self._ctrl_lock = threading.Lock()
+        self._stopped = False
+
+    def _bufs(self):
+        import numpy as np
+
+        b = getattr(self._tls, "bufs", None)
+        if b is None:
+            cap = self.arena_cap
+            b = dict(
+                ids=np.empty(cap, np.int64),
+                counts=np.empty(cap, np.int32),
+                prios=np.empty(cap, np.uint8),
+                f_fd=np.empty(cap, np.int32),
+                f_gen=np.empty(cap, np.int32),
+                f_xid=np.empty(cap, np.int32),
+                f_n=np.empty(cap, np.int32),
+                f_type=np.empty(cap, np.uint8),
+            )
+            self._tls.bufs = b
+        return b
+
+    def _ptr(self, arr, ctype):
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    def wait_batch(self, timeout_ms: int = 100, max_n: Optional[int] = None):
+        """Block for data-plane requests. Returns ``None`` on timeout, else
+        ``(ids, counts, prios, frames)`` where the first three are int64/
+        int32/bool views in request order and ``frames`` is the opaque
+        per-frame metadata to hand back to :meth:`submit`. ``max_n`` bounds
+        one pull (whole frames only, so it is clamped to at least one
+        max-size frame); the remainder stays queued for the next pull."""
+        if max_n is None:
+            max_n = self.arena_cap
+        max_n = min(max(int(max_n), (65535 - 7) // 13), self.arena_cap)
+        b = self._bufs()
+        n_frames = ctypes.c_int32()
+        n = self._lib.sn_fd_wait_batch(
+            self._h, timeout_ms,
+            self._ptr(b["ids"], ctypes.c_int64),
+            self._ptr(b["counts"], ctypes.c_int32),
+            self._ptr(b["prios"], ctypes.c_uint8),
+            max_n,
+            self._ptr(b["f_fd"], ctypes.c_int32),
+            self._ptr(b["f_gen"], ctypes.c_int32),
+            self._ptr(b["f_xid"], ctypes.c_int32),
+            self._ptr(b["f_n"], ctypes.c_int32),
+            self._ptr(b["f_type"], ctypes.c_uint8),
+            self.arena_cap, ctypes.byref(n_frames),
+        )
+        if n <= 0:
+            return None
+        k = n_frames.value
+        frames = (
+            b["f_fd"][:k], b["f_gen"][:k], b["f_xid"][:k], b["f_n"][:k],
+            b["f_type"][:k],
+        )
+        return (
+            b["ids"][:n], b["counts"][:n],
+            b["prios"][:n].astype(bool), frames,
+        )
+
+    def submit(self, frames, status, remaining, wait_ms) -> None:
+        """Encode + send verdict frames for a ``wait_batch`` result."""
+        import numpy as np
+
+        f_fd, f_gen, f_xid, f_n, f_type = frames
+        status = np.ascontiguousarray(status, np.int8)
+        remaining = np.ascontiguousarray(remaining, np.int32)
+        wait_ms = np.ascontiguousarray(wait_ms, np.int32)
+        self._lib.sn_fd_submit(
+            self._h, len(f_fd),
+            self._ptr(np.ascontiguousarray(f_fd, np.int32), ctypes.c_int32),
+            self._ptr(np.ascontiguousarray(f_gen, np.int32), ctypes.c_int32),
+            self._ptr(np.ascontiguousarray(f_xid, np.int32), ctypes.c_int32),
+            self._ptr(np.ascontiguousarray(f_n, np.int32), ctypes.c_int32),
+            self._ptr(np.ascontiguousarray(f_type, np.uint8), ctypes.c_uint8),
+            self._ptr(status, ctypes.c_int8),
+            self._ptr(remaining, ctypes.c_int32),
+            self._ptr(wait_ms, ctypes.c_int32),
+        )
+
+    def send(self, fd: int, gen: int, frame: bytes) -> None:
+        self._lib.sn_fd_send(self._h, fd, gen, frame, len(frame))
+
+    def set_idle_ttl(self, ttl_ms: int) -> None:
+        """Enable the IO-thread idle sweep (0 disables)."""
+        self._lib.sn_fd_set_idle_ttl(self._h, int(ttl_ms))
+
+    def close_conn(self, fd: int, gen: int) -> None:
+        self._lib.sn_fd_close_conn(self._h, fd, gen)
+
+    def next_control(self):
+        """``None`` or ``(kind, fd, gen, payload bytes)``."""
+        fd = ctypes.c_int32()
+        gen = ctypes.c_int32()
+        ln = ctypes.c_int32()
+        with self._ctrl_lock:
+            kind = self._lib.sn_fd_next_control(
+                self._h, ctypes.byref(fd), ctypes.byref(gen),
+                ctypes.cast(self._ctrl_buf, ctypes.POINTER(ctypes.c_uint8)),
+                len(self._ctrl_buf), ctypes.byref(ln),
+            )
+            if kind < 0:
+                return None
+            payload = self._ctrl_buf.raw[: ln.value] if ln.value > 0 else b""
+        return kind, fd.value, gen.value, payload
+
+    def stats(self):
+        import numpy as np
+
+        out = np.zeros(4, np.uint64)
+        self._lib.sn_fd_stats(
+            self._h, self._ptr(out, ctypes.c_uint64)
+        )
+        return {
+            "frames_in": int(out[0]), "requests_in": int(out[1]),
+            "bytes_in": int(out[2]), "bytes_out": int(out[3]),
+        }
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._lib.sn_fd_stop(self._h)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self.stop()
+            except Exception:
+                pass
+            self._lib.sn_fd_destroy(h)
+            self._h = None

@@ -18,6 +18,7 @@ from typing import Optional
 import sentinel_tpu
 from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.config import SentinelConfig
+from sentinel_tpu.core.log import record_log
 from sentinel_tpu.datasource import converters as conv
 from sentinel_tpu.datasource.base import WritableDataSourceRegistry
 from sentinel_tpu.local.authority import AuthorityRuleManager
@@ -229,6 +230,50 @@ _EMBEDDED_SERVER = {"server": None}
 _EMBEDDED_LOCK = threading.Lock()
 
 
+def _server_class():
+    """Transport selection: ``csp.sentinel.cluster.server.native=true``
+    serves through the native epoll front door (C++ data plane) when the
+    native library is built; default is the asyncio transport."""
+    if SentinelConfig.get_bool("csp.sentinel.cluster.server.native"):
+        from sentinel_tpu.cluster.server_native import (
+            NativeTokenServer,
+            native_available,
+        )
+
+        if native_available():
+            return NativeTokenServer
+        record_log.warning(
+            "csp.sentinel.cluster.server.native requested but the native "
+            "library is not built; using the asyncio transport"
+        )
+    from sentinel_tpu.cluster.server import TokenServer
+
+    return TokenServer
+
+
+def _rebind_server_port(prev, new_port: int):
+    """Rebuild a running token server on ``new_port``, preserving its class
+    (asyncio or native front door), its service (rules + counters), and its
+    operator tuning; on failure roll back onto the old port so the fleet
+    keeps a token server. Caller holds ``_EMBEDDED_LOCK`` and has cleared
+    the registry slot. Returns the running replacement."""
+    server_cls = type(prev)
+    tuning = prev.tuning_kwargs()
+    service = prev.service
+    host = prev.host
+    old_port = prev.port
+    prev.stop()
+    try:
+        server = server_cls(service, host=host, port=new_port, **tuning)
+        server.start()
+        return server
+    except Exception:
+        rollback = server_cls(service, host=host, port=old_port, **tuning)
+        rollback.start()
+        _EMBEDDED_SERVER["server"] = rollback
+        raise
+
+
 def apply_cluster_mode(mode: int, token_port: int = 18730) -> None:
     """Switch this agent's cluster state. Mode 1 provisions the embedded
     token server (transport + device service) and registers it — the analog
@@ -245,40 +290,19 @@ def apply_cluster_mode(mode: int, token_port: int = 18730) -> None:
             if prev is not None and token_port not in (0, prev.port):
                 # port reconfiguration (e.g. a datasource edit): the running
                 # server must move, not silently keep the old port. The
-                # service (rules, counters) is preserved across the move.
-                from sentinel_tpu.cluster.server import TokenServer
-
+                # service (rules, counters), transport class, and tuning are
+                # preserved across the move; failure rolls back.
                 _EMBEDDED_SERVER["server"] = None
-                service = prev.service
-                old_port = prev.port
-                # carry the live server's tuning across the move — a rebuild
-                # with constructor defaults would silently drop operator
-                # settings like batch_window_ms/n_loops on a port change
-                tuning = prev.tuning_kwargs()
-                prev.stop()
-                try:
-                    server = TokenServer(
-                        service, host="0.0.0.0", port=token_port, **tuning
-                    )
-                    server.start()
-                except Exception:
-                    # roll back onto the old port (we just freed it) so the
-                    # fleet keeps a token server and rules/counters survive;
-                    # if even that fails, surface the original error
-                    rollback = TokenServer(
-                        service, host="0.0.0.0", port=old_port, **tuning
-                    )
-                    rollback.start()
-                    _EMBEDDED_SERVER["server"] = rollback
-                    raise
-                _EMBEDDED_SERVER["server"] = server
+                _EMBEDDED_SERVER["server"] = _rebind_server_port(
+                    prev, token_port
+                )
             elif prev is None:
-                from sentinel_tpu.cluster.server import TokenServer
                 from sentinel_tpu.cluster.token_service import (
                     DefaultTokenService,
                 )
 
-                server = TokenServer(
+                server_cls = _server_class()
+                server = server_cls(
                     DefaultTokenService(), host="0.0.0.0", port=token_port
                 )
                 try:
@@ -634,15 +658,10 @@ def cmd_cluster_server_modify_transport_config(params, body):
             return {"error": "this machine is not a token server"}
         if server.port == port:
             return "success"
-        from sentinel_tpu.cluster.server import TokenServer
-
-        server.stop()
-        replacement = TokenServer(
-            server.service, host=server.host, port=port,
-            **server.tuning_kwargs(),
-        )
-        replacement.start()  # kernels already warm; this is just a rebind
-        _EMBEDDED_SERVER["server"] = replacement
+        _EMBEDDED_SERVER["server"] = None
+        # class-, service-, and tuning-preserving rebind with rollback —
+        # kernels stay warm either way
+        _EMBEDDED_SERVER["server"] = _rebind_server_port(server, port)
     return "success"
 
 

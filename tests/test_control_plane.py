@@ -468,6 +468,37 @@ class TestDatasourceClusterAssignment:
         finally:
             blocker.close()
 
+    def test_native_transport_selected_by_config(self):
+        # csp.sentinel.cluster.server.native=true promotes through the
+        # native epoll front door, and a port move preserves the class
+        from sentinel_tpu.cluster.server_native import (
+            NativeTokenServer,
+            native_available,
+        )
+        from sentinel_tpu.core.config import SentinelConfig
+        from sentinel_tpu.transport import handlers as H
+
+        if not native_available():
+            pytest.skip("native library not built")
+        SentinelConfig.set("csp.sentinel.cluster.server.native", "true")
+        try:
+            H.apply_cluster_mode(1, 0)
+            server = H._EMBEDDED_SERVER["server"]
+            assert isinstance(server, NativeTokenServer)
+            import socket as s
+
+            sock = s.socket()
+            sock.bind(("0.0.0.0", 0))
+            new_port = sock.getsockname()[1]
+            sock.close()
+            H.apply_cluster_mode(1, new_port)
+            moved = H._EMBEDDED_SERVER["server"]
+            assert isinstance(moved, NativeTokenServer)
+            assert moved.port == new_port
+        finally:
+            H.apply_cluster_mode(-1)
+            SentinelConfig.reset_for_tests()
+
     def test_port_move_preserves_server_tuning(self):
         # a datasource-driven port change rebuilds the TokenServer; operator
         # tuning (batch window, loop count, …) must survive the move instead

@@ -1,0 +1,205 @@
+"""Native epoll front door (``native/src/sentinel_frontdoor.cpp`` +
+``cluster/server_native.py``): protocol behavior through real sockets.
+
+Mirrors the asyncio-transport tests (SURVEY §4: service tests with the
+transport assumed, plus a socket smoke layer) — same TokenClient drives
+both servers, so protocol parity between the two front doors is the test.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.server_native import (
+    NativeTokenServer,
+    native_available,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library not built"
+)
+
+G = ThresholdMode.GLOBAL
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=256)
+
+
+@pytest.fixture()
+def native_server():
+    svc = DefaultTokenService(CFG)
+    svc.load_rules([
+        ClusterFlowRule(flow_id=1, count=5.0, mode=G),
+        ClusterFlowRule(flow_id=2, count=1e9, mode=G),
+    ])
+    server = NativeTokenServer(svc, port=0, idle_ttl_s=None)
+    server.start()
+    yield server, svc
+    server.stop()
+
+
+class TestNativeFrontdoor:
+    def test_ping_batch_single_roundtrip(self, native_server):
+        server, svc = native_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=3000)
+        try:
+            assert client.ping()
+            assert server.connections.connected_count("default") == 1
+            out = client.request_batch_arrays(np.full(20, 1, np.int64))
+            assert out is not None
+            assert int((out[0] == int(TokenStatus.OK)).sum()) == 5
+            assert int((out[0] == int(TokenStatus.BLOCKED)).sum()) == 15
+            assert all(client.request_token(2).ok for _ in range(5))
+            assert (
+                client.request_token(999).status
+                == TokenStatus.NO_RULE_EXISTS
+            )
+        finally:
+            client.close()
+
+    def test_multi_frame_pipelined_batch(self, native_server):
+        # a batch larger than one frame pipelines chunk frames; verdict
+        # order must match request order across the chunks
+        server, svc = native_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+        try:
+            n = 12_000  # > MAX_BATCH_PER_FRAME (5040)
+            ids = np.full(n, 2, np.int64)
+            out = client.request_batch_arrays(ids)
+            assert out is not None
+            assert int((out[0] == int(TokenStatus.OK)).sum()) == n
+        finally:
+            client.close()
+
+    def test_concurrent_clients_share_budget(self, native_server):
+        server, svc = native_server
+        results = []
+        lock = threading.Lock()
+
+        def worker():
+            client = TokenClient("127.0.0.1", server.port, timeout_ms=3000)
+            try:
+                mine = [client.request_token(1) for _ in range(4)]
+                with lock:
+                    results.extend(mine)
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(r.ok for r in results) == 5
+        assert len(results) == 16
+
+    def test_malformed_frame_closes_connection(self, native_server):
+        server, svc = native_server
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=3)
+        try:
+            sock.sendall(b"\x00\x01\xff")  # runt frame (len 1 < header)
+            sock.settimeout(3)
+            assert sock.recv(64) == b""  # server closed on us
+        finally:
+            sock.close()
+
+    def test_close_event_deflates_connected_count(self, native_server):
+        server, svc = native_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=3000)
+        assert client.ping()
+        assert server.connections.connected_count("default") == 1
+        client.close()
+        deadline = time.time() + 3
+        while time.time() < deadline:
+            if server.connections.connected_count("default") == 0:
+                break
+            time.sleep(0.02)
+        assert server.connections.connected_count("default") == 0
+
+    def test_concurrent_mode_over_native_control_path(self, native_server):
+        from sentinel_tpu.cluster.concurrent import ConcurrentFlowRule
+
+        server, svc = native_server
+        svc.load_concurrent_rules(
+            [ConcurrentFlowRule(flow_id=9, concurrency_level=2)]
+        )
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=3000)
+        try:
+            a = client.request_concurrent_token(9)
+            b = client.request_concurrent_token(9)
+            c = client.request_concurrent_token(9)
+            assert a.ok and b.ok and not c.ok
+            r = client.release_concurrent_token(a.token_id)
+            assert r.status == TokenStatus.RELEASE_OK
+            assert client.request_concurrent_token(9).ok
+        finally:
+            client.close()
+
+    def test_tuning_kwargs_roundtrip(self, native_server):
+        server, svc = native_server
+        kw = server.tuning_kwargs()
+        assert kw["max_batch"] == server.max_batch
+        assert kw["n_dispatchers"] == server.n_dispatchers
+
+    def test_arena_backpressure_small_cap(self):
+        # an arena smaller than the offered load parks connections and
+        # resumes them after each swap — nothing is lost or reordered.
+        # arena_cap=1 clamps to one max frame (5040 rows), so concurrent
+        # 5000-row frames from several clients force parking.
+        svc = DefaultTokenService(CFG)
+        # raise the namespace self-protection guard: this test pushes 45k
+        # requests through one namespace in well under a second
+        svc.load_rules([ClusterFlowRule(flow_id=2, count=1e9, mode=G)],
+                       ns_max_qps=1e12)
+        server = NativeTokenServer(svc, port=0, idle_ttl_s=None,
+                                   arena_cap=1)
+        server.start()
+        errors = []
+
+        def worker():
+            client = TokenClient("127.0.0.1", server.port, timeout_ms=8000)
+            try:
+                for _ in range(3):
+                    out = client.request_batch_arrays(
+                        np.full(5000, 2, np.int64)
+                    )
+                    if out is None:
+                        errors.append("timeout")
+                    elif int((out[0] == int(TokenStatus.OK)).sum()) != 5000:
+                        errors.append("bad verdicts")
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert errors == []
+        finally:
+            server.stop()
+
+    def test_native_idle_sweep_closes_quiet_connection(self):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=2, count=1e9, mode=G)])
+        server = NativeTokenServer(svc, port=0, idle_ttl_s=0.3)
+        server.start()
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=3000)
+        try:
+            assert client.ping()
+            assert server.connections.connected_count("default") == 1
+            deadline = time.time() + 5  # sweep ticks at 1s
+            while time.time() < deadline:
+                if server.connections.connected_count("default") == 0:
+                    break
+                time.sleep(0.1)
+            assert server.connections.connected_count("default") == 0
+        finally:
+            client.close()
+            server.stop()

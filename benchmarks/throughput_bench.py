@@ -95,8 +95,6 @@ def run(n_clients: int = 8, batch: int = 1024, pipeline: int = 3,
                   file=__import__("sys").stderr)
             native = False
     if native:
-        from sentinel_tpu.cluster.server_native import NativeTokenServer
-
         server = NativeTokenServer(service, host="127.0.0.1", port=port,
                                    max_batch=max_batch)
     else:
@@ -131,28 +129,19 @@ def run(n_clients: int = 8, batch: int = 1024, pipeline: int = 3,
     # sustains on this machine. served/ceiling is the front-door efficiency
     # — the VERDICT r3 metric ("served >= 1/3 of ceiling"); on a 1-core
     # host the clients share the core, so the ratio is conservative.
+    # Reuses the already-warm service (server.stop() only parks the expiry
+    # sweeper; the compiled steps and rule table stay live).
     import numpy as np
 
-    service2 = DefaultTokenService(config)
-    service2.load_rules(
-        [
-            ClusterFlowRule(flow_id=i, count=1e9, mode=ThresholdMode.GLOBAL,
-                            namespace=f"ns{i % 8}")
-            for i in range(n_flows)
-        ],
-        ns_max_qps=1e12,
-    )
-    service2.warmup()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, n_flows, size=max_batch).astype(np.int64)
     for _ in range(3):
-        service2.request_batch_arrays(ids)
+        service.request_batch_arrays(ids)
     t0 = time.perf_counter()
     reps = 20
     for _ in range(reps):
-        service2.request_batch_arrays(ids)
+        service.request_batch_arrays(ids)
     ceiling = max_batch * reps / (time.perf_counter() - t0)
-    service2.close()
 
     return {
         "metric": "e2e_token_server_throughput",

@@ -62,6 +62,12 @@ constexpr uint8_t kTypeFlow = 1;
 constexpr uint8_t kTypeBatchFlow = 5;
 constexpr size_t kMaxFrame = 65535;
 constexpr size_t kReadChunk = 1 << 16;
+// control-plane queue bound: beyond this the sender's conn parks (same
+// backpressure idiom as the data-plane arena) until Python drains to half
+constexpr size_t kMaxControls = 8192;
+
+struct Frontdoor;
+void wake(Frontdoor *s);
 
 inline uint16_t be16(const uint8_t *p) {
   return uint16_t(p[0]) << 8 | uint16_t(p[1]);
@@ -138,6 +144,12 @@ struct Frontdoor {
   bool arena_was_full = false;
 
   std::deque<Control> controls;  // guarded by mu
+  bool controls_was_full = false;  // guarded by mu
+
+  // listener parking after accept failure (EMFILE etc): level-triggered
+  // epoll would otherwise spin the IO thread at 100% until an fd frees
+  bool listener_parked = false;   // IO thread only
+  int64_t listener_parked_ms = 0;  // IO thread only
 
   // outbound handoff: Python-side submit() parks encoded frames here; the
   // IO thread moves them onto the conn write queues (guarded by mu)
@@ -190,6 +202,7 @@ void close_conn(Frontdoor *s, Conn &c) {
 // should be closed (protocol error).
 bool parse_frames(Frontdoor *s, Conn &c) {
   bool notify = false;
+  bool wake_self = false;
   {
     std::lock_guard<std::mutex> lk(s->mu);
     for (;;) {
@@ -214,6 +227,24 @@ bool parse_frames(Frontdoor *s, Conn &c) {
           n = 1;
           rows = payload + kHead;
         }
+        int32_t xid = be32(payload);
+        if (n == 0) {
+          // empty BATCH_FLOW: answer inline with an empty verdict frame —
+          // wait_batch only wakes for n_requests > 0, so queuing a
+          // zero-row FrameMeta would strand it (and its sender) forever
+          std::string rsp(size_t(2 + kHead + 2), '\0');
+          uint8_t *q = reinterpret_cast<uint8_t *>(&rsp[0]);
+          put16(q, uint16_t(kHead + 2));
+          put32(q + 2, uint32_t(xid));
+          q[6] = kTypeBatchFlow;
+          put16(q + 7, 0);
+          s->outbox.emplace_back(std::make_pair(c.fd, uint32_t(c.gen)),
+                                 std::move(rsp));
+          s->frames_in.fetch_add(1, std::memory_order_relaxed);
+          c.rpos += 2 + flen;
+          wake_self = true;
+          continue;
+        }
         if (s->n_requests + size_t(n) > s->cap) {
           // arena full: park this conn; bytes stay buffered
           c.paused = true;
@@ -221,7 +252,6 @@ bool parse_frames(Frontdoor *s, Conn &c) {
           epoll_mod(s, c);
           break;
         }
-        int32_t xid = be32(payload);
         size_t base = s->n_requests;
         for (int32_t i = 0; i < n; ++i, rows += kReqRow) {
           s->flow_ids[base + i] = be64(rows);
@@ -234,7 +264,16 @@ bool parse_frames(Frontdoor *s, Conn &c) {
         s->requests_in.fetch_add(uint64_t(n), std::memory_order_relaxed);
         notify = true;
       } else {
-        // control plane: hand the raw payload to Python
+        // control plane: hand the raw payload to Python. Bounded: a peer
+        // streaming control frames faster than the Python control thread
+        // drains parks (like the data-plane arena) instead of growing the
+        // deque without bound.
+        if (s->controls.size() >= kMaxControls) {
+          c.paused = true;
+          s->controls_was_full = true;
+          epoll_mod(s, c);
+          break;
+        }
         s->controls.push_back(
             {0, c.fd, c.gen,
              std::string(reinterpret_cast<const char *>(payload), flen)});
@@ -251,6 +290,9 @@ bool parse_frames(Frontdoor *s, Conn &c) {
     c.rpos = 0;
   }
   if (notify) s->cv.notify_all();
+  // schedule an outbox drain for inline responses (parse runs on the IO
+  // thread; the eventfd write makes the next epoll_wait return at once)
+  if (wake_self) wake(s);
   return true;
 }
 
@@ -286,6 +328,10 @@ void flush_writes(Frontdoor *s, Conn &c) {
 
 void io_loop(Frontdoor *s) {
   epoll_event evs[256];
+  // per-loop recv scratch (IO thread only); heap, not stack — 64 KiB
+  // would dominate the thread's stack frame
+  std::vector<uint8_t> scratch_vec(kReadChunk);
+  uint8_t *scratch = scratch_vec.data();
   while (!s->stopping.load(std::memory_order_acquire)) {
     int n = epoll_wait(s->epoll_fd, evs, 256, 100);
     if (n < 0) {
@@ -301,7 +347,18 @@ void io_loop(Frontdoor *s) {
           socklen_t alen = sizeof(addr);
           int cfd = accept4(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
                             &alen, SOCK_NONBLOCK);
-          if (cfd < 0) break;
+          if (cfd < 0) {
+            if (errno == ECONNABORTED) continue;  // peer gone; try next
+            if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+              // fd exhaustion (EMFILE/ENFILE) or kernel pressure: the
+              // pending backlog keeps the level-triggered listen fd
+              // readable, so park it for ~1s instead of spinning
+              epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, s->listen_fd, nullptr);
+              s->listener_parked = true;
+              s->listener_parked_ms = mono_ms();
+            }
+            break;
+          }
           int one = 1;
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
           Conn &c = s->conns[cfd];
@@ -348,11 +405,12 @@ void io_loop(Frontdoor *s) {
       if (evs[i].events & EPOLLIN) {
         bool closed = false;
         for (;;) {
-          size_t old = c.rbuf.size();
-          c.rbuf.resize(old + kReadChunk);
-          ssize_t r = ::recv(fd, c.rbuf.data() + old, kReadChunk, 0);
+          // recv into the shared scratch then append only what arrived:
+          // resizing rbuf by kReadChunk up front would value-initialize
+          // (memset) 64 KiB per recv on the serving hot path
+          ssize_t r = ::recv(fd, scratch, kReadChunk, 0);
           if (r > 0) {
-            c.rbuf.resize(old + size_t(r));
+            c.rbuf.insert(c.rbuf.end(), scratch, scratch + size_t(r));
             c.last_active_ms = mono_ms();
             s->bytes_in.fetch_add(uint64_t(r), std::memory_order_relaxed);
             if (!parse_frames(s, c)) {
@@ -362,10 +420,8 @@ void io_loop(Frontdoor *s) {
             }
             if (size_t(r) < kReadChunk || c.paused) break;
           } else if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-            c.rbuf.resize(old);
             break;
           } else {
-            c.rbuf.resize(old);
             closed = true;
             close_conn(s, c);
             break;
@@ -376,6 +432,15 @@ void io_loop(Frontdoor *s) {
           continue;
         }
       }
+    }
+    // re-arm a parked listener after ~1s (the epoll_wait timeout gives a
+    // natural tick even when no events fire)
+    if (s->listener_parked && mono_ms() - s->listener_parked_ms >= 1000) {
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = s->listen_fd;
+      epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+      s->listener_parked = false;
     }
     // idle sweep: close connections quiet past the ttl (the reference's
     // ScanIdleConnectionTask); checked at most once a second
@@ -405,8 +470,12 @@ void io_loop(Frontdoor *s) {
       {
         std::lock_guard<std::mutex> lk(s->mu);
         out.swap(s->outbox);
-        resume = s->arena_was_full && s->n_requests < s->cap;
-        if (resume) s->arena_was_full = false;
+        bool arena_ok = s->arena_was_full && s->n_requests < s->cap;
+        if (arena_ok) s->arena_was_full = false;
+        bool ctrl_ok =
+            s->controls_was_full && s->controls.size() < kMaxControls / 2;
+        if (ctrl_ok) s->controls_was_full = false;
+        resume = arena_ok || ctrl_ok;
       }
       for (auto &item : out) {
         auto it = s->conns.find(item.first.first);
@@ -420,15 +489,23 @@ void io_loop(Frontdoor *s) {
         }
         it->second.wq.push_back(std::move(item.second));
         flush_writes(s, it->second);
+        // flush_writes closes on send error; drop the map entry too or the
+        // rbuf/wq buffers linger until the kernel reuses this fd number
+        if (!it->second.open) s->conns.erase(it);
       }
       if (resume) {
-        for (auto &kv : s->conns) {
-          Conn &c = kv.second;
+        for (auto it = s->conns.begin(); it != s->conns.end();) {
+          Conn &c = it->second;
           if (c.paused && c.open) {
             c.paused = false;
             epoll_mod(s, c);
-            if (!parse_frames(s, c)) close_conn(s, c);
+            if (!parse_frames(s, c)) {
+              close_conn(s, c);
+              it = s->conns.erase(it);
+              continue;
+            }
           }
+          ++it;
         }
       }
     }
@@ -478,6 +555,16 @@ SN_EXPORT void *sn_fd_create(const char *host, int32_t port,
   s->port = ntohs(addr.sin_port);
   s->epoll_fd = epoll_create1(0);
   s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->epoll_fd < 0 || s->wake_fd < 0) {
+    // fd exhaustion: without this check the handle looks live but the IO
+    // loop's first epoll_wait would fail and exit silently — clients
+    // would connect into the kernel backlog and hang forever
+    if (s->epoll_fd >= 0) ::close(s->epoll_fd);
+    if (s->wake_fd >= 0) ::close(s->wake_fd);
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.fd = s->listen_fd;
@@ -498,13 +585,23 @@ SN_EXPORT void sn_fd_stop(void *h) {
   s->stopping.store(true, std::memory_order_release);
   wake(s);
   if (s->io.joinable()) s->io.join();
+  // listen/epoll fds are IO-thread-only, closable once it has joined (and
+  // closing the listener now releases the port for an immediate rebind).
+  // wake_fd stays open until destroy: dispatcher/control threads may still
+  // be inside submit()/send() whose wake() writes it — closing here could
+  // land those 8 bytes in a recycled fd. Post-stop writes to the live
+  // eventfd are harmless (nobody reads; the counter just accumulates).
   ::close(s->listen_fd);
   ::close(s->epoll_fd);
-  ::close(s->wake_fd);
+  s->listen_fd = s->epoll_fd = -1;
   s->cv.notify_all();
 }
 
-SN_EXPORT void sn_fd_destroy(void *h) { delete static_cast<Frontdoor *>(h); }
+SN_EXPORT void sn_fd_destroy(void *h) {
+  auto *s = static_cast<Frontdoor *>(h);
+  if (s->wake_fd >= 0) ::close(s->wake_fd);
+  delete s;
+}
 
 // Block until data-plane requests are queued (or timeout/stop). Copies up
 // to max_n requests + their frame list into the caller's arrays and resets
@@ -641,10 +738,18 @@ SN_EXPORT int32_t sn_fd_next_control(void *h, int32_t *fd_out,
                                      int32_t *gen_out, uint8_t *payload_out,
                                      int32_t max_len, int32_t *len_out) {
   auto *s = static_cast<Frontdoor *>(h);
-  std::lock_guard<std::mutex> lk(s->mu);
-  if (s->controls.empty()) return -1;
-  Control c = std::move(s->controls.front());
-  s->controls.pop_front();
+  bool unpark;
+  Control c;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (s->controls.empty()) return -1;
+    c = std::move(s->controls.front());
+    s->controls.pop_front();
+    unpark = s->controls_was_full && s->controls.size() < kMaxControls / 2;
+  }
+  // drained below half after a full queue: nudge the IO thread so conns
+  // parked by the control-plane cap resume reading
+  if (unpark) wake(s);
   *fd_out = c.fd;
   *gen_out = int32_t(c.gen);
   int32_t n = int32_t(c.payload.size());

@@ -127,6 +127,14 @@ class NativeTokenServer:
             t.join(timeout=5)
         self._threads = []
         self._door = None
+        # the door closed every socket without emitting CTRL_CLOSE (the
+        # control thread is already down), so deregister the clients here —
+        # a restart would otherwise inherit phantom connections that keep
+        # deflating AVG_LOCAL per-connection budgets
+        for key in list(self._addr_by_conn):
+            address = self._addr_by_conn.pop(key, None)
+            if address is not None:
+                self.connections.remove_address(address)
         close = getattr(self.service, "close", None)
         if close is not None:
             close()
@@ -181,9 +189,9 @@ class NativeTokenServer:
                 continue
             kind, fd, gen, payload = item
             if kind == door.CTRL_OPEN:
-                with self._addr_lock:
-                    self._addr_by_conn[(fd, gen)] = payload.decode("latin-1")
                 address = payload.decode("latin-1")
+                with self._addr_lock:
+                    self._addr_by_conn[(fd, gen)] = address
                 self.connections.attach_closer(
                     address,
                     lambda fd=fd, gen=gen: door.close_conn(fd, gen),

@@ -348,10 +348,13 @@ class Frontdoor:
         if lib is None:
             raise RuntimeError("native library not built")
         self._lib = lib
-        # the arena must fit at least one max-size frame ((65535-7)//13
-        # rows) or a full frame could never be admitted and its connection
-        # would park forever
-        arena_cap = max(arena_cap, (65535 - 7) // 13)
+        # the arena must fit at least one max-size frame or a full frame
+        # could never be admitted and its connection would park forever
+        # (MAX_BATCH_PER_FRAME is derived from the wire layout in
+        # protocol.py, the single source of truth the C++ codec mirrors)
+        from sentinel_tpu.cluster.protocol import MAX_BATCH_PER_FRAME
+
+        arena_cap = max(arena_cap, MAX_BATCH_PER_FRAME)
         # the C side binds with inet_addr (IPv4 literals only) — resolve
         # names like "localhost" here so the API matches the asyncio server
         if host:
@@ -402,7 +405,9 @@ class Frontdoor:
         max-size frame); the remainder stays queued for the next pull."""
         if max_n is None:
             max_n = self.arena_cap
-        max_n = min(max(int(max_n), (65535 - 7) // 13), self.arena_cap)
+        from sentinel_tpu.cluster.protocol import MAX_BATCH_PER_FRAME
+
+        max_n = min(max(int(max_n), MAX_BATCH_PER_FRAME), self.arena_cap)
         b = self._bufs()
         n_frames = ctypes.c_int32()
         n = self._lib.sn_fd_wait_batch(
@@ -434,17 +439,26 @@ class Frontdoor:
         """Encode + send verdict frames for a ``wait_batch`` result."""
         import numpy as np
 
+        # every array binds to a local: an unnamed ascontiguousarray copy
+        # would be freed the moment _ptr() returns, leaving sn_fd_submit
+        # reading freed memory whenever a caller passes a non-contiguous
+        # or wrongly-typed array
         f_fd, f_gen, f_xid, f_n, f_type = frames
+        f_fd = np.ascontiguousarray(f_fd, np.int32)
+        f_gen = np.ascontiguousarray(f_gen, np.int32)
+        f_xid = np.ascontiguousarray(f_xid, np.int32)
+        f_n = np.ascontiguousarray(f_n, np.int32)
+        f_type = np.ascontiguousarray(f_type, np.uint8)
         status = np.ascontiguousarray(status, np.int8)
         remaining = np.ascontiguousarray(remaining, np.int32)
         wait_ms = np.ascontiguousarray(wait_ms, np.int32)
         self._lib.sn_fd_submit(
             self._h, len(f_fd),
-            self._ptr(np.ascontiguousarray(f_fd, np.int32), ctypes.c_int32),
-            self._ptr(np.ascontiguousarray(f_gen, np.int32), ctypes.c_int32),
-            self._ptr(np.ascontiguousarray(f_xid, np.int32), ctypes.c_int32),
-            self._ptr(np.ascontiguousarray(f_n, np.int32), ctypes.c_int32),
-            self._ptr(np.ascontiguousarray(f_type, np.uint8), ctypes.c_uint8),
+            self._ptr(f_fd, ctypes.c_int32),
+            self._ptr(f_gen, ctypes.c_int32),
+            self._ptr(f_xid, ctypes.c_int32),
+            self._ptr(f_n, ctypes.c_int32),
+            self._ptr(f_type, ctypes.c_uint8),
             self._ptr(status, ctypes.c_int8),
             self._ptr(remaining, ctypes.c_int32),
             self._ptr(wait_ms, ctypes.c_int32),
@@ -473,7 +487,12 @@ class Frontdoor:
             )
             if kind < 0:
                 return None
-            payload = self._ctrl_buf.raw[: ln.value] if ln.value > 0 else b""
+            # string_at copies only the written bytes — .raw would build
+            # the full 70KB buffer as bytes for every 7-byte PING
+            payload = (
+                ctypes.string_at(self._ctrl_buf, ln.value)
+                if ln.value > 0 else b""
+            )
         return kind, fd.value, gen.value, payload
 
     def stats(self):

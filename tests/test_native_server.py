@@ -108,6 +108,32 @@ class TestNativeFrontdoor:
         finally:
             sock.close()
 
+    def test_empty_batch_frame_answered_not_stranded(self, native_server):
+        # n=0 BATCH_FLOW adds no requests, so wait_batch never wakes for
+        # it — the front door must answer inline instead of queueing a
+        # zero-row frame forever (and must keep the connection serviceable)
+        server, svc = native_server
+        import struct
+
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=3)
+        try:
+            payload = struct.pack(">IBH", 42, 5, 0)  # xid=42, BATCH_FLOW, n=0
+            sock.sendall(struct.pack(">H", len(payload)) + payload)
+            sock.settimeout(3)
+            rsp = sock.recv(64)
+            assert rsp == struct.pack(">H", 7) + struct.pack(">IBH", 42, 5, 0)
+            # connection still alive: a real request round-trips
+            row = struct.pack(">qiB", 2, 1, 0)
+            payload = struct.pack(">IBH", 43, 5, 1) + row
+            sock.sendall(struct.pack(">H", len(payload)) + payload)
+            rsp = sock.recv(64)
+            (ln,) = struct.unpack(">H", rsp[:2])
+            xid, typ, n = struct.unpack(">IBH", rsp[2:9])
+            assert (ln, xid, typ, n) == (16, 43, 5, 1)
+            assert rsp[9] == int(TokenStatus.OK)
+        finally:
+            sock.close()
+
     def test_close_event_deflates_connected_count(self, native_server):
         server, svc = native_server
         client = TokenClient("127.0.0.1", server.port, timeout_ms=3000)
@@ -184,6 +210,58 @@ class TestNativeFrontdoor:
             assert errors == []
         finally:
             server.stop()
+
+    def test_restart_clears_phantom_connections(self, native_server):
+        # stop() closes sockets natively (no CTRL_CLOSE events), so it must
+        # deregister clients itself — a restart inheriting phantom entries
+        # would deflate AVG_LOCAL per-connection budgets forever
+        server, svc = native_server
+        client = TokenClient("127.0.0.1", server.port, timeout_ms=3000)
+        try:
+            assert client.ping()
+            assert server.connections.connected_count("default") == 1
+        finally:
+            client.close()
+        server.stop()
+        assert server.connections.connected_count("default") == 0
+        server.start()  # fixture's stop() after yield is a no-op re-stop
+        assert server.connections.connected_count("default") == 0
+
+    def test_control_queue_backpressure_parks_and_resumes(self):
+        # a peer streaming control frames faster than the host drains must
+        # park (bounded queue), then resume once the host drains below half
+        # — every frame still arrives, none dropped. Uses the raw Frontdoor
+        # (no control thread) so the queue actually fills.
+        import struct
+
+        from sentinel_tpu.native.lib import Frontdoor
+
+        door = Frontdoor(port=0)
+        try:
+            sock = socket.create_connection(("127.0.0.1", door.port),
+                                            timeout=5)
+            sock.settimeout(5)
+            n_sent = 10_000  # > kMaxControls (8192)
+            frame = struct.pack(">H", 5) + struct.pack(">IB", 7, 2)
+            blob = frame * n_sent
+            sender = threading.Thread(
+                target=sock.sendall, args=(blob,), daemon=True
+            )
+            sender.start()
+            got = 0
+            deadline = time.monotonic() + 30
+            while got < n_sent and time.monotonic() < deadline:
+                ev = door.next_control()
+                if ev is None:
+                    time.sleep(0.001)
+                    continue
+                if ev[0] == 0:  # control frame (skip open/close events)
+                    got += 1
+            sender.join(timeout=5)
+            sock.close()
+            assert got == n_sent
+        finally:
+            door.stop()
 
     def test_native_idle_sweep_closes_quiet_connection(self):
         svc = DefaultTokenService(CFG)

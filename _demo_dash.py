@@ -1,6 +1,15 @@
-"""Launch a live guarded app + dashboard for browser verification."""
+"""Launch a live guarded app + dashboard for browser verification.
+
+Two machines register under app "svc": this process and a ``--worker``
+subprocess, each with its own command center + heartbeat + traffic loop.
+That makes the full console walkthrough drivable: resource tables, rule
+CRUD tabs, pass/block/exception + rt timelines, and the cluster screens —
+promote one machine to token server ("make token server"), then open
+"cluster" to see the server info/connections and the other machine's
+client assignment (the DemoClusterInitFunc-style wiring, live).
+"""
 import jax; jax.config.update("jax_platforms", "cpu")
-import sys, tempfile, threading, time
+import subprocess, sys, tempfile, threading, time
 
 import sentinel_tpu.metrics.log as mlog
 tmp = tempfile.mkdtemp()
@@ -12,15 +21,23 @@ from sentinel_tpu.local.flow import FlowRule, FlowRuleManager
 from sentinel_tpu.metrics.log import MetricTimer, MetricWriter
 from sentinel_tpu.transport.command import CommandCenter
 from sentinel_tpu.transport.heartbeat import HeartbeatSender
-from sentinel_tpu.dashboard.server import DashboardServer
+from sentinel_tpu.transport import handlers as _handlers  # register commands
 
-dash = DashboardServer(port=18081, fetch_interval_s=0.5).start()
+WORKER = "--worker" in sys.argv
+DASH_PORT = 18081
+
+if not WORKER:
+    from sentinel_tpu.dashboard.server import DashboardServer
+
+    dash = DashboardServer(port=DASH_PORT, fetch_interval_s=0.5).start()
+
 cc = CommandCenter(port=0).start()
 timer = MetricTimer(MetricWriter(base_dir=tmp), interval_s=0.5)
 timer.start()
 FlowRuleManager.load_rules([FlowRule(resource="GET:/checkout", count=30.0)])
-hb = HeartbeatSender(dashboard_addrs=["127.0.0.1:18081"], command_port=cc.port,
-                     interval_ms=500, client_ip="127.0.0.1")
+hb = HeartbeatSender(dashboard_addrs=[f"127.0.0.1:{DASH_PORT}"],
+                     command_port=cc.port, interval_ms=500,
+                     client_ip="127.0.0.1")
 hb.start()
 
 
@@ -36,5 +53,18 @@ def traffic():
 
 
 threading.Thread(target=traffic, daemon=True).start()
-print(f"READY dash=http://127.0.0.1:18081 cc={cc.port}", flush=True)
-time.sleep(600)
+
+if not WORKER:
+    worker = subprocess.Popen([sys.executable, __file__, "--worker"])
+    print(f"READY dash=http://127.0.0.1:{DASH_PORT} cc={cc.port} "
+          f"worker_pid={worker.pid}", flush=True)
+    try:
+        time.sleep(600)
+    finally:
+        # don't orphan the worker: a stale one would keep heartbeating a
+        # phantom machine into the next demo launch
+        worker.terminate()
+        worker.wait(timeout=10)
+else:
+    print(f"WORKER READY cc={cc.port}", flush=True)
+    time.sleep(600)

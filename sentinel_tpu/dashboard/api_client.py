@@ -82,15 +82,26 @@ class ApiClient:
         return nodes
 
     # -- rules (SentinelApiClient.fetchRules / setRulesAsync) ---------------
-    def fetch_rules(self, machine: MachineInfo, rule_type: str) -> Optional[list]:
-        text = self._get(machine, "getRules", {"type": rule_type})
+    def fetch_json(self, machine: MachineInfo, command: str,
+                   params: Optional[dict] = None):
+        """GET a command and parse its JSON body; None on transport/parse
+        failure. The cluster monitor screens ride this for
+        ``cluster/server/info``, ``cluster/server/metrics`` and
+        ``cluster/client/fetchConfig`` (the dashboard-side counterpart of
+        ``ClusterConfigService``'s state fetches)."""
+        text = self._get(machine, command, params or {})
         if text is None:
             return None
         try:
             return json.loads(text)
         except json.JSONDecodeError:
-            record_log.warning("bad rules payload from %s", machine.key)
+            record_log.warning(
+                "bad %s payload from %s", command, machine.key
+            )
             return None
+
+    def fetch_rules(self, machine: MachineInfo, rule_type: str) -> Optional[list]:
+        return self.fetch_json(machine, "getRules", {"type": rule_type})
 
     def get_cluster_mode(self, machine: MachineInfo) -> Optional[int]:
         raw = self._get(machine, "getClusterMode", {})

@@ -41,11 +41,13 @@ _INDEX_HTML = """<!doctype html>
 <style>
  :root{color-scheme:light;
   --surface-1:#fcfcfb; --text-primary:#0b0b0b; --text-secondary:#52514e;
-  --series-1:#2a78d6; --series-2:#eb6834; --grid:#e4e3df; --border:#ccc}
+  --series-1:#2a78d6; --series-2:#eb6834; --series-3:#7b5cd6;
+  --grid:#e4e3df; --border:#ccc}
  @media (prefers-color-scheme: dark){
   :root{color-scheme:dark;
    --surface-1:#1a1a19; --text-primary:#ffffff; --text-secondary:#c3c2b7;
-   --series-1:#3987e5; --series-2:#d95926; --grid:#33332f; --border:#444}}
+   --series-1:#3987e5; --series-2:#d95926; --series-3:#9b7ff0;
+   --grid:#33332f; --border:#444}}
  body{font-family:system-ui,sans-serif;margin:2rem;color:var(--text-primary);
   background:var(--surface-1)}
  h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
@@ -79,13 +81,22 @@ _INDEX_HTML = """<!doctype html>
  <div id="ruleview"></div>
  <span id="rulemsg" class="legend"></span>
 </div>
+<div id="clusterd" style="display:none">
+ <h2>cluster monitor: <span id="clusterapp"></span></h2>
+ <div id="clusterview"></div>
+</div>
 <div id="chartwrap" style="display:none">
- <h2>qps timeline: <span id="chartres"></span></h2>
+ <h2>timeline: <span id="chartres"></span></h2>
  <div class="legend"><span class="sw" style="background:var(--series-1)"></span>
   <b>pass qps</b><span class="sw" style="background:var(--series-2)"></span>
-  <b>block qps</b></div>
+  <b>block qps</b><span class="sw" style="background:var(--series-3)"></span>
+  <b>exception qps</b></div>
  <svg id="chart" width="720" height="220" role="img"
-  aria-label="pass and block qps over time"></svg>
+  aria-label="pass, block and exception qps over time"></svg>
+ <div class="legend"><span class="sw" style="background:var(--series-1)"></span>
+  <b>avg rt (ms)</b></div>
+ <svg id="rtchart" width="720" height="140" role="img"
+  aria-label="average response time over time"></svg>
  <div id="tip"></div>
 </div>
 <script>
@@ -234,7 +245,7 @@ async function assign(app, machine){
     {method:'POST', body: JSON.stringify({server: machine})});
   alert(JSON.stringify(await r.json())); refresh();
 }
-// ---- qps timeline (two series: pass, block — slots 1/2 of the palette) ----
+// ---- metric timelines: qps chart (pass/block/exception) + rt chart ----
 let chartData = null;
 async function openChart(app, resource){
   document.getElementById('chartwrap').style.display = '';
@@ -243,14 +254,23 @@ async function openChart(app, resource){
   const ms = await api(`metric?app=${encodeURIComponent(app)}` +
     `&identity=${encodeURIComponent(resource)}` +
     `&startTime=${now-300000}&endTime=${now}`);
-  chartData = ms.map(e => ({t: e.timestamp, pass: e.passQps, block: e.blockQps}));
+  chartData = ms.map(e => ({t: e.timestamp, pass: e.passQps,
+    block: e.blockQps, exc: e.exceptionQps, rt: e.rt}));
   drawChart();
 }
+const QPS_SERIES = [['pass','var(--series-1)'], ['block','var(--series-2)'],
+                    ['exc','var(--series-3)']];
+const RT_SERIES = [['rt','var(--series-1)']];
 function drawChart(){
-  const svg = document.getElementById('chart');
+  renderChart(document.getElementById('chart'), 220, QPS_SERIES,
+    d => 'pass ' + d.pass + '  block ' + d.block + '  exc ' + d.exc);
+  renderChart(document.getElementById('rtchart'), 140, RT_SERIES,
+    d => 'rt ' + d.rt + ' ms');
+}
+function renderChart(svg, H, series, fmt){
   svg.innerHTML = '';
   const NS = 'http://www.w3.org/2000/svg';
-  const W = 720, H = 220, L = 48, R = 10, T = 10, B = 24;
+  const W = 720, L = 48, R = 10, T = 10, B = 24;
   const data = chartData || [];
   if (!data.length){
     const t = document.createElementNS(NS, 'text');
@@ -261,7 +281,8 @@ function drawChart(){
     svg.appendChild(t); return;
   }
   const t0 = data[0].t, t1 = data[data.length-1].t || t0 + 1;
-  const ymax = Math.max(1, ...data.map(d => Math.max(d.pass, d.block)));
+  const ymax = Math.max(1,
+    ...data.map(d => Math.max(...series.map(([k]) => d[k] || 0))));
   const x = t => L + (W-L-R) * (t1 === t0 ? 0.5 : (t - t0)/(t1 - t0));
   const y = v => T + (H-T-B) * (1 - v/ymax);
   // recessive grid: 3 horizontal lines + y labels in secondary ink
@@ -277,11 +298,10 @@ function drawChart(){
     lab.setAttribute('fill', 'var(--text-secondary)');
     lab.textContent = Math.round(ymax*f); svg.appendChild(lab);
   }
-  for (const [key, color] of [['pass','var(--series-1)'],
-                              ['block','var(--series-2)']]){
+  for (const [key, color] of series){
     const pl = document.createElementNS(NS, 'polyline');
     pl.setAttribute('points',
-      data.map(d => `${x(d.t)},${y(d[key])}`).join(' '));
+      data.map(d => `${x(d.t)},${y(d[key] || 0)}`).join(' '));
     pl.setAttribute('fill', 'none');
     pl.setAttribute('stroke', color);
     pl.setAttribute('stroke-width', '2');
@@ -313,13 +333,71 @@ function drawChart(){
     tip.style.display = 'block';
     tip.style.left = (ev.pageX + 12) + 'px';
     tip.style.top = (ev.pageY - 10) + 'px';
-    tip.textContent = new Date(best.t).toLocaleTimeString() +
-      '  pass ' + best.pass + '  block ' + best.block;
+    tip.textContent = new Date(best.t).toLocaleTimeString() + '  ' + fmt(best);
   };
   hover.onmouseleave = () => {
     cross.style.display = 'none'; tip.style.display = 'none';
   };
   svg.appendChild(hover);
+}
+// ---- cluster monitor (cluster_app_server_monitor.js analog) ----
+async function openCluster(app){
+  document.getElementById('clusterd').style.display='';
+  document.getElementById('clusterapp').textContent = app;
+  const view = document.getElementById('clusterview');
+  view.innerHTML = '';
+  let mon;
+  try { mon = await api('cluster/monitor?app='+encodeURIComponent(app)); }
+  catch(e){ return; }
+  for (const s of mon.servers || []){
+    const h = document.createElement('h3');
+    h.textContent = 'token server ' + s.machine +
+      (s.info.port !== undefined ? ' (port ' + s.info.port + ')' : '');
+    view.appendChild(h);
+    const flow = s.info.flow || {};
+    const ct = document.createElement('table');
+    row(ct, ['namespaces', 'max allowed qps', 'interval ms', 'buckets',
+             'embedded'], 'th');
+    row(ct, [(s.info.namespaceSet || []).join(', '),
+             String(flow.maxAllowedQps ?? ''),
+             String(flow.intervalMs ?? ''),
+             String(flow.sampleCount ?? ''),
+             String(s.info.embedded ?? '')]);
+    view.appendChild(ct);
+    const conns = s.info.connection || [];
+    const gt = document.createElement('table');
+    row(gt, ['namespace', 'connected', 'clients'], 'th');
+    for (const g of conns)
+      row(gt, [g.namespace, String(g.connectedCount),
+               (g.clients || []).join(', ')]);
+    if (conns.length) view.appendChild(gt);
+    const entries = Object.entries(s.metrics || {});
+    if (entries.length){
+      const mt2 = document.createElement('table');
+      row(mt2, ['flow id', 'pass qps', 'block qps'], 'th');
+      for (const [fid, m] of entries)
+        row(mt2, [fid, String(m.pass_qps ?? m.passQps ?? ''),
+                  String(m.block_qps ?? m.blockQps ?? '')]);
+      view.appendChild(mt2);
+    }
+  }
+  for (const c of mon.clients || []){
+    const h = document.createElement('h3');
+    h.textContent = 'token client ' + c.machine;
+    view.appendChild(h);
+    const t = document.createElement('table');
+    row(t, ['server', 'timeout ms', 'namespace'], 'th');
+    row(t, [(c.config.serverHost ?? '') + ':' + (c.config.serverPort ?? ''),
+            String(c.config.requestTimeout ?? ''),
+            c.config.namespace ?? '']);
+    view.appendChild(t);
+  }
+  if (!(mon.servers || []).length && !(mon.clients || []).length){
+    const p = document.createElement('p');
+    p.textContent = 'no machines in cluster mode';
+    p.className = 'legend';
+    view.appendChild(p);
+  }
 }
 const MODES = {'-1':'off','0':'client','1':'server'};
 async function refresh(){
@@ -332,7 +410,11 @@ async function refresh(){
     const btn = document.createElement('button');
     btn.textContent = 'rules'; btn.style.marginLeft = '1rem';
     btn.onclick = () => openRules(app.name);
-    h.appendChild(btn); root.appendChild(h);
+    h.appendChild(btn);
+    const cbtn2 = document.createElement('button');
+    cbtn2.textContent = 'cluster'; cbtn2.style.marginLeft = '.3rem';
+    cbtn2.onclick = () => openCluster(app.name);
+    h.appendChild(cbtn2); root.appendChild(h);
     let modes = {};
     try {
       for (const s of await api('cluster/state?app='+encodeURIComponent(app.name)))
@@ -596,6 +678,30 @@ class DashboardServer:
                 }
                 for m in self.apps.healthy_machines(app)
             ]
+        if path == "cluster/monitor":
+            # cluster monitor screen data (cluster_app_server_monitor.js
+            # analog): for each server-mode machine the token-server info
+            # (port, namespaces, flow config, connection groups) and live
+            # per-flow metrics; for each client-mode machine its assignment
+            app = params.get("app", "")
+            out = {"servers": [], "clients": []}
+            for m in self.apps.healthy_machines(app):
+                mode = self.client.get_cluster_mode(m)
+                if mode == 1:
+                    out["servers"].append({
+                        "machine": m.key,
+                        "info": self.client.fetch_json(
+                            m, "cluster/server/info") or {},
+                        "metrics": self.client.fetch_json(
+                            m, "cluster/server/metrics") or {},
+                    })
+                elif mode == 0:
+                    out["clients"].append({
+                        "machine": m.key,
+                        "config": self.client.fetch_json(
+                            m, "cluster/client/fetchConfig") or {},
+                    })
+            return out
         if method == "POST" and path == "cluster/assign":
             # one-shot assignment (ClusterAssignServiceImpl analog): flip the
             # chosen machine to server mode, everything else to client mode

@@ -262,6 +262,12 @@ class TestClusterAssign:
             assert code == 200 and result["server"] is True
             state = _get(dash.port, "cluster/state?app=svc")
             assert state[0]["mode"] == 1  # the one machine became the server
+            # the monitor screen sees the promoted server's info
+            mon = _get(dash.port, "cluster/monitor?app=svc")
+            assert len(mon["servers"]) == 1 and mon["clients"] == []
+            info = mon["servers"][0]["info"]
+            assert info["embedded"] is True and info["port"] == 28731
+            assert "maxAllowedQps" in info["flow"]
             # mode 1 actually provisioned a listening token server
             from sentinel_tpu.cluster.client import TokenClient
             from sentinel_tpu.engine import TokenStatus
@@ -608,7 +614,9 @@ class TestRuleCrudViews:
             ) as r:
                 html = r.read().decode()
             for marker in ("SCHEMAS", "paramFlow", "gateway", "openChart",
-                           "qps timeline", "--series-1", "polyline"):
+                           "--series-1", "polyline", "rtchart",
+                           "openCluster", "cluster/monitor",
+                           "exception qps"):
                 assert marker in html, marker
         finally:
             dash.stop()

@@ -64,7 +64,11 @@ if not WORKER:
         # don't orphan the worker: a stale one would keep heartbeating a
         # phantom machine into the next demo launch
         worker.terminate()
-        worker.wait(timeout=10)
+        try:
+            worker.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            worker.kill()
+            worker.wait()
 else:
     print(f"WORKER READY cc={cc.port}", flush=True)
     time.sleep(600)

@@ -19,6 +19,8 @@ FAST_EXAMPLES = [
     "envoy_rls_scale_demo.py",
     "decorator_degrade_demo.py",
     "datasource_cluster_demo.py",
+    "gateway_demo.py",
+    "http_origin_demo.py",
 ]
 
 

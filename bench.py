@@ -437,7 +437,7 @@ def _measure(cfg: dict) -> None:
 
 def _run_attempt(name: str, cfg: dict, deadline_s: float):
     """Run one child, harvesting the LAST JSON line it printed; kill at the
-    deadline. Returns (doc|None, note|None)."""
+    deadline. Returns (doc|None, note|None, terminated: bool)."""
     env = dict(os.environ)
     env["JAX_COMPILATION_CACHE_DIR"] = CACHE_DIR
     proc = subprocess.Popen(
@@ -469,7 +469,15 @@ def _run_attempt(name: str, cfg: dict, deadline_s: float):
         proc.wait(timeout=deadline_s)
         timed_out = False
     except subprocess.TimeoutExpired:
-        proc.kill()
+        # SIGTERM first: give the jax client a chance to release the TPU
+        # tunnel cleanly — a SIGKILLed client can leave a lingering device
+        # reservation that blocks the NEXT attempt's backend init (observed
+        # as back-to-back "timeout with no JSON line" ladders)
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
         timed_out = True
     proc.wait()
     to.join(timeout=5)
@@ -481,17 +489,54 @@ def _run_attempt(name: str, cfg: dict, deadline_s: float):
                 f"killed at {deadline_s}s deadline after headline was "
                 "recorded; missing enrichment stages only"
             )
-        return doc, None
+        return doc, None, timed_out
     if timed_out:
-        return None, f"timeout after {deadline_s}s with no JSON line"
+        return None, f"timeout after {deadline_s}s with no JSON line", True
     tail = stderr_tail[-1] if stderr_tail else f"rc={proc.returncode}"
-    return None, tail[-300:]
+    return None, tail[-300:], False
+
+
+def _wait_device_free(max_wait_s: float = 240.0) -> None:
+    """Block until the TPU tunnel admits a fresh client (bounded). A killed
+    attempt's claim can linger in the pool's grant queue and each
+    additional KILLED client adds another dead grant ahead of the next
+    attempt — so probes that fail fast (rejection) retry after a pause,
+    but a probe that blocks gets ONE graceful termination, never a kill
+    loop."""
+    probe = "import jax, sys; jax.devices(); sys.stdout.write('ok')"
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        proc = subprocess.Popen(
+            [sys.executable, "-c", probe],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            out, _ = proc.communicate(timeout=remaining)
+            if "ok" in (out or ""):
+                return  # tunnel granted a claim (and the probe released it)
+            time.sleep(min(15.0, max(deadline - time.monotonic(), 0)))
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            return
 
 
 def main() -> None:
     errors = {}
+    prev_terminated = False
     for name, cfg, deadline_s in ATTEMPTS:
-        doc, err = _run_attempt(name, cfg, deadline_s)
+        if prev_terminated and cfg.get("platform") != "cpu":
+            # only a terminated predecessor can leave a lingering device
+            # claim; a fast failure never attached, so skip the probe cost
+            _wait_device_free()
+        doc, err, prev_terminated = _run_attempt(name, cfg, deadline_s)
         if doc is not None:
             doc.setdefault("extra", {})["bench_config"] = name
             if errors:

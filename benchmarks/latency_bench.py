@@ -20,6 +20,7 @@ import argparse
 import json
 import multiprocessing as mp
 import os
+import sys
 import time
 
 
@@ -50,7 +51,8 @@ def _client_worker(k: int, port: int, n_requests: int, n_flows: int,
 
 
 def run(n_clients: int = 8, n_requests: int = 2000, n_flows: int = 1024,
-        timeout_ms: int = 200, port: int = 0, n_loops: int = 2) -> dict:
+        timeout_ms: int = 200, port: int = 0, n_loops: int = 2,
+        native: bool = False) -> dict:
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
     from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
@@ -66,8 +68,22 @@ def run(n_clients: int = 8, n_requests: int = 2000, n_flows: int = 1024,
         ],
         ns_max_qps=1e12,
     )
+    if native:
+        from sentinel_tpu.cluster.server_native import (
+            NativeTokenServer,
+            native_available,
+        )
+
+        if not native_available():
+            print("native library not built; falling back to asyncio",
+                  file=sys.stderr)
+            native = False
     # port 0 = ephemeral; read the bound port back after start
-    server = TokenServer(service, host="127.0.0.1", port=port, n_loops=n_loops)
+    if native:
+        server = NativeTokenServer(service, host="127.0.0.1", port=port)
+    else:
+        server = TokenServer(service, host="127.0.0.1", port=port,
+                             n_loops=n_loops)
     server.start()
     port = server.port
 
@@ -114,7 +130,9 @@ def run(n_clients: int = 8, n_requests: int = 2000, n_flows: int = 1024,
             "requests": total,
             "error_or_timeout": int(errors),
             "target_p99_ms": 2.0,
-            "server_loops": n_loops,
+            "front_door": "native-epoll" if native else "asyncio",
+            # loop/dispatcher knob of whichever front door actually ran
+            "server_workers": (server.n_dispatchers if native else n_loops),
         },
     }
 
@@ -124,8 +142,10 @@ def main() -> None:
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=2000)
     ap.add_argument("--flows", type=int, default=1024)
+    ap.add_argument("--native", action="store_true",
+                    help="serve through the native epoll front door")
     args = ap.parse_args()
-    result = run(args.clients, args.requests, args.flows)
+    result = run(args.clients, args.requests, args.flows, native=args.native)
     line = json.dumps(result)
     print(line)
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")

@@ -22,6 +22,7 @@ import argparse
 import json
 import multiprocessing as mp
 import os
+import sys
 import time
 
 
@@ -92,7 +93,7 @@ def run(n_clients: int = 8, batch: int = 1024, pipeline: int = 3,
 
         if not native_available():
             print("native library not built; falling back to asyncio",
-                  file=__import__("sys").stderr)
+                  file=sys.stderr)
             native = False
     if native:
         server = NativeTokenServer(service, host="127.0.0.1", port=port,

@@ -196,6 +196,99 @@ class TestCommandCenter:
             cluster_api.reset_for_tests()
 
 
+class TestAsgiCommandCenter:
+    """ASGI-embedded command transport (netty-http/spring-mvc variant
+    analog): same handler registry, served by the app's own server."""
+
+    @staticmethod
+    def _call(app, path, method="GET", query="", body=b""):
+        import asyncio
+
+        sent = []
+
+        async def run():
+            scope = {"type": "http", "method": method, "path": path,
+                     "query_string": query.encode()}
+            chunks = [{"type": "http.request", "body": body}]
+
+            async def receive():
+                return chunks.pop(0)
+
+            async def send(msg):
+                sent.append(msg)
+
+            await app(scope, receive, send)
+
+        asyncio.run(run())
+        status = next(
+            m["status"] for m in sent if m["type"] == "http.response.start"
+        )
+        out = b"".join(
+            m.get("body", b"") for m in sent
+            if m["type"] == "http.response.body"
+        )
+        return status, out
+
+    def test_api_version_and_unknown(self):
+        from sentinel_tpu.transport.command_asgi import command_asgi_app
+
+        app = command_asgi_app()
+        status, body = self._call(app, "/api")
+        assert status == 200 and b"getRules" in body
+        status, body = self._call(app, "/version")
+        assert status == 200 and b"sentinel-tpu" in body
+        status, _ = self._call(app, "/definitely-not-a-command")
+        assert status == 404
+
+    def test_rule_crud_matches_thread_server(self):
+        from sentinel_tpu.transport.command_asgi import command_asgi_app
+
+        app = command_asgi_app()
+        rules = [{"resource": "asgi_res", "count": 7, "grade": 1}]
+        status, body = self._call(
+            app, "/setRules", method="POST", query="type=flow",
+            body=json.dumps(rules).encode(),
+        )
+        assert status == 200 and b"success" in body
+        status, body = self._call(app, "/getRules", query="type=flow")
+        assert status == 200
+        got = json.loads(body)
+        assert any(r["resource"] == "asgi_res" for r in got)
+
+    def test_body_size_cap(self):
+        from sentinel_tpu.transport.command_asgi import command_asgi_app
+
+        app = command_asgi_app(max_body_bytes=64)
+        status, _ = self._call(
+            app, "/setRules", method="POST", query="type=flow",
+            body=b"x" * 128,
+        )
+        assert status == 413
+
+    def test_lifespan_protocol(self):
+        import asyncio
+
+        from sentinel_tpu.transport.command_asgi import command_asgi_app
+
+        app = command_asgi_app()
+        sent = []
+
+        async def run():
+            msgs = [{"type": "lifespan.startup"}, {"type": "lifespan.shutdown"}]
+
+            async def receive():
+                return msgs.pop(0)
+
+            async def send(msg):
+                sent.append(msg["type"])
+
+            await app({"type": "lifespan"}, receive, send)
+
+        asyncio.run(run())
+        assert sent == ["lifespan.startup.complete",
+                        "lifespan.shutdown.complete"]
+
+
 class TestMetricLog:
     def test_writer_searcher_roundtrip(self, tmp_path):
         w = MetricWriter(base_dir=str(tmp_path), single_file_size=10_000)

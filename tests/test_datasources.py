@@ -125,6 +125,67 @@ class TestConsul:
 
 
 class TestEtcd:
+    def test_watch_stream_triggers_refresh(self, http_server):
+        # true watch (jetcd Watch analog): the /v3/watch chunked stream
+        # delivers an events message and the value updates WITHOUT waiting
+        # for a mod-revision poll (poll interval here is far beyond the
+        # wait_for window)
+        state = {"value": RULES_V1, "rev": 1}
+        changed = threading.Event()
+
+        def rng(h):
+            length = int(h.headers.get("Content-Length", 0))
+            h.rfile.read(length)
+            h.send_response(200)
+            h.end_headers()
+            body = {"kvs": [{
+                "value": base64.b64encode(state["value"].encode()).decode(),
+                "mod_revision": str(state["rev"]),
+            }]}
+            h.wfile.write(json.dumps(body).encode())
+
+        def watch(h):
+            length = int(h.headers.get("Content-Length", 0))
+            req = json.loads(h.rfile.read(length))
+            assert "create_request" in req
+            # real chunked transfer needs HTTP/1.1 on the status line —
+            # under the handler's default HTTP/1.0 the client ignores
+            # Transfer-Encoding and this test wouldn't exercise dechunking
+            h.protocol_version = "HTTP/1.1"
+            h.send_response(200)
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def chunk(obj):
+                data = json.dumps(obj).encode() + b"\n"
+                h.wfile.write(f"{len(data):x}\r\n".encode())
+                h.wfile.write(data + b"\r\n")
+                h.wfile.flush()
+
+            chunk({"result": {"created": True}})
+            if changed.wait(5):
+                chunk({"result": {"events": [{"type": "PUT"}]}})
+            # hold the stream open briefly so the client reads the event,
+            # then end the chunked body properly
+            changed.wait(0.2)
+            h.wfile.write(b"0\r\n\r\n")
+            h.close_connection = True
+
+        http_server.routes[("POST", "/v3/kv/range")] = rng
+        http_server.routes[("POST", "/v3/watch")] = watch
+        ds = EtcdDataSource(
+            flow_rules_from_json,
+            endpoint=f"http://127.0.0.1:{http_server.port}",
+            refresh_interval_s=30.0,  # poll can't be what picks this up
+        ).start()
+        try:
+            assert counts(ds) == [5]
+            state.update(value=RULES_V2, rev=2)
+            changed.set()
+            assert wait_for(lambda: counts(ds) == [9])
+        finally:
+            ds.close()
+
     def test_poll_on_mod_revision(self, http_server):
         state = {"value": RULES_V1, "rev": 1}
 
@@ -144,6 +205,7 @@ class TestEtcd:
             flow_rules_from_json,
             endpoint=f"http://127.0.0.1:{http_server.port}",
             refresh_interval_s=0.05,
+            watch=False,  # this test exercises the poll backstop alone
         ).start()
         try:
             assert counts(ds) == [5]

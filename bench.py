@@ -541,6 +541,13 @@ def main() -> None:
             doc.setdefault("extra", {})["bench_config"] = name
             if errors:
                 doc["extra"]["prior_failures"] = errors
+            if doc["extra"].get("backend") != "tpu":
+                # tunnel wedged this run: carry the latest committed TPU
+                # measurement inline (clearly labeled as prior evidence)
+                # so a CPU fallback never erases the TPU story
+                prior = _latest_tpu_result()
+                if prior is not None:
+                    doc["extra"]["last_tpu_result"] = prior
             doc["extra"]["served_rate"] = _served_rate()
             out = json.dumps(doc)
             print(out)
@@ -559,6 +566,39 @@ def main() -> None:
     )
     print(out)
     _record(out)
+
+
+def _latest_tpu_result():
+    """Newest committed bench result measured on a real TPU backend, or
+    None. Returned as {source, value, unit, extra-subset} for embedding."""
+    import glob
+
+    paths = sorted(
+        glob.glob(os.path.join(REPO, "benchmarks", "results", "bench-*.json")),
+        reverse=True,
+    )
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.loads(f.readline())
+        except (OSError, json.JSONDecodeError):
+            continue
+        extra = doc.get("extra", {})
+        if extra.get("backend") == "tpu":
+            return {
+                "source": os.path.basename(path),
+                "value": doc.get("value"),
+                "unit": doc.get("unit"),
+                "vs_baseline": doc.get("vs_baseline"),
+                "device": extra.get("device"),
+                "batch_size": extra.get("batch_size"),
+                "chain": extra.get("chain"),
+                "n_flows": extra.get("n_flows"),
+                "per_batch_device_ms_med": extra.get(
+                    "per_batch_device_ms_med"
+                ),
+            }
+    return None
 
 
 def _served_rate() -> dict:

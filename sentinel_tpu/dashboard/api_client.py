@@ -133,6 +133,11 @@ class ApiClient:
         )
         return self._post(machine, "cluster/client/modifyConfig", {}, body) is not None
 
+    def push_api_definitions(self, machine: MachineInfo, body: str) -> bool:
+        """Replace a machine's gateway custom-API groups (raw JSON array)."""
+        rsp = self._post(machine, "gateway/updateApiDefinitions", {}, body)
+        return rsp is not None and "success" in rsp
+
     def push_rules(self, machine: MachineInfo, rule_type: str, rules: list) -> bool:
         rsp = self._post(
             machine, "setRules", {"type": rule_type}, json.dumps(rules)

@@ -157,7 +157,11 @@ function openRules(app){
     b.onclick = () => { curType = t; editId = null; openRules(curApp); };
     tabs.appendChild(b);
   }
-  loadRules();
+  const ab = document.createElement('button');
+  ab.textContent = 'api groups'; ab.className = 'tab' + (curType==='apiGroups'?' on':'');
+  ab.onclick = () => { curType = 'apiGroups'; openRules(curApp); };
+  tabs.appendChild(ab);
+  if (curType === 'apiGroups') loadApiGroups(); else loadRules();
 }
 function coerce(text){
   if (text === '') return undefined;
@@ -239,6 +243,46 @@ function renderView(fill){
 }
 function msg(obj){
   document.getElementById('rulemsg').textContent = JSON.stringify(obj);
+}
+// gateway custom-API group editor (GatewayApiController analog): the
+// definitions are a small nested structure, edited as a JSON document
+async function loadApiGroups(){
+  const view = document.getElementById('ruleview');
+  view.innerHTML = '';
+  let defs = null;
+  try { defs = await api('v1/gateway/apis?app='+encodeURIComponent(curApp)); }
+  catch(e){}
+  if (!Array.isArray(defs)){
+    // a failed fetch must NOT render an empty editor — saving it would
+    // wipe every machine's live definitions (same guard as v1/rule's
+    // seed-before-push)
+    msg(defs || {error: 'fetching api groups failed'});
+    const p = document.createElement('p');
+    p.textContent = 'could not load live api groups; editor disabled';
+    p.className = 'dead';
+    view.appendChild(p);
+    return;
+  }
+  const ta = document.createElement('textarea');
+  ta.rows = 12; ta.cols = 80;
+  ta.value = JSON.stringify(defs, null, 2);
+  view.appendChild(ta);
+  const hint = document.createElement('div');
+  hint.className = 'legend';
+  hint.textContent = 'array of {apiName, predicateItems: [{pattern, ' +
+    'matchStrategy: 0 exact | 1 prefix | 2 regex}]}';
+  view.appendChild(hint);
+  const save = document.createElement('button');
+  save.textContent = 'save api groups';
+  save.onclick = async () => {
+    let parsed;
+    try { parsed = JSON.parse(ta.value); }
+    catch(e){ msg({error: 'invalid JSON: ' + e.message}); return; }
+    const r = await fetch('v1/gateway/apis?app='+encodeURIComponent(curApp),
+      {method:'POST', body: JSON.stringify(parsed)});
+    msg(await r.json());
+  };
+  view.appendChild(save);
 }
 async function assign(app, machine){
   const r = await fetch(`cluster/assign?app=${encodeURIComponent(app)}`,
@@ -655,6 +699,24 @@ class DashboardServer:
                 self.client.push_rules(m, rule_type, plain) for m in machines
             )
             return {"id": rule_id, "pushed": pushed, "machines": len(machines)}
+        if path == "v1/gateway/apis":
+            # gateway custom-API group management (GatewayApiController
+            # analog): GET lists the live definitions, POST replaces them on
+            # every healthy machine
+            app = params.get("app", "")
+            machines = self.apps.healthy_machines(app)
+            if not machines:
+                return {"error": f"no healthy machine for app {app}"}
+            if method == "POST":
+                pushed = sum(
+                    1 for m in machines
+                    if self.client.push_api_definitions(m, body)
+                )
+                return {"pushed": pushed, "machines": len(machines)}
+            result = self.client.fetch_json(
+                machines[0], "gateway/getApiDefinitions"
+            )
+            return result if result is not None else {"error": "fetch failed"}
         if method == "POST" and path == "machine/remove":
             # per-machine deregistration; ip+port name the machine
             removed = self.apps.remove_machine(

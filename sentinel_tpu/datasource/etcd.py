@@ -64,7 +64,14 @@ class EtcdDataSource(AutoRefreshDataSource):
         conn = self._watch_conn
         if conn is not None:
             try:
-                conn.close()  # unblocks the reader's readline
+                # shutdown (not just close) — closing an fd from another
+                # thread does not reliably wake a blocked recv on Linux,
+                # but SHUT_RDWR makes the reader's recv return 0 at once
+                if conn.sock is not None:
+                    import socket as _socket
+
+                    conn.sock.shutdown(_socket.SHUT_RDWR)
+                conn.close()
             except Exception:
                 pass
         super().close()

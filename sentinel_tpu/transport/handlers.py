@@ -125,6 +125,37 @@ def cmd_set_rules(params, body):
     return "success"
 
 
+@command_mapping("gateway/getApiDefinitions", "custom gateway API groups")
+def cmd_gateway_get_api_definitions(params, body):
+    """``GetGatewayApiDefinitionsCommandHandler`` analog."""
+    from sentinel_tpu.adapters.gateway_api import (
+        GatewayApiDefinitionManager,
+        api_definition_to_dict,
+    )
+
+    return [
+        api_definition_to_dict(d)
+        for d in GatewayApiDefinitionManager.get_api_definitions()
+    ]
+
+
+@command_mapping(
+    "gateway/updateApiDefinitions",
+    "replace gateway API groups; body/data=json array",
+)
+def cmd_gateway_update_api_definitions(params, body):
+    """``UpdateGatewayApiDefinitionGroupCommandHandler`` analog."""
+    from sentinel_tpu.adapters.gateway_api import (
+        GatewayApiDefinitionManager,
+        parse_api_definition,
+    )
+
+    data = body or params.get("data", "[]")
+    definitions = [parse_api_definition(obj) for obj in json.loads(data)]
+    GatewayApiDefinitionManager.load_api_definitions(definitions)
+    return "success"
+
+
 @command_mapping("metric", "metric log lines; startTime&endTime[&identity]")
 def cmd_metric(params, body):
     from sentinel_tpu.metrics.log import MetricSearcher, default_metric_dir

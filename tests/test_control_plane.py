@@ -130,6 +130,35 @@ class TestCommandCenter:
         rules = json.loads(body)
         assert rules[0]["resource"] == "cmd_res"
 
+    def test_gateway_api_definitions_roundtrip(self, command_center):
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager,
+        )
+
+        try:
+            defs = [{"apiName": "prod-api", "predicateItems": [
+                {"pattern": "/product/", "matchStrategy": 1}]}]
+            status, body = http_post(
+                command_center, "gateway/updateApiDefinitions",
+                json.dumps(defs),
+            )
+            assert body == "success"
+            status, body = http_get(
+                command_center, "gateway/getApiDefinitions"
+            )
+            got = json.loads(body)
+            assert got == defs
+            # the matcher actually picks the group up
+            from sentinel_tpu.adapters.gateway_api import (
+                GatewayApiMatcherManager,
+            )
+
+            assert GatewayApiMatcherManager.pick_matching_api_names(
+                "/product/7"
+            ) == ["prod-api"]
+        finally:
+            GatewayApiDefinitionManager.reset_for_tests()
+
     def test_set_rules_writes_through_datasource(self, command_center, tmp_path):
         from sentinel_tpu.datasource import converters as conv
 

@@ -452,6 +452,30 @@ class TestEndToEnd:
             cc.stop()
             dash.stop()
 
+    def test_gateway_api_groups_proxied(self):
+        from sentinel_tpu.adapters.gateway_api import (
+            GatewayApiDefinitionManager,
+        )
+        from sentinel_tpu.transport.command import CommandCenter
+
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            defs = [{"apiName": "orders-api", "predicateItems": [
+                {"pattern": "/orders", "matchStrategy": 0}]}]
+            code, out, _ = _post(dash.port, "v1/gateway/apis?app=svc", defs)
+            assert code == 200 and out["pushed"] == 1
+            fetched = _get(dash.port, "v1/gateway/apis?app=svc")
+            assert fetched == defs
+        finally:
+            GatewayApiDefinitionManager.reset_for_tests()
+            cc.stop()
+            dash.stop()
+
     def test_console_page_served(self):
         dash = DashboardServer(port=0).start()
         try:
@@ -616,7 +640,8 @@ class TestRuleCrudViews:
             for marker in ("SCHEMAS", "paramFlow", "gateway", "openChart",
                            "--series-1", "polyline", "rtchart",
                            "openCluster", "cluster/monitor",
-                           "exception qps"):
+                           "exception qps", "loadApiGroups",
+                           "v1/gateway/apis"):
                 assert marker in html, marker
         finally:
             dash.stop()

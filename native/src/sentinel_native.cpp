@@ -58,6 +58,10 @@ struct Window {
   std::atomic<int64_t> *starts;  // [n_buckets]
   SpinLock *reset_locks;         // [n_buckets]
   std::atomic<double> *counts;   // [n_buckets * n_channels]
+  // serializes the matured-borrow transfer when this window is a node's
+  // future array (see touch_transfer) — admission readers must never see
+  // tokens drained from here but not yet credited to the second window
+  SpinLock xfer_lock;
 
   Window(int32_t bms, int32_t nb, int32_t nc)
       : bucket_ms(bms), n_buckets(nb), n_channels(nc),
@@ -252,9 +256,9 @@ SN_EXPORT double sn_window_future_waiting(void *wp, int64_t now, int32_t chan) {
   return total;
 }
 
-// Drain the current bucket if its window has arrived (matured borrows).
-SN_EXPORT double sn_window_take_matured(void *wp, int64_t now, int32_t chan) {
-  Window *w = static_cast<Window *>(wp);
+namespace {
+// Drain logic shared by sn_window_take_matured and the composite stat ops.
+inline double drain_matured(Window *w, int64_t now, int32_t chan) {
   int64_t cur_start = w->start_of(now);
   int32_t idx = w->idx_of(cur_start);
   if (w->starts[idx].load(std::memory_order_acquire) != cur_start) return 0.0;
@@ -264,6 +268,88 @@ SN_EXPORT double sn_window_take_matured(void *wp, int64_t now, int32_t chan) {
          !cell.compare_exchange_weak(old, 0.0, std::memory_order_relaxed)) {
   }
   return old;
+}
+}  // namespace
+
+// Drain the current bucket if its window has arrived (matured borrows).
+SN_EXPORT double sn_window_take_matured(void *wp, int64_t now, int32_t chan) {
+  return drain_matured(static_cast<Window *>(wp), now, chan);
+}
+
+// ---------------------------------------------------------------------------
+// Composite StatisticNode writes — ONE ctypes round-trip per logical stat
+// write instead of one per window op (ctypes call overhead dominates the
+// local entry hot path otherwise). Channel layout is stat.py's:
+// PASS=0 BLOCK=1 EXCEPTION=2 SUCCESS=3 RT=4 OCCUPIED_PASS=5. No cross-window
+// lock: the reference's StatisticNode writes its second/minute LeapArrays
+// without one either, and each Window op is individually atomic.
+// ---------------------------------------------------------------------------
+
+namespace {
+// Matured borrowed tokens roll in as PASS (consuming capacity) and
+// OCCUPIED_PASS (observability) — OccupiableBucketLeapArray's transfer.
+// The future window's xfer_lock makes drain+credit atomic with respect to
+// every other composite op on the same node: without it a flow-check read
+// between the drain and the credit would see the tokens in NEITHER window
+// and over-admit (the Python slow path's node RLock gave the same guarantee).
+inline void touch_transfer(Window *s, Window *m, Window *f, int64_t now) {
+  f->xfer_lock.lock();
+  double matured = drain_matured(f, now, 0);
+  if (matured != 0.0) {
+    s->add(now, 0, matured);
+    s->add(now, 5, matured);
+    m->add(now, 0, matured);
+    m->add(now, 5, matured);
+  }
+  f->xfer_lock.unlock();
+}
+}  // namespace
+
+SN_EXPORT void sn_stat_pass(void *sec, void *minute, void *future, int64_t now,
+                            double n) {
+  Window *s = static_cast<Window *>(sec);
+  Window *m = static_cast<Window *>(minute);
+  touch_transfer(s, m, static_cast<Window *>(future), now);
+  s->add(now, 0, n);
+  m->add(now, 0, n);
+}
+
+SN_EXPORT void sn_stat_event(void *sec, void *minute, int64_t now,
+                             int32_t chan, double n) {
+  static_cast<Window *>(sec)->add(now, chan, n);
+  static_cast<Window *>(minute)->add(now, chan, n);
+}
+
+SN_EXPORT void sn_stat_rt_success(void *sec, void *minute, int64_t now,
+                                  double rt, double n) {
+  Window *s = static_cast<Window *>(sec);
+  Window *m = static_cast<Window *>(minute);
+  s->add(now, 3, n);
+  s->add(now, 4, rt);
+  m->add(now, 3, n);
+  m->add(now, 4, rt);
+}
+
+// Touch matured borrows, then return the second-window sum of one channel —
+// the flow-check read (StatisticNode.passQps) in one round trip. The sum
+// happens under the same xfer_lock so an in-flight transfer on another
+// thread can never be observed half-done.
+SN_EXPORT double sn_stat_touched_sum(void *sec, void *minute, void *future,
+                                     int64_t now, int32_t chan) {
+  Window *s = static_cast<Window *>(sec);
+  Window *m = static_cast<Window *>(minute);
+  Window *f = static_cast<Window *>(future);
+  f->xfer_lock.lock();
+  double matured = drain_matured(f, now, 0);
+  if (matured != 0.0) {
+    s->add(now, 0, matured);
+    s->add(now, 5, matured);
+    m->add(now, 0, matured);
+    m->add(now, 5, matured);
+  }
+  double total = s->sum(now, chan);
+  f->xfer_lock.unlock();
+  return total;
 }
 
 // --- token buckets ---------------------------------------------------------

@@ -180,6 +180,7 @@ function fieldValue(rule, f){
 }
 let lastRules = [];
 async function loadRules(){
+  if (curType === 'apiGroups') return loadApiGroups();
   const qs = `app=${encodeURIComponent(curApp)}&type=${encodeURIComponent(curType)}`;
   let rules = [];
   try { rules = await api('v1/rules?' + qs); } catch(e){}
@@ -192,6 +193,7 @@ async function loadRules(){
 // in-progress edit captured
 function renderView(fill){
   const fields = SCHEMAS[curType];
+  if (!fields) return;  // non-CRUD tab (apiGroups) owns #ruleview itself
   const qs = `app=${encodeURIComponent(curApp)}&type=${encodeURIComponent(curType)}`;
   const view = document.getElementById('ruleview');
   view.innerHTML = '';

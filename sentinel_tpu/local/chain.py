@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.registry import registry
 from sentinel_tpu.local.base import (
     BlockException,
@@ -180,13 +181,28 @@ class LogSlot(ProcessorSlot):
             raise
 
 
+_ext_module = None
+
+
+def _extension_hooks():
+    """Cached handle to ``metrics.extension`` — imported lazily because
+    ``metrics.__init__`` → ``exporter`` imports this module back, but cached
+    in a module global so the entry hot path pays a dict lookup, not an
+    import-machinery round trip per call."""
+    global _ext_module
+    if _ext_module is None:
+        from sentinel_tpu.metrics import extension as _ext_mod
+
+        _ext_module = _ext_mod
+    return _ext_module
+
+
 class StatisticSlot(ProcessorSlot):
     """The write path (``slots/statistic/StatisticSlot.java:52-153``):
     fire checks first; count pass/block/rt afterwards based on the outcome."""
 
     def entry(self, context, resource, node, count, prioritized, args):
-        from sentinel_tpu.metrics import extension as _ext
-
+        _ext = _extension_hooks()
         try:
             self.fire_entry(context, resource, node, count, prioritized, args)
         except PriorityWaitException:
@@ -205,53 +221,53 @@ class StatisticSlot(ProcessorSlot):
             _ext.on_thread_inc(resource.name, args)
         except BlockException as e:
             context.cur_entry.block_error = e
-            node.add_block(count)
+            now = _clock.now_ms()
+            node.add_block(count, now=now)
             if node.cluster_node is not None:
-                node.cluster_node.add_block(count)
+                node.cluster_node.add_block(count, now=now)
             if context.cur_entry.origin_node is not None:
-                context.cur_entry.origin_node.add_block(count)
+                context.cur_entry.origin_node.add_block(count, now=now)
             if resource.entry_type == EntryType.IN:
-                _entry_node().add_block(count)
+                _entry_node().add_block(count, now=now)
             _ext.on_block(resource.name, count, context.origin, e, args)
             raise
         else:
+            now = _clock.now_ms()
             node.increase_thread()
-            node.add_pass(count)
+            node.add_pass(count, now=now)
             if node.cluster_node is not None:
                 node.cluster_node.increase_thread()
-                node.cluster_node.add_pass(count)
+                node.cluster_node.add_pass(count, now=now)
             if context.cur_entry.origin_node is not None:
                 context.cur_entry.origin_node.increase_thread()
-                context.cur_entry.origin_node.add_pass(count)
+                context.cur_entry.origin_node.add_pass(count, now=now)
             if resource.entry_type == EntryType.IN:
                 en = _entry_node()
                 en.increase_thread()
-                en.add_pass(count)
+                en.add_pass(count, now=now)
             _ext.on_pass(resource.name, count, args)
             _ext.on_thread_inc(resource.name, args)
 
     def exit(self, context, resource, count, args):
         entry = context.cur_entry
         if entry is not None and entry.block_error is None:
-            from sentinel_tpu.core import clock as _clock
-
-            rt = _clock.now_ms() - entry.create_ms
+            now = _clock.now_ms()
+            rt = now - entry.create_ms
             node = entry.cur_node
             if node is not None:
-                node.add_rt_and_success(rt, count)
+                node.add_rt_and_success(rt, count, now=now)
                 node.decrease_thread()
                 if node.cluster_node is not None:
-                    node.cluster_node.add_rt_and_success(rt, count)
+                    node.cluster_node.add_rt_and_success(rt, count, now=now)
                     node.cluster_node.decrease_thread()
             if entry.origin_node is not None:
-                entry.origin_node.add_rt_and_success(rt, count)
+                entry.origin_node.add_rt_and_success(rt, count, now=now)
                 entry.origin_node.decrease_thread()
             if resource.entry_type == EntryType.IN:
                 en = _entry_node()
-                en.add_rt_and_success(rt, count)
+                en.add_rt_and_success(rt, count, now=now)
                 en.decrease_thread()
-            from sentinel_tpu.metrics import extension as _ext
-
+            _ext = _extension_hooks()
             _ext.on_complete(resource.name, count, rt, args)
             _ext.on_thread_dec(resource.name, args)
         self.fire_exit(context, resource, count, args)

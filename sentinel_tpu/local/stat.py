@@ -225,6 +225,24 @@ class StatisticNode:
         self.minute = make_window(1000, 60)
         self.future = make_future_window(self.sec.bucket_ms, sec_buckets)
         self.cur_thread_num = 0
+        # composite-write fast path: when every window is native, one ctypes
+        # call covers a whole logical write (touch+PASS, SUCCESS+RT, …) with
+        # no Python lock — each C op is atomic and the reference's
+        # StatisticNode holds no cross-window lock either. ctypes round
+        # trips otherwise dominate the entry hot path.
+        self._fast = None
+        if _NATIVE:
+            from sentinel_tpu.native import NativeWindow
+
+            if (
+                isinstance(self.sec, NativeWindow)
+                and isinstance(self.minute, NativeWindow)
+                and isinstance(self.future, _NativeFutureWindow)
+            ):
+                self._fast = (
+                    self.sec._lib, self.sec._h, self.minute._h,
+                    self.future._w._h,
+                )
 
     # -- write path ---------------------------------------------------------
     def increase_thread(self) -> None:
@@ -249,6 +267,10 @@ class StatisticNode:
 
     def add_pass(self, n: int = 1, now: Optional[int] = None) -> None:
         now = _clock.now_ms() if now is None else now
+        fast = self._fast
+        if fast is not None:
+            fast[0].sn_stat_pass(fast[1], fast[2], fast[3], now, float(n))
+            return
         with self._lock:
             self._touch(now)
             self.sec.add(now, PASS, n)
@@ -256,18 +278,32 @@ class StatisticNode:
 
     def add_block(self, n: int = 1, now: Optional[int] = None) -> None:
         now = _clock.now_ms() if now is None else now
+        fast = self._fast
+        if fast is not None:
+            fast[0].sn_stat_event(fast[1], fast[2], now, BLOCK, float(n))
+            return
         with self._lock:
             self.sec.add(now, BLOCK, n)
             self.minute.add(now, BLOCK, n)
 
     def add_exception(self, n: int = 1, now: Optional[int] = None) -> None:
         now = _clock.now_ms() if now is None else now
+        fast = self._fast
+        if fast is not None:
+            fast[0].sn_stat_event(fast[1], fast[2], now, EXCEPTION, float(n))
+            return
         with self._lock:
             self.sec.add(now, EXCEPTION, n)
             self.minute.add(now, EXCEPTION, n)
 
     def add_rt_and_success(self, rt_ms: float, n: int = 1, now: Optional[int] = None) -> None:
         now = _clock.now_ms() if now is None else now
+        fast = self._fast
+        if fast is not None:
+            fast[0].sn_stat_rt_success(
+                fast[1], fast[2], now, float(rt_ms), float(n)
+            )
+            return
         with self._lock:
             self.sec.add(now, SUCCESS, n)
             self.sec.add(now, RT, rt_ms)
@@ -286,6 +322,12 @@ class StatisticNode:
 
     def pass_qps(self, now: Optional[int] = None) -> float:
         now = self._now(now)
+        fast = self._fast
+        if fast is not None:
+            total = fast[0].sn_stat_touched_sum(
+                fast[1], fast[2], fast[3], now, PASS
+            )
+            return total * 1000.0 / self.sec.interval_ms
         with self._lock:
             self._touch(now)
             return self.sec.qps(now, PASS)

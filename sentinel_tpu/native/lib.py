@@ -40,6 +40,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         "sn_window_add_future": ([P, I64, I32, F64], None),
         "sn_window_future_waiting": ([P, I64, I32], F64),
         "sn_window_take_matured": ([P, I64, I32], F64),
+        "sn_stat_pass": ([P, P, P, I64, F64], None),
+        "sn_stat_event": ([P, P, I64, I32, F64], None),
+        "sn_stat_rt_success": ([P, P, I64, F64, F64], None),
+        "sn_stat_touched_sum": ([P, P, P, I64, I32], F64),
         "sn_tb_create": ([I32], P),
         "sn_tb_destroy": ([P], None),
         "sn_tb_reset": ([P, I32], None),

@@ -496,19 +496,22 @@ def _run_attempt(name: str, cfg: dict, deadline_s: float):
     return None, tail[-300:], False
 
 
-def _wait_device_free(max_wait_s: float = 240.0) -> None:
-    """Block until the TPU tunnel admits a fresh client (bounded). A killed
-    attempt's claim can linger in the pool's grant queue and each
-    additional KILLED client adds another dead grant ahead of the next
-    attempt — so probes that fail fast (rejection) retry after a pause,
-    but a probe that blocks gets ONE graceful termination, never a kill
-    loop."""
+def _wait_device_free(max_wait_s: float = 240.0) -> bool:
+    """Wait (bounded) for the TPU tunnel to admit a fresh client; returns
+    whether a probe actually claimed the device. A killed attempt's claim
+    can linger in the pool's grant queue and each additional KILLED client
+    adds another dead grant ahead of the next attempt — so probes that fail
+    fast (rejection) retry after a pause, but a probe that blocks gets ONE
+    graceful termination, never a kill loop. A False return means the
+    tunnel is wedged/sick (observed failure mode: a deterministic ~25-min
+    'TPU backend setup/compile error' per claim) and further TPU attempts
+    would only burn their deadlines the same way."""
     probe = "import jax, sys; jax.devices(); sys.stdout.write('ok')"
     deadline = time.monotonic() + max_wait_s
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            return
+            return False
         proc = subprocess.Popen(
             [sys.executable, "-c", probe],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
@@ -516,7 +519,7 @@ def _wait_device_free(max_wait_s: float = 240.0) -> None:
         try:
             out, _ = proc.communicate(timeout=remaining)
             if "ok" in (out or ""):
-                return  # tunnel granted a claim (and the probe released it)
+                return True  # tunnel granted a claim (probe released it)
             time.sleep(min(15.0, max(deadline - time.monotonic(), 0)))
         except subprocess.TimeoutExpired:
             proc.terminate()
@@ -525,17 +528,28 @@ def _wait_device_free(max_wait_s: float = 240.0) -> None:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
-            return
+            return False
 
 
 def main() -> None:
     errors = {}
     prev_terminated = False
+    tpu_dead = False
     for name, cfg, deadline_s in ATTEMPTS:
-        if prev_terminated and cfg.get("platform") != "cpu":
-            # only a terminated predecessor can leave a lingering device
-            # claim; a fast failure never attached, so skip the probe cost
-            _wait_device_free()
+        if cfg.get("platform") != "cpu":
+            if tpu_dead:
+                # the probe already proved the tunnel can't grant a claim;
+                # burning this attempt's deadline would end the same way
+                errors[name] = "skipped: device probe could not claim TPU"
+                continue
+            # probe budget = this attempt's own deadline: if a claim can't
+            # land inside it, the attempt itself couldn't have measured
+            # anything — so skipping on a False probe is provably safe even
+            # for a transiently draining grant queue
+            if prev_terminated and not _wait_device_free(deadline_s):
+                tpu_dead = True
+                errors[name] = "skipped: device probe could not claim TPU"
+                continue
         doc, err, prev_terminated = _run_attempt(name, cfg, deadline_s)
         if doc is not None:
             doc.setdefault("extra", {})["bench_config"] = name

@@ -1,0 +1,111 @@
+"""bench.py parent-ladder control flow (no TPU, no subprocesses).
+
+The ladder has cost two rounds their TPU artifact; its failure-handling
+rules are load-bearing enough to pin down:
+- a dead tunnel (probe fails after a terminated attempt) skips every
+  remaining TPU attempt instead of burning their deadlines,
+- a CPU fallback document carries the newest committed TPU measurement,
+- the final document always records why prior attempts failed.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(_REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "_record", lambda line: None)
+    monkeypatch.setattr(
+        mod, "_served_rate", lambda: {"verdicts_per_sec": 1}
+    )
+    return mod
+
+
+def _doc(backend):
+    return {
+        "metric": "m", "value": 42, "unit": "u", "vs_baseline": 1.0,
+        "extra": {"backend": backend},
+    }
+
+
+def test_dead_tunnel_skips_remaining_tpu_attempts(bench, monkeypatch, capsys):
+    calls = []
+
+    def fake_attempt(name, cfg, deadline_s):
+        calls.append(name)
+        if cfg.get("platform") != "cpu":
+            return None, "timeout after Ns with no JSON line", True
+        return _doc("cpu"), None, False
+
+    monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
+    monkeypatch.setattr(bench, "_wait_device_free", lambda budget_s: False)
+    monkeypatch.setattr(bench, "_latest_tpu_result", lambda: {"value": 5})
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # tpu-full ran, tpu-retry was skipped (probe said dead), cpu ran
+    assert calls == ["tpu-full", "cpu-fallback"]
+    assert "skipped" in out["extra"]["prior_failures"]["tpu-retry"]
+    assert out["extra"]["last_tpu_result"] == {"value": 5}
+
+
+def test_healthy_probe_allows_retry(bench, monkeypatch, capsys):
+    calls = []
+
+    def fake_attempt(name, cfg, deadline_s):
+        calls.append(name)
+        if name == "tpu-full":
+            return None, "timeout after Ns with no JSON line", True
+        return _doc("tpu"), None, False
+
+    monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
+    monkeypatch.setattr(bench, "_wait_device_free", lambda budget_s: True)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert calls == ["tpu-full", "tpu-retry"]
+    assert out["extra"]["backend"] == "tpu"
+    # a TPU-backed doc must NOT embed prior TPU evidence (it IS the evidence)
+    assert "last_tpu_result" not in out["extra"]
+
+
+def test_fast_failure_skips_probe(bench, monkeypatch, capsys):
+    probes = []
+
+    def fake_attempt(name, cfg, deadline_s):
+        if cfg.get("platform") != "cpu":
+            # failed fast, never attached to the device
+            return None, "rc=1", False
+        return _doc("cpu"), None, False
+
+    monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
+    monkeypatch.setattr(
+        bench, "_wait_device_free", lambda budget_s: probes.append(1) or True
+    )
+    monkeypatch.setattr(bench, "_latest_tpu_result", lambda: None)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert probes == []  # no termination happened, so no probe needed
+    assert out["extra"]["backend"] == "cpu"
+    assert "last_tpu_result" not in out["extra"]
+
+
+def test_all_attempts_failed_still_emits_json(bench, monkeypatch, capsys):
+    monkeypatch.setattr(
+        bench, "_run_attempt",
+        lambda name, cfg, d: (None, "boom", False),
+    )
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0
+    assert set(out["extra"]["attempts"]) == {
+        "tpu-full", "tpu-retry", "cpu-fallback"
+    }

@@ -204,6 +204,20 @@ class DefaultTokenService(TokenService):
         self.concurrency = ConcurrencyManager()
         self._expiry = None  # background sweep; started on first rule load
 
+    @staticmethod
+    def _prep_batch(cfg, slots, acq, pr):
+        """Build the device batch; returns ``(order, batch)`` where order is
+        None when slots arrived ascending-SORTED (stable argsort would be
+        the identity) — skipping an O(n log n) sort and three fancy-index
+        passes each way. Grouped-but-unsorted input still sorts.
+        Shared by the hot prep and the rare rules-reloaded re-prep so the
+        two can't diverge."""
+        sorted_already = bool((slots[:-1] <= slots[1:]).all())
+        if sorted_already:
+            return None, make_batch(cfg, slots, acq, pr)
+        order = np.argsort(slots, kind="stable")
+        return order, make_batch(cfg, slots[order], acq[order], pr[order])
+
     # -- mesh placement -----------------------------------------------------
     def _place_state(self, state):
         if self.mesh is None:
@@ -435,6 +449,20 @@ class DefaultTokenService(TokenService):
         pos = np.minimum(pos, keys.size - 1)
         return np.where(keys[pos] == flow_ids, slots[pos], -1).astype(np.int32)
 
+    @staticmethod
+    def _prep_batch(cfg, slots, acq, pr):
+        """Build the device batch; returns ``(order, batch)`` where order is
+        None when slots arrived ascending-SORTED (stable argsort would be
+        the identity) — skipping an O(n log n) sort and three fancy-index
+        passes each way. Grouped-but-unsorted input still sorts.
+        Shared by the hot prep and the rare rules-reloaded re-prep so the
+        two can't diverge."""
+        sorted_already = bool((slots[:-1] <= slots[1:]).all())
+        if sorted_already:
+            return None, make_batch(cfg, slots, acq, pr)
+        order = np.argsort(slots, kind="stable")
+        return order, make_batch(cfg, slots[order], acq[order], pr[order])
+
     def request_batch_arrays(
         self,
         flow_ids: np.ndarray,
@@ -483,12 +511,11 @@ class DefaultTokenService(TokenService):
         # detect the uniform-acquire common case — together they skip the
         # device argsort and the iterative admission refinement (see
         # decide()'s grouped/uniform flags)
-        order = np.argsort(slots, kind="stable")
         uniform = bool(acq.min() == acq.max())
         # smallest compiled shape bucket that fits this batch
         bucket = next(b for b in self._serve_buckets if n <= b)
         cfg = self.config._replace(batch_size=bucket)
-        batch = make_batch(cfg, slots[order], acq[order], pr[order])
+        order, batch = self._prep_batch(cfg, slots, acq, pr)
         step = self._step_fn(bucket, uniform)
         # -- device step: the only serialized section --
         with self._lock:
@@ -498,8 +525,7 @@ class DefaultTokenService(TokenService):
                 # live table (rare, and still under the lock — the same
                 # atomicity load_rules callers had before the narrowing)
                 slots = self._lookup_from(self._lookup, flow_ids)
-                order = np.argsort(slots, kind="stable")
-                batch = make_batch(cfg, slots[order], acq[order], pr[order])
+                order, batch = self._prep_batch(cfg, slots, acq, pr)
             now = self._engine_now()
             self._state, verdicts = step(
                 self._state, self._table, batch, np.int32(now)
@@ -508,12 +534,20 @@ class DefaultTokenService(TokenService):
         status_sorted = np.asarray(verdicts.status)[:n]
         remaining_sorted = np.asarray(verdicts.remaining)[:n]
         wait_sorted = np.asarray(verdicts.wait_ms)[:n]
-        status = np.empty(n, status_sorted.dtype)
-        remaining = np.empty(n, np.int32)
-        wait = np.empty(n, np.int32)
-        status[order] = status_sorted
-        remaining[order] = remaining_sorted
-        wait[order] = wait_sorted
+        if order is None:
+            # copy: callers own writable results (the sorted path builds
+            # fresh arrays), and a [:n] view would pin the whole padded
+            # bucket buffer alive
+            status = np.array(status_sorted)
+            remaining = np.array(remaining_sorted, np.int32)
+            wait = np.array(wait_sorted, np.int32)
+        else:
+            status = np.empty(n, status_sorted.dtype)
+            remaining = np.empty(n, np.int32)
+            wait = np.empty(n, np.int32)
+            status[order] = status_sorted
+            remaining[order] = remaining_sorted
+            wait[order] = wait_sorted
         # cluster server stat log (ClusterServerStatLogUtil analog): one
         # aggregated counter per verdict class per window
         from sentinel_tpu.metrics.stat_logger import log_cluster

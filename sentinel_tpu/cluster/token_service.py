@@ -449,20 +449,6 @@ class DefaultTokenService(TokenService):
         pos = np.minimum(pos, keys.size - 1)
         return np.where(keys[pos] == flow_ids, slots[pos], -1).astype(np.int32)
 
-    @staticmethod
-    def _prep_batch(cfg, slots, acq, pr):
-        """Build the device batch; returns ``(order, batch)`` where order is
-        None when slots arrived ascending-SORTED (stable argsort would be
-        the identity) — skipping an O(n log n) sort and three fancy-index
-        passes each way. Grouped-but-unsorted input still sorts.
-        Shared by the hot prep and the rare rules-reloaded re-prep so the
-        two can't diverge."""
-        sorted_already = bool((slots[:-1] <= slots[1:]).all())
-        if sorted_already:
-            return None, make_batch(cfg, slots, acq, pr)
-        order = np.argsort(slots, kind="stable")
-        return order, make_batch(cfg, slots[order], acq[order], pr[order])
-
     def request_batch_arrays(
         self,
         flow_ids: np.ndarray,

@@ -21,6 +21,8 @@ FAST_EXAMPLES = [
     "datasource_cluster_demo.py",
     "gateway_demo.py",
     "http_origin_demo.py",
+    "prometheus_exporter_demo.py",
+    "asgi_app_demo.py",
 ]
 
 

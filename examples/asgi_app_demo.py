@@ -27,6 +27,8 @@ if _p:
 import json
 
 from sentinel_tpu.adapters.asgi import SentinelAsgiMiddleware
+from sentinel_tpu.core import clock as clock_mod
+from sentinel_tpu.core.clock import ManualClock
 from sentinel_tpu.local.flow import FlowRuleManager
 from sentinel_tpu.transport.command_asgi import command_asgi_app
 
@@ -57,6 +59,9 @@ async def call(app, path, method="GET", body=b"", query=""):
 
 
 async def main() -> None:
+    # manual clock: the exact 2-pass/3-block assertion must not depend on
+    # wall-clock window rolls (FAST_EXAMPLES determinism contract)
+    prev = clock_mod.set_clock(ManualClock())
     app = SentinelAsgiMiddleware(hello_app)      # the guarded business app
     control = command_asgi_app()                 # the embedded control plane
 
@@ -75,6 +80,7 @@ async def main() -> None:
     status, body = await call(control, "/getRules", query="type=flow")
     print("control plane sees:", json.loads(body))
     FlowRuleManager.load_rules([])
+    clock_mod.set_clock(prev)
 
 
 if __name__ == "__main__":

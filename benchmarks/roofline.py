@@ -22,6 +22,13 @@ whole step is ~0.3 GFLOP against a 49-TFLOP/s f32 ceiling.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO not in _sys.path:
+    _sys.path.insert(0, _REPO)
+
 _CUMSUM_BLOCK = 128  # ops/scan_mm.py blocked_cumsum default
 
 

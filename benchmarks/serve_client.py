@@ -1,0 +1,248 @@
+"""Standalone token-server load client (subprocess worker, CPU-pinned).
+
+One process per client. Speaks the raw wire protocol (BATCH_FLOW frames)
+over plain sockets — no jax backend is ever initialized (jax is imported
+transitively by the protocol package, so the first statement pins it to CPU;
+the device stays exclusively the server's).
+
+Two drive modes:
+
+- ``closed``: ``--pipeline`` threads, each with its own socket, keep one
+  frame in flight back-to-back. Measures the served ceiling the way a
+  sidecar fleet with pipelined channels would (the reference's netty
+  clients pipeline channel writes the same way).
+- ``open``: frames are sent on an ABSOLUTE schedule at ``--rate`` verdicts/s
+  (send time ``t0 + k*dt``, never "previous send + dt", so scheduler jitter
+  does not silently shrink the offered load — the coordinated-omission trap)
+  while a reader thread matches responses by xid. If the in-flight window
+  hits ``--window`` frames the next send is SKIPPED and counted, so an
+  overloaded server shows up as drops + fat percentiles, not client OOM.
+
+Prints ONE JSON line: counts, achieved send rate, and a subsample of raw
+per-frame RTTs (ms) for exact cross-client percentile merging.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402  (import first so the platform pin lands early)
+
+jax.config.update("jax_platforms", "cpu")
+
+import argparse
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+
+from sentinel_tpu.cluster import protocol as P
+
+MAX_RTT_SAMPLES = 50_000
+
+
+def _connect(port: int) -> socket.socket:
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _recv_frames(sock: socket.socket, frames: P.FrameReader, want_xid=None):
+    """Block until at least one BATCH_FLOW response arrives; return list of
+    (xid, n_ok, n) per decoded frame."""
+    out = []
+    while not out:
+        data = sock.recv(65536)
+        if not data:
+            raise ConnectionError("server closed")
+        for payload in frames.feed(data):
+            if P.peek_type(payload) != P.MsgType.BATCH_FLOW:
+                continue
+            xid, status, _rem, _wait = P.decode_batch_response(payload)
+            out.append((xid, int((status == 0).sum()), len(status)))
+    return out
+
+
+def run_closed(port: int, batch: int, pipeline: int, seconds: float,
+               n_flows: int, seed: int) -> dict:
+    rng = np.random.default_rng(seed)
+    stop_at = time.perf_counter() + seconds
+    totals = []
+    rtts: list = []
+    lock = threading.Lock()
+
+    def pump(t: int) -> None:
+        sock = _connect(port)
+        frames = P.FrameReader()
+        # per-thread generator: np.random.Generator is not thread-safe
+        t_rng = np.random.default_rng([seed, t])
+        flow_ids = t_rng.integers(0, n_flows, size=batch)
+        n_ok = n_err = 0
+        local_rtt = []
+        xid = t * 1_000_000 + 1
+        # warmup round trip (connection + compiled-shape route)
+        sock.sendall(P.encode_batch_request(xid, flow_ids))
+        _recv_frames(sock, frames)
+        while time.perf_counter() < stop_at:
+            xid += 1
+            t0 = time.perf_counter()
+            try:
+                sock.sendall(P.encode_batch_request(xid, flow_ids))
+                _recv_frames(sock, frames)
+            except (ConnectionError, socket.timeout, OSError):
+                n_err += batch
+                break
+            local_rtt.append(time.perf_counter() - t0)
+            n_ok += batch
+        try:
+            sock.close()
+        except OSError:
+            pass
+        with lock:
+            totals.append((n_ok, n_err))
+            rtts.extend(local_rtt)
+
+    threads = [
+        threading.Thread(target=pump, args=(t,)) for t in range(pipeline)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    rtt_ms = (np.asarray(rtts) * 1e3) if rtts else np.empty(0)
+    if rtt_ms.size > MAX_RTT_SAMPLES:
+        rtt_ms = rng.choice(rtt_ms, MAX_RTT_SAMPLES, replace=False)
+    return {
+        "verdicts_ok": int(sum(n for n, _ in totals)),
+        "verdicts_err": int(sum(e for _, e in totals)),
+        "wall_s": round(wall, 3),
+        "rtt_ms": [round(float(x), 4) for x in np.sort(rtt_ms)],
+    }
+
+
+def run_open(port: int, batch: int, rate: float, seconds: float,
+             n_flows: int, seed: int, window: int) -> dict:
+    """Open-loop: offered load is ``rate`` verdicts/s as batch frames."""
+    rng = np.random.default_rng(seed)
+    sock = _connect(port)
+    frames = P.FrameReader()
+    flow_ids = rng.integers(0, n_flows, size=batch)
+    dt = batch / rate  # seconds between frame sends
+    n_frames = max(1, int(seconds / dt))
+    sent_at: dict = {}
+    lock = threading.Lock()
+    rtts: list = []
+    ok = [0]
+    done = threading.Event()
+
+    def reader() -> None:
+        try:
+            while True:
+                data = sock.recv(65536)
+                if not data:
+                    return
+                t_now = time.perf_counter()
+                for payload in frames.feed(data):
+                    if P.peek_type(payload) != P.MsgType.BATCH_FLOW:
+                        continue
+                    xid, status, _r, _w = P.decode_batch_response(payload)
+                    with lock:
+                        t0 = sent_at.pop(xid, None)
+                    if t0 is not None:
+                        rtts.append(t_now - t0)
+                        ok[0] += int((status == 0).sum())
+                    with lock:
+                        if done.is_set() and not sent_at:
+                            return
+        except (ConnectionError, OSError):
+            return
+
+    rt = threading.Thread(target=reader, daemon=True)
+    # warmup frame (compiled-shape route); its response carries an unknown
+    # xid, so the reader absorbs and ignores it — not timed
+    sock.sendall(P.encode_batch_request(999_999_999, flow_ids))
+    rt.start()
+    dropped = 0
+    sent = 0
+    t0 = time.perf_counter() + 0.05
+    for k in range(n_frames):
+        target = t0 + k * dt
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        with lock:
+            inflight = len(sent_at)
+        if inflight >= window:
+            dropped += 1  # overload: shed instead of queueing client-side
+            continue
+        xid = k + 1
+        with lock:
+            sent_at[xid] = time.perf_counter()
+        try:
+            sock.sendall(P.encode_batch_request(xid, flow_ids))
+        except (ConnectionError, OSError):
+            break
+        sent += 1
+    send_wall = time.perf_counter() - t0
+    done.set()
+    # grace period for stragglers
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        with lock:
+            if not sent_at:
+                break
+        time.sleep(0.01)
+    with lock:
+        lost = len(sent_at)
+    try:
+        sock.close()
+    except OSError:
+        pass
+    rt.join(timeout=2.0)
+    rtt_ms = np.sort(np.asarray(rtts) * 1e3) if rtts else np.empty(0)
+    if rtt_ms.size > MAX_RTT_SAMPLES:
+        rtt_ms = np.sort(rng.choice(rtt_ms, MAX_RTT_SAMPLES, replace=False))
+    return {
+        "offered_rate": rate,
+        "frames_sent": sent,
+        "frames_dropped": dropped,
+        "frames_lost": lost,
+        "verdicts_ok": int(ok[0]),
+        "send_wall_s": round(send_wall, 3),
+        "achieved_send_rate": round(sent * batch / max(send_wall, 1e-9)),
+        "rtt_ms": [round(float(x), 4) for x in rtt_ms],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--pipeline", type=int, default=2)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--flows", type=int, default=1024)
+    ap.add_argument("--rate", type=float, default=100_000.0)
+    ap.add_argument("--window", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.mode == "closed":
+        out = run_closed(args.port, args.batch, args.pipeline, args.seconds,
+                         args.flows, args.seed)
+    else:
+        out = run_open(args.port, args.batch, args.rate, args.seconds,
+                       args.flows, args.seed, args.window)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

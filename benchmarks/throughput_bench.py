@@ -18,6 +18,13 @@ Prints ONE JSON line and appends a copy under ``benchmarks/results/``.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO not in _sys.path:
+    _sys.path.insert(0, _REPO)
+
 import argparse
 import json
 import multiprocessing as mp

@@ -1,0 +1,243 @@
+"""Byte-level fuzz of the native front door's frame decoder.
+
+The robustness contract mirrored here is the reference's
+``LengthFieldBasedFrameDecoder(1024,0,2,0,2)`` + request-decoder stack
+(``NettyTransportServer.java:80``): arbitrary bytes on the wire may close
+THAT connection but must never crash the server, corrupt another
+connection's responses, or wedge the arena.
+
+Importable (``run_fuzz``) so the pytest case and the ASan harness share one
+corpus strategy:
+
+- pure random garbage (runt frames, bad types, random lengths);
+- MUTATED valid frames (bit flips in length/type/n/rows — the hardest class,
+  since most of the frame still parses);
+- TRUNCATED valid frames followed by socket close mid-frame;
+- oversize declared n vs actual payload;
+- valid frames delivered 1–3 bytes at a time interleaved with garbage
+  connections (partial-parse state machine);
+- arena-boundary pressure: a tiny-cap server parked mid-fuzz must resume.
+
+After every connection's worth of fuzz, a fresh VALID client performs a
+round trip — the liveness oracle. Run standalone (ASan build)::
+
+    make -C native asan-check
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import struct
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _valid_batch_frame(xid: int, n: int) -> bytes:
+    rows = b"".join(
+        struct.pack(">qiB", random.randrange(0, 64), 1, 0) for _ in range(n)
+    )
+    payload = struct.pack(">iB", xid, 5) + struct.pack(">H", n) + rows
+    return struct.pack(">H", len(payload)) + payload
+
+
+def _valid_flow_frame(xid: int) -> bytes:
+    payload = struct.pack(">iB", xid, 1) + struct.pack(">qiB", 1, 1, 0)
+    return struct.pack(">H", len(payload)) + payload
+
+
+def _mutate(frame: bytes, rng: random.Random) -> bytes:
+    b = bytearray(frame)
+    for _ in range(rng.randrange(1, 4)):
+        i = rng.randrange(len(b))
+        b[i] ^= 1 << rng.randrange(8)
+    return bytes(b)
+
+
+def _oracle_roundtrip(port: int, timeout: float = 5.0) -> bool:
+    """One valid BATCH_FLOW round trip on a fresh connection."""
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(_valid_batch_frame(xid=7, n=4))
+        buf = b""
+        s.settimeout(timeout)
+        while len(buf) < 2 or len(buf) < 2 + struct.unpack(">H", buf[:2])[0]:
+            chunk = s.recv(4096)
+            if not chunk:
+                return False
+            buf += chunk
+        flen = struct.unpack(">H", buf[:2])[0]
+        xid, mtype = struct.unpack(">iB", buf[2:7])
+        return xid == 7 and mtype == 5 and flen >= 7
+    return False
+
+
+def _fuzz_one_conn(port: int, rng: random.Random) -> None:
+    """One connection's worth of hostile bytes; server may close on us."""
+    kind = rng.randrange(5)
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=2) as s:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if kind == 0:  # pure garbage
+                s.sendall(rng.randbytes(rng.randrange(1, 4096)))
+            elif kind == 1:  # mutated valid frames
+                for _ in range(rng.randrange(1, 8)):
+                    f = _valid_batch_frame(rng.randrange(1, 1 << 30),
+                                           rng.randrange(0, 32))
+                    s.sendall(_mutate(f, rng))
+            elif kind == 2:  # truncated frame, close mid-parse
+                f = _valid_batch_frame(1, rng.randrange(1, 64))
+                s.sendall(f[: rng.randrange(1, len(f))])
+            elif kind == 3:  # oversize declared n vs actual rows
+                n_claim = rng.randrange(64, 5000)
+                payload = (struct.pack(">iB", 1, 5)
+                           + struct.pack(">H", n_claim)
+                           + rng.randbytes(rng.randrange(0, 64)))
+                s.sendall(struct.pack(">H", len(payload)) + payload)
+            else:  # drip-feed a valid frame in tiny chunks, then garbage
+                f = _valid_batch_frame(3, 8) + _valid_flow_frame(4)
+                i = 0
+                while i < len(f):
+                    step = rng.randrange(1, 4)
+                    s.sendall(f[i : i + step])
+                    i += step
+                # valid frames' responses may arrive; drain nonblocking
+                s.settimeout(0.2)
+                try:
+                    s.recv(4096)
+                except (socket.timeout, OSError):
+                    pass
+                s.sendall(rng.randbytes(rng.randrange(1, 128)))
+            # give the server a beat to process / close
+            s.settimeout(0.2)
+            try:
+                s.recv(4096)
+            except (socket.timeout, OSError):
+                pass
+    except OSError:
+        pass  # connection refused/reset mid-fuzz is fine; liveness is checked
+
+
+def run_fuzz(iters: int = 200, seed: int = 0, arena_cap: int = 65536,
+             oracle_every: int = 10) -> dict:
+    """Stand up a native server and fuzz it; returns stats, raises on a
+    liveness failure (the crash signal when run under ASan)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sentinel_tpu.cluster.server_native import (
+        NativeTokenServer,
+        native_available,
+    )
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    if not native_available():
+        raise RuntimeError("native library not built")
+    cfg = EngineConfig(max_flows=64, max_namespaces=4, batch_size=256)
+    svc = DefaultTokenService(cfg)
+    svc.load_rules([
+        ClusterFlowRule(flow_id=i, count=1e9, mode=ThresholdMode.GLOBAL)
+        for i in range(64)
+    ])
+    server = NativeTokenServer(svc, port=0, idle_ttl_s=None,
+                               arena_cap=arena_cap)
+    server.start()
+    rng = random.Random(seed)
+    checks = 0
+    try:
+        assert _oracle_roundtrip(server.port), "server dead before fuzz"
+        for i in range(iters):
+            _fuzz_one_conn(server.port, rng)
+            if (i + 1) % oracle_every == 0:
+                assert _oracle_roundtrip(server.port), (
+                    f"liveness oracle failed after fuzz iteration {i} "
+                    f"(seed {seed})"
+                )
+                checks += 1
+        assert _oracle_roundtrip(server.port), "server dead after fuzz"
+        stats = server.stats()
+    finally:
+        server.stop()
+        svc.close()
+    return {"iters": iters, "oracle_checks": checks + 2, "stats": stats}
+
+
+def run_fuzz_raw(iters: int = 300, seed: int = 0,
+                 arena_cap: int = 65536, oracle_every: int = 10) -> dict:
+    """Same corpus against a bare ``Frontdoor`` with a constant-verdict
+    dispatch loop — no jit ever executes. This is the ASan harness mode:
+    ASan's ``__cxa_throw`` interceptor is incompatible with jaxlib's
+    nanobind exception machinery, so the sanitized run must keep the
+    entire jax execution path cold (imports are fine; jit calls are not).
+    It is also the purest decoder fuzz: every byte the corpus can reach is
+    C++."""
+    import threading
+
+    import numpy as np
+
+    from sentinel_tpu.native.lib import Frontdoor, available
+
+    if not available():
+        raise RuntimeError("native library not built")
+    door = Frontdoor("127.0.0.1", 0, arena_cap=max(arena_cap, 1))
+    stop = threading.Event()
+
+    def dispatch():
+        while not stop.is_set():
+            got = door.wait_batch(timeout_ms=50)
+            if got is None:
+                continue
+            ids, _counts, _prios, frames = got
+            n = len(ids)
+            door.submit(frames, np.zeros(n, np.int8),
+                        np.zeros(n, np.int32), np.zeros(n, np.int32))
+
+    def control():
+        while not stop.is_set():
+            item = door.next_control()
+            if item is None:
+                time.sleep(0.002)
+
+    threads = [threading.Thread(target=dispatch, daemon=True),
+               threading.Thread(target=control, daemon=True)]
+    for t in threads:
+        t.start()
+    rng = random.Random(seed)
+    checks = 0
+    try:
+        assert _oracle_roundtrip(door.port), "front door dead before fuzz"
+        for i in range(iters):
+            _fuzz_one_conn(door.port, rng)
+            if (i + 1) % oracle_every == 0:
+                assert _oracle_roundtrip(door.port), (
+                    f"liveness oracle failed after fuzz iteration {i} "
+                    f"(seed {seed})"
+                )
+                checks += 1
+        assert _oracle_roundtrip(door.port), "front door dead after fuzz"
+        stats = door.stats()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        door.stop()
+    return {"iters": iters, "oracle_checks": checks + 2, "stats": stats}
+
+
+if __name__ == "__main__":
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    t0 = time.time()
+    seed = int(os.environ.get("FUZZ_SEED", "0"))
+    if os.environ.get("FUZZ_RAW"):
+        out = run_fuzz_raw(iters=iters, seed=seed)
+    else:
+        out = run_fuzz(iters=iters, seed=seed)
+    print(f"fuzz ok: {out['iters']} hostile conns, "
+          f"{out['oracle_checks']} liveness checks, {time.time()-t0:.1f}s")

@@ -12,50 +12,46 @@
   reconnect (``DefaultClusterTokenClient``/``NettyTransportClient`` analog).
 - ``api``: process-global cluster state (CLIENT/SERVER/OFF) consumed by the
   local flow checker's cluster branch (``ClusterStateManager`` analog).
+
+Re-exports are LAZY (PEP 562): importing a jax-free submodule (``protocol``,
+``connection``) must not pull the jax-backed service stack — socket-only
+processes (bench load clients, the ASan fuzz harness, sidecars that only
+speak the wire format) depend on that boundary.
 """
 
-from sentinel_tpu.cluster.token_service import (
-    TokenResult,
-    TokenService,
-    DefaultTokenService,
-)
-from sentinel_tpu.cluster.concurrent import (
-    ConcurrencyManager,
-    ConcurrentFlowRule,
-    ExpiryTask,
-)
-from sentinel_tpu.cluster.api import (
-    ClusterMode,
-    get_mode,
-    set_client,
-    set_embedded_server,
-    set_mode,
-)
-from sentinel_tpu.cluster.connection import ConnectionManager
-from sentinel_tpu.cluster.namespaces import (
-    NamespaceAssignment,
-    aggregate_snapshots,
-    flow_namespaces,
-    partition_rules,
-)
-from sentinel_tpu.cluster.routing import RoutingTokenClient
+_EXPORTS = {
+    "TokenResult": "sentinel_tpu.cluster.token_service",
+    "TokenService": "sentinel_tpu.cluster.token_service",
+    "DefaultTokenService": "sentinel_tpu.cluster.token_service",
+    "ConcurrencyManager": "sentinel_tpu.cluster.concurrent",
+    "ConcurrentFlowRule": "sentinel_tpu.cluster.concurrent",
+    "ExpiryTask": "sentinel_tpu.cluster.concurrent",
+    "ClusterMode": "sentinel_tpu.cluster.api",
+    "get_mode": "sentinel_tpu.cluster.api",
+    "set_client": "sentinel_tpu.cluster.api",
+    "set_embedded_server": "sentinel_tpu.cluster.api",
+    "set_mode": "sentinel_tpu.cluster.api",
+    "ConnectionManager": "sentinel_tpu.cluster.connection",
+    "NamespaceAssignment": "sentinel_tpu.cluster.namespaces",
+    "aggregate_snapshots": "sentinel_tpu.cluster.namespaces",
+    "flow_namespaces": "sentinel_tpu.cluster.namespaces",
+    "partition_rules": "sentinel_tpu.cluster.namespaces",
+    "RoutingTokenClient": "sentinel_tpu.cluster.routing",
+}
 
-__all__ = [
-    "ConnectionManager",
-    "NamespaceAssignment",
-    "RoutingTokenClient",
-    "aggregate_snapshots",
-    "flow_namespaces",
-    "partition_rules",
-    "TokenResult",
-    "TokenService",
-    "DefaultTokenService",
-    "ConcurrencyManager",
-    "ConcurrentFlowRule",
-    "ExpiryTask",
-    "ClusterMode",
-    "get_mode",
-    "set_mode",
-    "set_client",
-    "set_embedded_server",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module 'sentinel_tpu.cluster' has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

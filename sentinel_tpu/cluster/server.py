@@ -62,6 +62,7 @@ class _LoopWorker:
         self.index = index
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.queue: Optional[asyncio.Queue] = None
+        self.inflight = 0  # _process tasks alive (loop-thread only)
         self.thread: Optional[threading.Thread] = None
         self.aserver: Optional[asyncio.AbstractServer] = None
         self.started = threading.Event()
@@ -204,16 +205,25 @@ class _LoopWorker:
 
     # -- micro-batcher ------------------------------------------------------
     async def _batcher(self) -> None:
-        """Adaptive micro-batching: dispatch as soon as the device is free.
+        """Adaptive micro-batching with bounded in-flight steps.
 
-        While a device step is in flight (``_process`` awaits it), new
-        arrivals pile up in the queue and the next iteration drains them all
-        in one go — so batches grow naturally with load and a lone request
-        under light load pays ZERO batching delay. A fixed collect window
-        (``batch_window_ms > 0``) is still honored for callers that prefer
-        bigger batches over tail latency.
+        While a device step is in flight, new arrivals pile up in the queue
+        and the next iteration drains them all in one go — so batches grow
+        naturally with load and a lone request under light load pays ZERO
+        batching delay. A fixed collect window (``batch_window_ms > 0``) is
+        still honored for callers that prefer bigger batches over tail
+        latency.
+
+        Up to ``srv.max_inflight`` batches are processed CONCURRENTLY
+        (``_process`` runs as a task gated by a semaphore): with JAX's async
+        dispatch, batch k+1's host prep and dispatch overlap batch k's
+        device execution and response encode — the device never waits for
+        Python between steps. Responses are xid-correlated, so cross-batch
+        completion order is free to vary.
         """
         srv = self.server
+        sem = asyncio.Semaphore(max(1, srv.max_inflight))
+        loop = asyncio.get_running_loop()
         while True:
             first = await self.queue.get()
             batch: List[Tuple[object, asyncio.StreamWriter]] = [first]
@@ -242,7 +252,15 @@ class _LoopWorker:
                         break
                     batch.append(item)
                     total += self._n_requests(item[0])
-            await self._process(batch, total)
+            await sem.acquire()
+            self.inflight += 1
+            task = loop.create_task(self._process(batch, total))
+
+            def _done(_t):
+                self.inflight -= 1
+                sem.release()
+
+            task.add_done_callback(_done)
 
     @staticmethod
     def _n_requests(item) -> int:
@@ -298,12 +316,29 @@ class _LoopWorker:
             counts = cnt_parts[0] if len(cnt_parts) == 1 else np.concatenate(cnt_parts)
             prios = prio_parts[0] if len(prio_parts) == 1 else np.concatenate(prio_parts)
             try:
-                if n_flow <= srv.inline_below:
-                    # small step: run it right here on the loop thread. The
-                    # two executor hops of to_thread cost more than the step
-                    # blocks the loop for, and a blocked loop just means
-                    # arrivals pile up into the next batch — which is the
-                    # batching policy anyway.
+                dispatch = getattr(service, "dispatch_batch_arrays", None)
+                if dispatch is not None:
+                    # dispatch INLINE on the loop thread: host prep + async
+                    # enqueue only (sub-100µs), so device steps start in
+                    # batch order even when several _process tasks are in
+                    # flight. Materialization (blocks on the device) hops to
+                    # a worker thread for large steps so the loop keeps
+                    # pumping frames and the next batch's dispatch overlaps
+                    # this step's execution.
+                    materialize = dispatch(flow_ids, counts, prios)
+                    if n_flow <= srv.inline_below and self.inflight == 1:
+                        # small LONE step: the two executor hops of
+                        # to_thread cost more than the step blocks the loop
+                        # for. Only when nothing else is in flight — device
+                        # state chains serially, so an inline materialize
+                        # behind another task's large step would block the
+                        # loop for the predecessor's duration too.
+                        status, remaining, wait = materialize()
+                    else:
+                        status, remaining, wait = await asyncio.to_thread(
+                            materialize
+                        )
+                elif n_flow <= srv.inline_below:
                     status, remaining, wait = service.request_batch_arrays(
                         flow_ids, counts, prios
                     )
@@ -414,6 +449,7 @@ class TokenServer:
         max_batch: int = 1024,
         inline_below: int = 64,
         n_loops: int = 1,
+        max_inflight: int = 2,
         idle_ttl_s: Optional[float] = 600.0,
         profile_dir: Optional[str] = None,
     ):
@@ -427,6 +463,9 @@ class TokenServer:
         # through to_thread so the IO loop keeps pumping during the step
         self.inline_below = inline_below
         self.n_loops = max(1, int(n_loops))
+        # batches processed concurrently per loop (device pipelining depth);
+        # 2 keeps one step executing while the next preps/dispatches
+        self.max_inflight = max(1, int(max_inflight))
         self.idle_ttl_s = idle_ttl_s
         self._workers: List[_LoopWorker] = []
         # namespace-scoped connection groups (ConnectionManager.java:35);
@@ -453,6 +492,7 @@ class TokenServer:
             max_batch=self.max_batch,
             inline_below=self.inline_below,
             n_loops=self.n_loops,
+            max_inflight=self.max_inflight,
             idle_ttl_s=self.idle_ttl_s,
             profile_dir=self.profile_dir,
         )

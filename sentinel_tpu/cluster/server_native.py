@@ -156,8 +156,13 @@ class NativeTokenServer:
                 continue
             ids, counts, prios, frames = got
             try:
-                # pulls larger than the engine batch size are chunked inside
-                # request_batch_arrays — one pull may span device steps
+                # pulls larger than the engine batch size pipeline
+                # internally: request_batch_arrays dispatches ALL chunk
+                # steps before blocking on the first verdict (the
+                # dispatch/materialize split in DefaultTokenService);
+                # across threads, another dispatcher's step overlaps this
+                # one's materialization (the service lock covers dispatch
+                # only)
                 status, remaining, wait = service.request_batch_arrays(
                     ids, counts, prios
                 )

@@ -458,29 +458,58 @@ class DefaultTokenService(TokenService):
         """Array-in/array-out decision path: (status int8[N], remaining
         int32[N], wait_ms int32[N]) in request order.
 
-        This is the serving hot path. The service lock covers ONLY the device
-        dispatch + state swap — host prep (slot lookup, grouping sort, batch
-        padding) runs before it and verdict materialization after it, so with
-        JAX's async dispatch the host preps batch k+1 while the device still
-        executes batch k (the lock-free analog of the reference's
-        unsynchronized ``ClusterFlowChecker.java:55-120`` hot loop).
+        Dispatch + materialize in one call; pipelining callers use
+        :meth:`dispatch_batch_arrays` directly.
+        """
+        return self.dispatch_batch_arrays(flow_ids, acquires, prios)()
+
+    def dispatch_batch_arrays(
+        self,
+        flow_ids: np.ndarray,
+        acquires: Optional[np.ndarray] = None,
+        prios: Optional[np.ndarray] = None,
+    ):
+        """Serving hot path, phase 1: host prep + device dispatch. Returns a
+        zero-arg **materializer** that blocks on the async dispatch and
+        yields ``(status, remaining, wait)`` in request order.
+
+        The service lock covers ONLY the device dispatch + state swap — host
+        prep (slot lookup, grouping sort, batch padding) runs before it and
+        verdict materialization after it (the lock-free analog of the
+        reference's unsynchronized ``ClusterFlowChecker.java:55-120`` hot
+        loop). Because JAX dispatch is asynchronous and consecutive steps
+        chain on-device through the state future, a caller that dispatches
+        batch k+1 before materializing batch k keeps the device busy end to
+        end — the serving-path analog of the netty pipeline that amortizes
+        the reference's per-RPC cost (``NettyTransportServer.java:73-101``).
+        Oversized bursts are split into per-bucket chunks whose dispatches
+        are ALL issued before any chunk materializes, so one big pull
+        pipelines internally too.
         """
         flow_ids = np.asarray(flow_ids, np.int64)
         n = flow_ids.shape[0]
         if n == 0:
-            empty32 = np.empty(0, np.int32)
-            return np.empty(0, np.int8), empty32, empty32
+            def _empty():
+                empty32 = np.empty(0, np.int32)
+                return np.empty(0, np.int8), empty32, empty32
+
+            return _empty
         cap = self.config.batch_size
-        if n > cap:  # split oversized bursts
-            parts = [
-                self.request_batch_arrays(
+        if n > cap:  # split oversized bursts; dispatch all chunks first
+            mats = [
+                self.dispatch_batch_arrays(
                     flow_ids[i : i + cap],
                     None if acquires is None else acquires[i : i + cap],
                     None if prios is None else prios[i : i + cap],
                 )
                 for i in range(0, n, cap)
             ]
-            return tuple(np.concatenate(ps) for ps in zip(*parts))
+
+            def _concat():
+                parts = [m() for m in mats]
+                return tuple(np.concatenate(ps) for ps in zip(*parts))
+
+            return _concat
         # -- host prep, outside the lock --
         lookup_snap = self._lookup
         slots = self._lookup_from(lookup_snap, flow_ids)
@@ -516,38 +545,42 @@ class DefaultTokenService(TokenService):
             self._state, verdicts = step(
                 self._state, self._table, batch, np.int32(now)
             )
-        # -- verdict materialization (blocks on the async dispatch), outside --
-        status_sorted = np.asarray(verdicts.status)[:n]
-        remaining_sorted = np.asarray(verdicts.remaining)[:n]
-        wait_sorted = np.asarray(verdicts.wait_ms)[:n]
-        if order is None:
-            # copy: callers own writable results (the sorted path builds
-            # fresh arrays), and a [:n] view would pin the whole padded
-            # bucket buffer alive
-            status = np.array(status_sorted)
-            remaining = np.array(remaining_sorted, np.int32)
-            wait = np.array(wait_sorted, np.int32)
-        else:
-            status = np.empty(n, status_sorted.dtype)
-            remaining = np.empty(n, np.int32)
-            wait = np.empty(n, np.int32)
-            status[order] = status_sorted
-            remaining[order] = remaining_sorted
-            wait[order] = wait_sorted
-        # cluster server stat log (ClusterServerStatLogUtil analog): one
-        # aggregated counter per verdict class per window
-        from sentinel_tpu.metrics.stat_logger import log_cluster
 
-        for event, code in (
-            ("pass", int(TokenStatus.OK)),
-            ("block", int(TokenStatus.BLOCKED)),
-            ("occupied", int(TokenStatus.SHOULD_WAIT)),
-            ("tooManyRequest", int(TokenStatus.TOO_MANY_REQUEST)),
-        ):
-            hits = int((status == code).sum())
-            if hits:
-                log_cluster(event, count=hits)
-        return status, remaining, wait
+        def _materialize():
+            # blocks on the async dispatch; runs outside the lock
+            status_sorted = np.asarray(verdicts.status)[:n]
+            remaining_sorted = np.asarray(verdicts.remaining)[:n]
+            wait_sorted = np.asarray(verdicts.wait_ms)[:n]
+            if order is None:
+                # copy: callers own writable results (the sorted path builds
+                # fresh arrays), and a [:n] view would pin the whole padded
+                # bucket buffer alive
+                status = np.array(status_sorted)
+                remaining = np.array(remaining_sorted, np.int32)
+                wait = np.array(wait_sorted, np.int32)
+            else:
+                status = np.empty(n, status_sorted.dtype)
+                remaining = np.empty(n, np.int32)
+                wait = np.empty(n, np.int32)
+                status[order] = status_sorted
+                remaining[order] = remaining_sorted
+                wait[order] = wait_sorted
+            # cluster server stat log (ClusterServerStatLogUtil analog): one
+            # aggregated counter per verdict class per window
+            from sentinel_tpu.metrics.stat_logger import log_cluster
+
+            for event, code in (
+                ("pass", int(TokenStatus.OK)),
+                ("block", int(TokenStatus.BLOCKED)),
+                ("occupied", int(TokenStatus.SHOULD_WAIT)),
+                ("tooManyRequest", int(TokenStatus.TOO_MANY_REQUEST)),
+            ):
+                hits = int((status == code).sum())
+                if hits:
+                    log_cluster(event, count=hits)
+            return status, remaining, wait
+
+        return _materialize
 
     def request_batch(self, requests) -> List[TokenResult]:
         if not requests:

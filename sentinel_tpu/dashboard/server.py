@@ -710,6 +710,22 @@ class DashboardServer:
             if not machines:
                 return {"error": f"no healthy machine for app {app}"}
             if method == "POST":
+                # validate before fanning out: a malformed body (from a
+                # non-UI client) must return a parse error, not one failing
+                # HTTP push per machine with pushed:0 and no explanation
+                # (r4 advisor)
+                try:
+                    defs = json.loads(body)
+                except (json.JSONDecodeError, TypeError):
+                    return {"error": "body is not valid JSON"}
+                if not isinstance(defs, list) or any(
+                    not isinstance(d, dict) or "apiName" not in d
+                    for d in defs
+                ):
+                    return {
+                        "error": "body must be a list of {apiName, "
+                                 "predicateItems} objects"
+                    }
                 pushed = sum(
                     1 for m in machines
                     if self.client.push_api_definitions(m, body)

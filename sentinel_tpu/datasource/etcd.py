@@ -22,6 +22,9 @@ from sentinel_tpu.core.log import record_log
 from sentinel_tpu.datasource.base import AutoRefreshDataSource, Converter
 from sentinel_tpu.datasource.http_util import request
 
+# one watch-stream JSON line (rule payloads are KBs; 16MB is generous)
+_MAX_WATCH_LINE = 16 * 1024 * 1024
+
 
 def _b64(s: str) -> str:
     return base64.b64encode(s.encode()).decode()
@@ -120,9 +123,16 @@ class EtcdDataSource(AutoRefreshDataSource):
                 if resp.status != 200:
                     raise RuntimeError(f"watch HTTP {resp.status}")
                 while not self._watch_stop.is_set():
-                    line = resp.readline()
+                    # bounded read: a misbehaving gateway streaming one huge
+                    # line must fail the stream (→ reconnect), not exhaust
+                    # process memory (r4 advisor)
+                    line = resp.readline(_MAX_WATCH_LINE + 1)
                     if not line:
                         break  # stream closed by server
+                    if len(line) > _MAX_WATCH_LINE:
+                        raise RuntimeError(
+                            f"watch line exceeded {_MAX_WATCH_LINE} bytes"
+                        )
                     try:
                         msg = json.loads(line)
                     except json.JSONDecodeError:

@@ -311,7 +311,16 @@ class StatisticNode:
             self.minute.add(now, RT, rt_ms)
 
     def add_occupied_pass(self, n: int, wait_ms: int, now: Optional[int] = None) -> None:
-        """Borrow from a future window (``StatisticNode.addOccupiedPass``)."""
+        """Borrow from a future window (``StatisticNode.addOccupiedPass``).
+
+        On fast (native-window) nodes this is a single atomic bucket add —
+        no xfer_lock needed: the lock exists to make the drain→credit
+        TRANSFER atomic; depositing NEW tokens into a future bucket is one
+        atomic op that no reader can observe half-done. The composite
+        readers (``sn_stat_touched_sum``) and ``try_occupy_next``'s
+        ``waiting`` probe may race a concurrent transfer by design — the
+        same drift the reference's unsynchronized LeapArray readers accept.
+        """
         now = _clock.now_ms() if now is None else now
         with self._lock:
             self.future.add(now + wait_ms, n)
@@ -334,6 +343,14 @@ class StatisticNode:
 
     def occupied_pass_qps(self, now: Optional[int] = None) -> float:
         now = self._now(now)
+        fast = self._fast
+        if fast is not None:
+            # same xfer-locked composite read as pass_qps: the drain+credit
+            # transfer can never be observed half-done (r4 advisor)
+            total = fast[0].sn_stat_touched_sum(
+                fast[1], fast[2], fast[3], now, OCCUPIED_PASS
+            )
+            return total * 1000.0 / self.sec.interval_ms
         with self._lock:
             self._touch(now)
             return self.sec.qps(now, OCCUPIED_PASS)

@@ -13,7 +13,11 @@ import threading
 from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SO_PATH = os.path.join(_HERE, "_sentinel_native.so")
+# SENTINEL_NATIVE_SO overrides the library path — the ASan fuzz harness
+# (`make -C native asan-check`) points it at the sanitizer build
+_SO_PATH = os.environ.get(
+    "SENTINEL_NATIVE_SO", os.path.join(_HERE, "_sentinel_native.so")
+)
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

@@ -697,3 +697,86 @@ class TestProfilingHook:
             server.stop()
         produced = list(tmp_path.rglob("*"))
         assert any(p.is_file() for p in produced), produced
+
+
+class TestPipelinedDispatch:
+    """Dispatch/materialize split (the serving-path pipelining seam)."""
+
+    def test_dispatch_then_materialize_matches_request(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=100.0, mode=G)])
+        import numpy as np
+
+        ids = np.array([1, 1, 404], np.int64)
+        mat = svc.dispatch_batch_arrays(ids)
+        status, remaining, wait = mat()
+        assert status[0] == int(TokenStatus.OK)
+        assert status[1] == int(TokenStatus.OK)
+        assert status[2] == int(TokenStatus.NO_RULE_EXISTS)
+        assert len(remaining) == len(wait) == 3
+
+    def test_two_inflight_dispatches_share_budget(self, manual_clock):
+        """Two dispatches issued BEFORE either materializes must still apply
+        the budget sequentially (state chains through device futures)."""
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=10.0, mode=G)])
+        import numpy as np
+
+        ids = np.full(8, 1, np.int64)
+        m1 = svc.dispatch_batch_arrays(ids)
+        m2 = svc.dispatch_batch_arrays(ids)
+        s1, _, _ = m1()
+        s2, _, _ = m2()
+        total_ok = int((s1 == int(TokenStatus.OK)).sum()) + int(
+            (s2 == int(TokenStatus.OK)).sum()
+        )
+        assert total_ok == 10  # budget honored across in-flight steps
+
+    def test_chunked_burst_dispatches_all_before_materializing(
+        self, manual_clock
+    ):
+        """Oversized bursts split into chunks whose dispatches all land
+        before the first materialize (on-device pipelining for big pulls)."""
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=1000.0, mode=G)])
+        import numpy as np
+
+        ids = np.full(150, 1, np.int64)  # > batch_size 64 → 3 chunks
+        mat = svc.dispatch_batch_arrays(ids)
+        status, remaining, wait = mat()
+        assert len(status) == 150
+        assert int((status == int(TokenStatus.OK)).sum()) == 150
+
+    def test_server_max_inflight_serves_concurrent_frames(self):
+        import numpy as np
+
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=1e6, mode=G)])
+        server = TokenServer(svc, port=0, max_inflight=3)
+        server.start()
+        try:
+            assert server.tuning_kwargs()["max_inflight"] == 3
+            clients = [
+                TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+                for _ in range(3)
+            ]
+            results = []
+
+            def pump(c):
+                ids = np.full(32, 1, np.int64)
+                for _ in range(20):
+                    out = c.request_batch_arrays(ids)
+                    results.append(out is not None and len(out[0]) == 32)
+
+            threads = [
+                threading.Thread(target=pump, args=(c,)) for c in clients
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for c in clients:
+                c.close()
+            assert all(results) and len(results) == 60
+        finally:
+            server.stop()

@@ -281,3 +281,36 @@ class TestNativeFrontdoor:
         finally:
             client.close()
             server.stop()
+
+
+class TestFrontdoorFuzz:
+    """Byte-level decoder fuzz (the ``LengthFieldBasedFrameDecoder``
+    robustness contract, ``NettyTransportServer.java:80``): hostile bytes
+    may close their own connection, never the server. The same corpus runs
+    under AddressSanitizer via ``make -C native asan-check``."""
+
+    def test_decoder_survives_hostile_bytes(self):
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "native")
+        )
+        from fuzz_frontdoor import run_fuzz
+
+        out = run_fuzz(iters=60, seed=1234, oracle_every=5)
+        assert out["oracle_checks"] >= 13
+
+    def test_decoder_survives_hostile_bytes_at_arena_boundary(self):
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "native")
+        )
+        from fuzz_frontdoor import run_fuzz
+
+        # cap smaller than one max mutated batch: parse must park/resume
+        # around arena-full mid-hostility without wedging
+        out = run_fuzz(iters=40, seed=99, arena_cap=16, oracle_every=5)
+        assert out["oracle_checks"] >= 9

@@ -1,12 +1,18 @@
 """Launch a live guarded app + dashboard for browser verification.
 
-Two machines register under app "svc": this process and a ``--worker``
-subprocess, each with its own command center + heartbeat + traffic loop.
-That makes the full console walkthrough drivable: resource tables, rule
-CRUD tabs, pass/block/exception + rt timelines, and the cluster screens —
-promote one machine to token server ("make token server"), then open
-"cluster" to see the server info/connections and the other machine's
-client assignment (the DemoClusterInitFunc-style wiring, live).
+Two machines register (under the configured app name, default
+``sentinel-tpu-app``): this process and a ``--worker`` subprocess, each
+with its own command center + heartbeat + traffic loop. That makes the
+full console walkthrough drivable: resource tables, rule CRUD tabs,
+pass/block/exception + rt timelines with per-machine drill-down, and the
+cluster screens — promote one machine to token server ("make token
+server"), open "cluster" for server info/connections and client
+assignments, and manage multi-group assignment from the "assignment
+management" panel (the DemoClusterInitFunc-style wiring, live).
+
+``--cycle`` runs the scripted headless walkthrough instead: a two-server-
+group assign/unassign cycle through ``cluster/assign/manage`` plus a
+per-machine metric drill-down, asserting each step.
 """
 import jax; jax.config.update("jax_platforms", "cpu")
 import subprocess, sys, tempfile, threading, time
@@ -54,12 +60,78 @@ def traffic():
 
 threading.Thread(target=traffic, daemon=True).start()
 
+def _dash_json(path, payload=None, timeout=150):
+    import json as _json
+    import urllib.request
+
+    url = f"http://127.0.0.1:{DASH_PORT}/{path}"
+    data = _json.dumps(payload).encode() if payload is not None else None
+    with urllib.request.urlopen(url, data=data, timeout=timeout) as r:
+        return _json.loads(r.read())
+
+
+def _assign_cycle():
+    """Scripted console walkthrough: a TWO-SERVER-GROUP assign/unassign
+    cycle through cluster/assign/manage + a per-machine metric drill-down —
+    the cluster_app_assign_manage.js and metric.js flows, headless."""
+    for _ in range(60):  # wait for both machines to register + heartbeat
+        apps = _dash_json("apps")
+        machines = apps[0]["machines"] if apps else []
+        if len(machines) >= 2 and all(m["healthy"] for m in machines):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(
+            f"machines never became healthy: {apps!r}"
+        )
+    from urllib.parse import quote
+    app = quote(apps[0]["name"])  # agents register under their config name
+    keys = sorted(f"{m['ip']}:{m['port']}" for m in machines)
+    print("CYCLE machines:", keys, "app:", apps[0]["name"], flush=True)
+    # each machine becomes its own server group (2 groups, no clients —
+    # with two machines total that's the two-server shape; with more,
+    # the rest would be listed per group)
+    res = _dash_json(f"cluster/assign/manage?app={app}", {
+        "groups": [{"server": keys[0], "tokenPort": 28741},
+                   {"server": keys[1], "tokenPort": 28742}]})
+    print("CYCLE assign:", res, flush=True)
+    state = _dash_json(f"cluster/assign/state?app={app}")
+    assert len(state["servers"]) == 2, state
+    print("CYCLE state (2 server groups):", state, flush=True)
+    res = _dash_json(f"cluster/assign/manage?app={app}",
+                     {"unassign": keys})
+    print("CYCLE unassign:", res, flush=True)
+    state = _dash_json(f"cluster/assign/state?app={app}")
+    assert not state["servers"] and len(state["unassigned"]) == 2, state
+    print("CYCLE state (all standalone):", state, flush=True)
+    # per-machine drill-down: one machine's own series for the guarded
+    # resource (vs the app-wide sum the default chart shows)
+    for _ in range(30):
+        mkeys = _dash_json(
+            f"metric/machines?app={app}&identity=GET%3A%2Fcheckout")
+        if mkeys:
+            break
+        time.sleep(1.0)
+    else:
+        raise AssertionError("no per-machine metric series appeared in 30s")
+    per_m = _dash_json(
+        f"metric?app={app}&identity=GET%3A%2Fcheckout&machine={mkeys[0]}"
+        f"&startTime=0&endTime={2**61}")
+    assert per_m, "no per-machine samples"
+    print(f"CYCLE per-machine chart: {len(per_m)} samples from {mkeys[0]}, "
+          f"last passQps={per_m[-1]['passQps']}", flush=True)
+    print("CYCLE OK", flush=True)
+
+
 if not WORKER:
     worker = subprocess.Popen([sys.executable, __file__, "--worker"])
     print(f"READY dash=http://127.0.0.1:{DASH_PORT} cc={cc.port} "
           f"worker_pid={worker.pid}", flush=True)
     try:
-        time.sleep(600)
+        if "--cycle" in sys.argv:
+            _assign_cycle()
+        else:
+            time.sleep(600)
     finally:
         # don't orphan the worker: a stale one would keep heartbeating a
         # phantom machine into the next demo launch

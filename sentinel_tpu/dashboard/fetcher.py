@@ -84,6 +84,9 @@ class MetricFetcher:
                     success_qps=node.success_qps,
                     exception_qps=node.exception_qps,
                     rt=node.rt,
+                    # machine tag feeds the per-machine drill-down series;
+                    # the merged app-wide series strips it on save
+                    machine=machine.key,
                 )
                 for node in nodes
             ]

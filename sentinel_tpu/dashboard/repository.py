@@ -25,6 +25,7 @@ class MetricEntry:
     success_qps: float = 0.0
     exception_qps: float = 0.0
     rt: float = 0.0
+    machine: str = ""  # "ip:port" for per-machine series; "" = app-wide sum
 
     def to_dict(self) -> dict:
         return {
@@ -36,6 +37,7 @@ class MetricEntry:
             "successQps": self.success_qps,
             "exceptionQps": self.exception_qps,
             "rt": self.rt,
+            "machine": self.machine,
         }
 
 
@@ -45,6 +47,11 @@ class InMemoryMetricsRepository:
         self.retention_ms = retention_ms
         # (app, resource) → {timestamp → MetricEntry}
         self._store: Dict[Tuple[str, str], Dict[int, MetricEntry]] = {}
+        # per-machine drill-down series (the reference's metric.js charts
+        # one machine at a time): (app, machine, resource) → {ts → entry}
+        self._machine_store: Dict[
+            Tuple[str, str, str], Dict[int, MetricEntry]
+        ] = {}
         self._last_sweep_ms = 0
 
     def save(self, entry: MetricEntry, merge: bool = False) -> None:
@@ -58,7 +65,14 @@ class InMemoryMetricsRepository:
                 existing.exception_qps += entry.exception_qps
                 existing.rt = max(existing.rt, entry.rt)
             else:
-                series[entry.timestamp_ms] = entry
+                # the app-wide series never carries a machine tag: merge
+                # sums lines from several machines into one entry
+                series[entry.timestamp_ms] = replace(entry, machine="")
+            if entry.machine:
+                mkey = (entry.app, entry.machine, entry.resource)
+                self._machine_store.setdefault(mkey, {})[
+                    entry.timestamp_ms
+                ] = entry
             self._sweep_locked()
 
     def save_all(self, entries: List[MetricEntry], merge: bool = False) -> None:
@@ -74,12 +88,13 @@ class InMemoryMetricsRepository:
             return
         self._last_sweep_ms = now
         horizon = now - self.retention_ms
-        for key in list(self._store):
-            series = self._store[key]
-            for ts in [t for t in series if t < horizon]:
-                del series[ts]
-            if not series:
-                del self._store[key]
+        for store in (self._store, self._machine_store):
+            for key in list(store):
+                series = store[key]
+                for ts in [t for t in series if t < horizon]:
+                    del series[ts]
+                if not series:
+                    del store[key]
 
     def query(
         self, app: str, resource: str, start_ms: int, end_ms: int
@@ -97,6 +112,36 @@ class InMemoryMetricsRepository:
                     if start_ms <= ts <= end_ms
                 ),
                 key=lambda e: e.timestamp_ms,
+            )
+
+    def query_machine(
+        self, app: str, machine: str, resource: str,
+        start_ms: int, end_ms: int
+    ) -> List[MetricEntry]:
+        """One machine's own series for a resource (``metric.js`` drill-down
+        analog) — the un-merged lines the fetcher pulled from that machine."""
+        horizon = _clock.now_ms() - self.retention_ms
+        start_ms = max(start_ms, horizon)
+        with self._lock:
+            series = self._machine_store.get((app, machine, resource), {})
+            return sorted(
+                (
+                    replace(e)
+                    for ts, e in series.items()
+                    if start_ms <= ts <= end_ms
+                ),
+                key=lambda e: e.timestamp_ms,
+            )
+
+    def machines_of_resource(self, app: str, resource: str) -> List[str]:
+        """Machines with live (in-retention) data for a resource."""
+        horizon = _clock.now_ms() - self.retention_ms
+        with self._lock:
+            return sorted(
+                m
+                for (a, m, r), series in self._machine_store.items()
+                if a == app and r == resource
+                and any(t >= horizon for t in series)
             )
 
     def resources_of_app(self, app: str) -> List[str]:

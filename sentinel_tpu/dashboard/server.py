@@ -87,6 +87,12 @@ _INDEX_HTML = """<!doctype html>
 </div>
 <div id="chartwrap" style="display:none">
  <h2>timeline: <span id="chartres"></span></h2>
+ <div class="legend">machine <select id="chartmachine"
+   onchange="chartCtx.machine=this.value;loadChart()"></select>
+  window <select id="chartwin" onchange="loadChart()">
+   <option value="60000">1 min</option>
+   <option value="180000">3 min</option>
+   <option value="300000" selected>5 min</option></select></div>
  <div class="legend"><span class="sw" style="background:var(--series-1)"></span>
   <b>pass qps</b><span class="sw" style="background:var(--series-2)"></span>
   <b>block qps</b><span class="sw" style="background:var(--series-3)"></span>
@@ -292,14 +298,38 @@ async function assign(app, machine){
   alert(JSON.stringify(await r.json())); refresh();
 }
 // ---- metric timelines: qps chart (pass/block/exception) + rt chart ----
+// per-machine drill-down + history window (metric.js analog): the machine
+// selector switches between the app-wide sum and one machine's own series
 let chartData = null;
+let chartCtx = {app:'', resource:'', machine:''};
 async function openChart(app, resource){
   document.getElementById('chartwrap').style.display = '';
-  document.getElementById('chartres').textContent = resource;
+  chartCtx = {app, resource, machine:''};
+  const sel = document.getElementById('chartmachine');
+  sel.innerHTML = '';
+  const all = document.createElement('option');
+  all.value = ''; all.textContent = 'all machines (sum)';
+  sel.appendChild(all);
+  try {
+    for (const mk of await api(`metric/machines?app=${encodeURIComponent(app)}` +
+        `&identity=${encodeURIComponent(resource)}`)){
+      const o = document.createElement('option');
+      o.value = mk; o.textContent = mk; sel.appendChild(o);
+    }
+  } catch(e){}
+  sel.value = '';
+  await loadChart();
+}
+async function loadChart(){
+  const {app, resource, machine} = chartCtx;
+  document.getElementById('chartres').textContent =
+    resource + (machine ? ' @ ' + machine : '');
+  const win = +document.getElementById('chartwin').value;
   const now = Date.now();
   const ms = await api(`metric?app=${encodeURIComponent(app)}` +
     `&identity=${encodeURIComponent(resource)}` +
-    `&startTime=${now-300000}&endTime=${now}`);
+    `&startTime=${now-win}&endTime=${now}` +
+    (machine ? `&machine=${encodeURIComponent(machine)}` : ''));
   chartData = ms.map(e => ({t: e.timestamp, pass: e.passQps,
     block: e.blockQps, exc: e.exceptionQps, rt: e.rt}));
   drawChart();
@@ -444,6 +474,74 @@ async function openCluster(app){
     p.className = 'legend';
     view.appendChild(p);
   }
+  await renderAssignManage(app, view);
+}
+// ---- assignment management (cluster_app_assign_manage.js analog) ----
+// server groups with their clients, group unassignment back to standalone,
+// and a new-group form (pick a server + client set + token port)
+async function renderAssignManage(app, view){
+  const h = document.createElement('h3');
+  h.textContent = 'assignment management';
+  view.appendChild(h);
+  let st;
+  try { st = await api('cluster/assign/state?app='+encodeURIComponent(app)); }
+  catch(e){ return; }
+  const gt = document.createElement('table');
+  row(gt, ['server group', 'token port', 'clients', ''], 'th');
+  for (const g of st.servers || []){
+    const ub = document.createElement('button');
+    ub.textContent = 'unassign group';
+    ub.onclick = () => manageAssign(app,
+      {unassign: [g.machine, ...g.clients]});
+    row(gt, [g.machine, String(g.port), (g.clients || []).join(', '), ub]);
+  }
+  if ((st.servers || []).length) view.appendChild(gt);
+  const pool = [...(st.unassigned || []),
+                ...(st.servers || []).flatMap(g => [g.machine, ...g.clients])];
+  const form = document.createElement('div');
+  const lbl = document.createElement('span');
+  lbl.className = 'legend'; lbl.textContent = 'new group: server ';
+  form.appendChild(lbl);
+  const ssel = document.createElement('select');
+  for (const mk of pool){
+    const o = document.createElement('option');
+    o.value = mk; o.textContent = mk; ssel.appendChild(o);
+  }
+  form.appendChild(ssel);
+  const plbl = document.createElement('span');
+  plbl.className = 'legend'; plbl.textContent = ' port ';
+  form.appendChild(plbl);
+  const port = document.createElement('input');
+  port.value = '18730'; port.size = 6; form.appendChild(port);
+  const boxes = [];
+  for (const mk of pool){
+    const cb = document.createElement('input');
+    cb.type = 'checkbox'; cb.value = mk; boxes.push(cb);
+    const cl = document.createElement('label');
+    cl.className = 'legend';
+    cl.appendChild(cb); cl.appendChild(document.createTextNode(mk));
+    form.appendChild(cl);
+  }
+  const apply = document.createElement('button');
+  apply.textContent = 'assign group';
+  apply.onclick = () => manageAssign(app, {groups: [{
+    server: ssel.value, tokenPort: +port.value || 18730,
+    clients: boxes.filter(b => b.checked && b.value !== ssel.value)
+                  .map(b => b.value)}]});
+  form.appendChild(apply);
+  view.appendChild(form);
+  if (st.unknown && st.unknown.length){
+    const p = document.createElement('p');
+    p.className = 'legend';
+    p.textContent = 'unreachable: ' + st.unknown.join(', ');
+    view.appendChild(p);
+  }
+}
+async function manageAssign(app, payload){
+  const r = await fetch('cluster/assign/manage?app='+encodeURIComponent(app),
+    {method:'POST', body: JSON.stringify(payload)});
+  alert(JSON.stringify(await r.json()));
+  openCluster(app);
 }
 const MODES = {'-1':'off','0':'client','1':'server'};
 async function refresh(){
@@ -625,13 +723,31 @@ class DashboardServer:
         if path == "resources":
             return self.repository.resources_of_app(params.get("app", ""))
         if path == "metric":
-            entries = self.repository.query(
-                params.get("app", ""),
-                params.get("identity", ""),
-                int(params.get("startTime", 0)),
-                int(params.get("endTime", 2**62)),
-            )
+            # app-wide merged series, or one machine's own series when
+            # ``machine=ip:port`` is given (metric.js drill-down analog)
+            machine = params.get("machine", "")
+            if machine:
+                entries = self.repository.query_machine(
+                    params.get("app", ""),
+                    machine,
+                    params.get("identity", ""),
+                    int(params.get("startTime", 0)),
+                    int(params.get("endTime", 2**62)),
+                )
+            else:
+                entries = self.repository.query(
+                    params.get("app", ""),
+                    params.get("identity", ""),
+                    int(params.get("startTime", 0)),
+                    int(params.get("endTime", 2**62)),
+                )
             return [e.to_dict() for e in entries]
+        if path == "metric/machines":
+            # machines with live data for a resource — populates the
+            # drill-down selector
+            return self.repository.machines_of_resource(
+                params.get("app", ""), params.get("identity", "")
+            )
         if path == "rules":
             app = params.get("app", "")
             rule_type = params.get("type", "flow")
@@ -785,36 +901,140 @@ class DashboardServer:
         if method == "POST" and path == "cluster/assign":
             # one-shot assignment (ClusterAssignServiceImpl analog): flip the
             # chosen machine to server mode, everything else to client mode
-            # pointed at it
+            # pointed at it — the single-group case of _apply_assign_groups,
+            # with this route's historical response shape preserved
             data = json.loads(body) if body else {}
             app = params.get("app", "") or data.get("app", "")
             server_key = data.get("server", "")
-            token_port = int(data.get("tokenPort", 18730))
             machines = self.apps.healthy_machines(app)
-            server = next((m for m in machines if m.key == server_key), None)
-            if server is None:
+            if not any(m.key == server_key for m in machines):
                 return {"error": f"machine {server_key} not found/healthy"}
-            if not self.client.set_cluster_mode(server, 1, token_port):
-                # abort BEFORE touching clients: re-pointing the fleet at a
-                # machine that failed to become a server would break every
-                # cluster check at once
+            res = self._apply_assign_groups(
+                machines,
+                [{
+                    "server": server_key,
+                    "tokenPort": data.get("tokenPort", 18730),
+                    "clients": [m.key for m in machines
+                                if m.key != server_key],
+                }],
+                (),
+            )
+            g = res["groups"][0]
+            if "error" in g:
+                # fail-stop happened inside the group apply: no client of
+                # this group was reconfigured
                 return {"error": f"promoting {server_key} to token server "
                         "failed; no clients were reconfigured"}
-            results = {"server": True, "clients": 0, "failed": []}
+            return {"server": True, "clients": g["clients"],
+                    "failed": res["failed"]}
+        if path == "cluster/assign/state":
+            # live assignment view (cluster_app_assign_manage.js analog):
+            # server groups with their pointed-at clients, plus machines in
+            # neither role — reconstructed from each machine's own mode and
+            # client config, so the view is truth, not dashboard memory
+            app = params.get("app", "")
+            machines = self.apps.healthy_machines(app)
+            by_addr = {}  # "ip:tokenPort" → server group
+            state = {"servers": [], "unassigned": [], "unknown": []}
+            clients = []
             for m in machines:
-                if m.key == server_key:
-                    continue
-                ok = self.client.push_cluster_client_config(
-                    m, server.ip, token_port
-                ) and self.client.set_cluster_mode(m, 0)
-                if ok:
-                    results["clients"] += 1
+                mode = self.client.get_cluster_mode(m)
+                if mode == 1:
+                    info = self.client.fetch_json(m, "cluster/server/info")
+                    if info is None:
+                        # a known server whose info fetch failed: transport
+                        # trouble, not definitive state — 'unknown', never
+                        # 'unassigned' (an operator acting on 'unassigned'
+                        # would re-assign a live server)
+                        state["unknown"].append(m.key)
+                        continue
+                    group = {
+                        "machine": m.key,
+                        "ip": m.ip,
+                        "port": int(info.get("port", 0) or 0),
+                        "clients": [],
+                    }
+                    state["servers"].append(group)
+                    by_addr[f"{m.ip}:{group['port']}"] = group
+                elif mode == 0:
+                    clients.append(m)
+                elif mode is None:
+                    state["unknown"].append(m.key)
                 else:
-                    results["failed"].append(m.key)
-            return results
+                    state["unassigned"].append(m.key)
+            for m in clients:
+                cfg = self.client.fetch_json(m, "cluster/client/fetchConfig")
+                if cfg is None:
+                    # active client, config unreadable right now: transport
+                    # failure is 'unknown', not a standalone verdict
+                    state["unknown"].append(m.key)
+                    continue
+                addr = f"{cfg.get('serverHost', '')}:{cfg.get('serverPort', '')}"
+                group = by_addr.get(addr)
+                if group is not None:
+                    group["clients"].append(m.key)
+                else:
+                    # definitively points at a server outside this app's
+                    # healthy set (an orphan client)
+                    state["unassigned"].append(m.key)
+            return state
+        if method == "POST" and path == "cluster/assign/manage":
+            # full assignment management (ClusterAssignServiceImpl
+            # applyAssignToApp / unbindClusterServers analog): multiple
+            # server GROUPS, each with its own client set, plus explicit
+            # unassignment back to standalone (mode -1). Per-group
+            # fail-stop: a group whose server promotion fails reconfigures
+            # none of its clients.
+            data = json.loads(body) if body else {}
+            app = params.get("app", "") or data.get("app", "")
+            return self._apply_assign_groups(
+                self.apps.healthy_machines(app),
+                data.get("groups", ()),
+                data.get("unassign", ()),
+            )
         if path in ("", "index.html"):
             return _INDEX_HTML
         return None
+
+    def _apply_assign_groups(self, healthy, groups, unassign) -> dict:
+        """Apply server groups + unassignments (the one sequence behind both
+        POST cluster/assign and POST cluster/assign/manage). Per-group
+        fail-stop: a group whose server promotion fails reconfigures none
+        of its clients."""
+        machines = {m.key: m for m in healthy}
+        results = {"groups": [], "unassigned": 0, "failed": []}
+        for group in groups:
+            server_key = group.get("server", "")
+            token_port = int(group.get("tokenPort", 18730))
+            server = machines.get(server_key)
+            gres = {"server": server_key, "clients": 0}
+            if server is None or not self.client.set_cluster_mode(
+                server, 1, token_port
+            ):
+                gres["error"] = "server not found/healthy or promote failed"
+                results["groups"].append(gres)
+                results["failed"].append(server_key)
+                continue
+            for ckey in group.get("clients", ()):
+                m = machines.get(ckey)
+                ok = m is not None and self.client.push_cluster_client_config(
+                    m, server.ip, token_port
+                ) and self.client.set_cluster_mode(m, 0)
+                if ok:
+                    gres["clients"] += 1
+                else:
+                    results["failed"].append(ckey)
+            results["groups"].append(gres)
+        for ckey in unassign:
+            m = machines.get(ckey)
+            # mode -1 = standalone: the agent tears down its token
+            # client/server and local checks take over (the unbind path of
+            # the reference's assign service)
+            if m is not None and self.client.set_cluster_mode(m, -1):
+                results["unassigned"] += 1
+            else:
+                results["failed"].append(ckey)
+        return results
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "DashboardServer":

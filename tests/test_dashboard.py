@@ -669,3 +669,211 @@ class TestRuleCrudViews:
         finally:
             cc.stop()
             dash.stop()
+
+
+class TestPerMachineDrilldown:
+    """Per-machine metric series (the reference metric.js drill-down)."""
+
+    def test_machine_series_kept_alongside_merged(self, manual_clock):
+        repo = InMemoryMetricsRepository()
+        now = manual_clock.now_ms()
+        repo.save(MetricEntry("svc", "res", now, pass_qps=5,
+                              machine="10.0.0.1:1"), merge=True)
+        repo.save(MetricEntry("svc", "res", now, pass_qps=7,
+                              machine="10.0.0.2:1"), merge=True)
+        merged = repo.query("svc", "res", 0, 2**61)
+        assert [e.pass_qps for e in merged] == [12]
+        assert merged[0].machine == ""  # the sum carries no machine tag
+        m1 = repo.query_machine("svc", "10.0.0.1:1", "res", 0, 2**61)
+        m2 = repo.query_machine("svc", "10.0.0.2:1", "res", 0, 2**61)
+        assert [e.pass_qps for e in m1] == [5]
+        assert [e.pass_qps for e in m2] == [7]
+        assert repo.machines_of_resource("svc", "res") == [
+            "10.0.0.1:1", "10.0.0.2:1"
+        ]
+
+    def test_machine_series_respects_retention(self, manual_clock):
+        repo = InMemoryMetricsRepository()
+        t0 = manual_clock.now_ms()
+        repo.save(MetricEntry("svc", "res", t0, pass_qps=1,
+                              machine="m:1"), merge=True)
+        manual_clock.sleep(6 * 60 * 1000)
+        repo.save(MetricEntry("svc", "res", manual_clock.now_ms(),
+                              pass_qps=2, machine="m:1"), merge=True)
+        assert [e.pass_qps for e in
+                repo.query_machine("svc", "m:1", "res", 0, 2**61)] == [2]
+
+    def test_fetcher_tags_machine_and_route_serves_it(
+        self, manual_clock, monkeypatch
+    ):
+        from sentinel_tpu.metrics.log import MetricNode
+
+        dash = DashboardServer(port=0).start()
+        try:
+            apps = dash.apps
+            apps.register(MachineInfo(app="svc", ip="10.0.0.1", port=1))
+            apps.register(MachineInfo(app="svc", ip="10.0.0.2", port=1))
+            ts = manual_clock.now_ms() // 1000 * 1000 - 3000
+
+            def fake_fetch(machine, start, end):
+                qps = 5 if machine.ip == "10.0.0.1" else 7
+                return [MetricNode(timestamp_ms=ts, resource="res",
+                                   pass_qps=qps)]
+
+            monkeypatch.setattr(dash.fetcher.client, "fetch_metrics",
+                                fake_fetch)
+            dash.fetcher.fetch_once("svc")
+            per_m = _get(
+                dash.port,
+                "metric?app=svc&identity=res&machine=10.0.0.1:1"
+                "&startTime=0&endTime=2305843009213693952",
+            )
+            assert [e["passQps"] for e in per_m] == [5]
+            machines = _get(dash.port, "metric/machines?app=svc&identity=res")
+            assert machines == ["10.0.0.1:1", "10.0.0.2:1"]
+        finally:
+            dash.stop()
+
+
+class _FakeAssignClient:
+    """Simulates per-machine agent state for assignment-management tests
+    (two real agents can't coexist in one process — the embedded token
+    server is process-global)."""
+
+    def __init__(self, keys):
+        self.mode = {k: -1 for k in keys}
+        self.server_port = {}
+        self.client_cfg = {}
+        self.dead = set()
+
+    def get_cluster_mode(self, m):
+        return None if m.key in self.dead else self.mode[m.key]
+
+    def set_cluster_mode(self, m, mode, token_port=None):
+        if m.key in self.dead:
+            return False
+        self.mode[m.key] = mode
+        if mode == 1:
+            self.server_port[m.key] = token_port or 18730
+        return True
+
+    def push_cluster_client_config(self, m, host, port):
+        if m.key in self.dead:
+            return False
+        self.client_cfg[m.key] = {"serverHost": host, "serverPort": port}
+        return True
+
+    def fetch_json(self, m, command, params=None):
+        if m.key in self.dead:
+            return None
+        if command == "cluster/server/info":
+            return {"port": self.server_port.get(m.key, 0)}
+        if command == "cluster/client/fetchConfig":
+            return dict(self.client_cfg.get(m.key, {}))
+        return {}
+
+
+class TestAssignManagement:
+    """cluster/assign/state + cluster/assign/manage
+    (cluster_app_assign_manage.js / ClusterAssignService analog)."""
+
+    def _dash(self, n=4):
+        dash = DashboardServer(port=0).start()
+        keys = []
+        for i in range(n):
+            ip = f"10.0.0.{i + 1}"
+            _post(dash.port, "registry/machine",
+                  {"app": "svc", "ip": ip, "port": 1})
+            keys.append(f"{ip}:1")
+        fake = _FakeAssignClient(keys)
+        dash.client = fake
+        return dash, fake, keys
+
+    def test_two_group_assign_then_unassign_cycle(self, manual_clock):
+        dash, fake, keys = self._dash(4)
+        try:
+            code, res, _ = _post(
+                dash.port, "cluster/assign/manage?app=svc",
+                {"groups": [
+                    {"server": keys[0], "tokenPort": 28001,
+                     "clients": [keys[1]]},
+                    {"server": keys[2], "tokenPort": 28002,
+                     "clients": [keys[3]]},
+                ]},
+            )
+            assert code == 200 and res["failed"] == []
+            assert [g["clients"] for g in res["groups"]] == [1, 1]
+            assert fake.mode == {keys[0]: 1, keys[1]: 0,
+                                 keys[2]: 1, keys[3]: 0}
+            state = _get(dash.port, "cluster/assign/state?app=svc")
+            groups = {g["machine"]: g for g in state["servers"]}
+            assert groups[keys[0]]["clients"] == [keys[1]]
+            assert groups[keys[0]]["port"] == 28001
+            assert groups[keys[2]]["clients"] == [keys[3]]
+            assert state["unassigned"] == [] and state["unknown"] == []
+            # unassign group 2: both machines back to standalone
+            code, res, _ = _post(
+                dash.port, "cluster/assign/manage?app=svc",
+                {"unassign": [keys[2], keys[3]]},
+            )
+            assert code == 200 and res["unassigned"] == 2
+            assert fake.mode[keys[2]] == -1 and fake.mode[keys[3]] == -1
+            state = _get(dash.port, "cluster/assign/state?app=svc")
+            assert sorted(state["unassigned"]) == sorted([keys[2], keys[3]])
+            assert [g["machine"] for g in state["servers"]] == [keys[0]]
+        finally:
+            dash.stop()
+
+    def test_failed_promote_reconfigures_no_clients(self, manual_clock):
+        dash, fake, keys = self._dash(3)
+        try:
+            fake.dead.add(keys[0])
+            code, res, _ = _post(
+                dash.port, "cluster/assign/manage?app=svc",
+                {"groups": [{"server": keys[0],
+                             "clients": [keys[1], keys[2]]}]},
+            )
+            assert code == 200
+            assert keys[0] in res["failed"]
+            # fail-stop: the group's clients were never touched
+            assert fake.mode[keys[1]] == -1 and fake.mode[keys[2]] == -1
+            assert fake.client_cfg == {}
+        finally:
+            dash.stop()
+
+    def test_state_reports_unreachable_and_orphan_clients(self, manual_clock):
+        dash, fake, keys = self._dash(3)
+        try:
+            fake.dead.add(keys[0])
+            # keys[1] points at a server that is not in this app
+            fake.mode[keys[1]] = 0
+            fake.client_cfg[keys[1]] = {"serverHost": "10.9.9.9",
+                                        "serverPort": 1}
+            state = _get(dash.port, "cluster/assign/state?app=svc")
+            assert state["unknown"] == [keys[0]]
+            assert keys[1] in state["unassigned"]  # orphan client
+            assert keys[2] in state["unassigned"]
+        finally:
+            dash.stop()
+
+    def test_transport_failure_is_unknown_not_unassigned(self, manual_clock):
+        """A live client/server whose detail fetch fails must be 'unknown':
+        acting on 'unassigned' would re-assign a clustered machine."""
+        dash, fake, keys = self._dash(3)
+        try:
+            fake.mode[keys[0]] = 1  # server, but info fetch will fail
+            fake.mode[keys[1]] = 0  # client, but config fetch will fail
+            orig = fake.fetch_json
+
+            def flaky(m, command, params=None):
+                if m.key in (keys[0], keys[1]):
+                    return None  # transport failure on the detail call only
+                return orig(m, command, params)
+
+            fake.fetch_json = flaky
+            state = _get(dash.port, "cluster/assign/state?app=svc")
+            assert sorted(state["unknown"]) == sorted([keys[0], keys[1]])
+            assert state["unassigned"] == [keys[2]]
+            assert state["servers"] == []
+        finally:
+            dash.stop()

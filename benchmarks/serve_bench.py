@@ -83,7 +83,7 @@ def _pcts(rtt_ms: np.ndarray) -> dict:
 
 def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                  serve_buckets=(4096, 16384), native: bool = True,
-                 port: int = 0):
+                 port: int = 0, n_dispatchers: int = 2):
     """Service (100k rules — the headline's problem size) + front door."""
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
@@ -113,7 +113,8 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
 
             if native_available():
                 server = NativeTokenServer(
-                    service, host="127.0.0.1", port=port, max_batch=max_batch
+                    service, host="127.0.0.1", port=port,
+                    max_batch=max_batch, n_dispatchers=n_dispatchers,
                 )
                 front_door = "native-epoll"
         except Exception:
@@ -215,13 +216,21 @@ def operating_point(points) -> dict | None:
 
 
 def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
-                  n_flows: int = 100_000, max_batch: int = 16384) -> dict:
+                  n_flows: int = 100_000, max_batch: int = 16384,
+                  n_dispatchers: int = None) -> dict:
     """Full measurement on the CURRENT backend (caller configured jax)."""
     import jax
 
     backend = jax.default_backend()
+    if n_dispatchers is None:
+        # remote/tunnel backends are dispatch-latency-bound: more
+        # dispatcher threads = more device steps in flight (each chains on
+        # the state future), which is the only lever against per-dispatch
+        # RTT. On CPU extra dispatchers just time-slice the host.
+        n_dispatchers = 4 if backend == "tpu" else 2
     service, server, front_door = build_server(
-        n_flows=n_flows, max_batch=max_batch, native=native
+        n_flows=n_flows, max_batch=max_batch, native=native,
+        n_dispatchers=n_dispatchers,
     )
     try:
         closed = run_closed(server.port, n_flows=n_flows,
@@ -246,6 +255,12 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
     op = operating_point(curve)
     return {
         "backend": backend,
+        # only the native door has dispatcher threads; the asyncio fallback
+        # ignores the knob, and reporting it there would let readers
+        # attribute throughput to a dispatcher count never in effect
+        "n_dispatchers": (
+            n_dispatchers if front_door == "native-epoll" else None
+        ),
         "front_door": front_door,
         "verdicts_per_sec": closed["verdicts_per_sec"],
         "p50_ms": closed["p50_ms"],

@@ -228,9 +228,13 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
         # the state future), which is the only lever against per-dispatch
         # RTT. On CPU extra dispatchers just time-slice the host.
         n_dispatchers = 4 if backend == "tpu" else 2
+    # bucket ladder per backend: on TPU big buckets amortize dispatch RTT;
+    # on CPU the step is shape-proportional, so padding a light pull to
+    # 16384 wastes host time — give it smaller rungs
+    buckets = (4096, 16384) if backend == "tpu" else (1024, 4096, 16384)
     service, server, front_door = build_server(
         n_flows=n_flows, max_batch=max_batch, native=native,
-        n_dispatchers=n_dispatchers,
+        n_dispatchers=n_dispatchers, serve_buckets=buckets,
     )
     try:
         closed = run_closed(server.port, n_flows=n_flows,

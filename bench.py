@@ -53,7 +53,7 @@ ATTEMPTS = [
     # (benchmarks/shape_sweep.py — same per-batch-overhead amortization
     # argument as on TPU)
     ("cpu-fallback", dict(platform="cpu", n_flows=100_000, batch=16384,
-                          chain=8, repeats=3, upgrade=(32768, 8)), 420),
+                          chain=16, repeats=3, upgrade=(32768, 8)), 420),
 ]
 
 # v5e single-chip peaks (public: jax-ml.github.io/scaling-book): 197 TFLOP/s

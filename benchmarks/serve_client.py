@@ -129,6 +129,15 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
     }
 
 
+def open_loop_schedule(batch: int, rate: float, seconds: float):
+    """Absolute send schedule: frame k goes at ``t0 + k*dt`` — never
+    "previous send + dt", so scheduler jitter cannot silently shrink the
+    offered load (the coordinated-omission trap)."""
+    dt = batch / rate  # seconds between frame sends
+    n_frames = max(1, int(seconds / dt))
+    return dt, n_frames
+
+
 def run_open(port: int, batch: int, rate: float, seconds: float,
              n_flows: int, seed: int, window: int) -> dict:
     """Open-loop: offered load is ``rate`` verdicts/s as batch frames."""
@@ -136,8 +145,7 @@ def run_open(port: int, batch: int, rate: float, seconds: float,
     sock = _connect(port)
     frames = P.FrameReader()
     flow_ids = rng.integers(0, n_flows, size=batch)
-    dt = batch / rate  # seconds between frame sends
-    n_frames = max(1, int(seconds / dt))
+    dt, n_frames = open_loop_schedule(batch, rate, seconds)
     sent_at: dict = {}
     lock = threading.Lock()
     rtts: list = []

@@ -241,14 +241,26 @@ class DefaultTokenService(TokenService):
         object per call would route every dispatch through pjit's slow
         Python cache-miss path (~1ms/call on CPU — measured; the C++
         fast path keys on the callable identity), which at serving rates
-        costs more than the kernel itself."""
+        costs more than the kernel itself.
+
+        The single-shard step DONATES the state buffers: every serving
+        step scatter-updates the full [max_flows, buckets, events] window
+        tensors, and without donation XLA must copy them first (measured
+        22% of the 64-bucket step at 100k flows on CPU; on TPU it is HBM
+        traffic and allocator churn). Safe because the service lock makes
+        `self._state, verdicts = step(self._state, …)` the only reader of
+        the old buffer, and warmup feeds throwaway states. If a dispatch
+        ever raises AFTER consuming its donated input, later steps fail
+        loudly with a donated-buffer error (visible, not silent)."""
         key = (bucket, uniform)
         step = self._sharded_steps.get(key)
         if step is not None:
             return step
         cfg = self.config._replace(batch_size=bucket)
         if self.mesh is None:
-            step = partial(decide, cfg, grouped=True, uniform=uniform)
+            from sentinel_tpu.engine.decide import decide_donating
+
+            step = decide_donating(cfg, grouped=True, uniform=uniform)
         else:
             from sentinel_tpu.parallel.sharding import make_sharded_decide
 
@@ -410,13 +422,19 @@ class DefaultTokenService(TokenService):
             now = self._engine_now()
             # compile both serving variants (uniform acquire and mixed) for
             # every shape bucket the serving path can pick (mesh-sharded
-            # variants when this service runs over a pod mesh)
+            # variants when this service runs over a pod mesh). ONE
+            # throwaway state threads through every variant: the
+            # single-shard step donates its state argument (passing the
+            # live self._state would invalidate it), and since each step
+            # returns a same-shaped state, chaining keeps warmup at a
+            # single extra state allocation instead of one per variant.
+            ws = self._place_state(make_state(self.config))
             for bucket in self._serve_buckets:
                 cfg = self.config._replace(batch_size=bucket)
                 batch = make_batch(cfg, [-1])
                 for uniform in (True, False):
                     step = self._step_fn(bucket, uniform)
-                    step(self._state, self._table, batch, jnp.int32(now))
+                    ws, _ = step(ws, self._table, batch, jnp.int32(now))
             idx = hash_indices(
                 np.zeros(1, np.int64), self.param_config.depth, self.param_config.width
             )

@@ -21,7 +21,10 @@ enter context → ``entry`` → proceed → trace on error → ``exit``.
   ``tornado``).
 """
 
-from sentinel_tpu.adapters.decorator import sentinel_resource
+from sentinel_tpu.adapters.decorator import (
+    sentinel_intercept,
+    sentinel_resource,
+)
 from sentinel_tpu.adapters.wsgi import SentinelWsgiMiddleware
 from sentinel_tpu.adapters.asgi import SentinelAsgiMiddleware
 from sentinel_tpu.adapters.gateway import (
@@ -46,6 +49,7 @@ from sentinel_tpu.adapters.gateway_api import (
 )
 
 __all__ = [
+    "sentinel_intercept",
     "sentinel_resource",
     "SentinelWsgiMiddleware",
     "SentinelAsgiMiddleware",

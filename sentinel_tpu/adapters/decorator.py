@@ -82,6 +82,7 @@ def sentinel_resource(
                 finally:
                     e.exit()
 
+            async_wrapper.__sentinel_resource__ = name
             return async_wrapper
 
         @functools.wraps(fn)
@@ -102,6 +103,74 @@ def sentinel_resource(
             finally:
                 e.exit()
 
+        wrapper.__sentinel_resource__ = name
         return wrapper
+
+    return decorate
+
+
+def sentinel_intercept(
+    include: Optional[Callable[[str], bool]] = None,
+    exclude: Tuple[str, ...] = (),
+    resource_fmt: str = "{cls}.{method}",
+    **resource_kwargs,
+):
+    """Class-level interceptor: guard every public method of a class.
+
+    Analog of the CDI interceptor binding
+    (``sentinel-annotation-cdi-interceptor/.../SentinelResourceInterceptor.java:35-70``,
+    ``SentinelResourceBinding.java``): where CDI weaves an ``@AroundInvoke``
+    interceptor around every business method of a bound bean, Python's
+    idiom is a class decorator that wraps the class's own public methods
+    with :func:`sentinel_resource`. Semantics match the reference:
+
+    - every public method defined ON the class becomes a resource named
+      ``resource_fmt.format(cls=..., method=...)``;
+    - a method already bound with ``@sentinel_resource`` keeps its own
+      binding (method-level annotation wins over the class binding — the
+      CDI interceptor consults the method annotation first);
+    - dunders, private methods (``_``-prefixed), static/class methods'
+      descriptors, and non-callables are left alone;
+    - ``include(name) -> bool`` / ``exclude`` narrow the set;
+    - ``resource_kwargs`` (block_handler, fallback, entry_type, …) apply
+      to every bound method, like binding-level defaults.
+
+    Usage::
+
+        @sentinel_intercept(fallback=my_fallback)
+        class CheckoutService:
+            def checkout(self, order): ...
+            def refund(self, order): ...
+    """
+
+    def decorate(cls):
+        def bind(fn: Callable, attr: str) -> Callable:
+            return sentinel_resource(
+                resource=resource_fmt.format(cls=cls.__name__, method=attr),
+                **resource_kwargs,
+            )(fn)
+
+        for attr, member in list(vars(cls).items()):
+            if attr.startswith("_") or attr in exclude:
+                continue
+            if include is not None and not include(attr):
+                continue
+            if isinstance(member, (staticmethod, classmethod)):
+                inner = member.__func__
+                if getattr(inner, "__sentinel_resource__", None):
+                    continue
+                setattr(cls, attr, type(member)(bind(inner, attr)))
+                continue
+            # plain FUNCTIONS only: nested classes and callable instances
+            # are also callable, but wrapping them would corrupt them (a
+            # function wrapper is a descriptor — it would bind self and
+            # break isinstance/subclassing). The CDI interceptor likewise
+            # wraps business METHODS, nothing else.
+            if not inspect.isfunction(member):
+                continue
+            if getattr(member, "__sentinel_resource__", None):
+                continue  # method-level @sentinel_resource wins
+            setattr(cls, attr, bind(member, attr))
+        return cls
 
     return decorate

@@ -799,3 +799,105 @@ class TestGatewayApiDefinitions:
         list(body3)  # consume to completion also releases
         body4 = mw(dict(env), capture)
         assert statuses[-1].startswith("200")
+
+
+class TestClassInterceptor:
+    """sentinel_intercept — the CDI interceptor-binding analog
+    (SentinelResourceInterceptor.java:35-70)."""
+
+    def test_public_methods_guarded_with_formatted_names(self, manual_clock):
+        from sentinel_tpu.adapters import sentinel_intercept
+
+        @sentinel_intercept()
+        class Svc:
+            def checkout(self, x):
+                return x * 2
+
+            def _internal(self, x):  # private: untouched
+                return x
+
+        FlowRuleManager.load_rules(
+            [FlowRule(resource="Svc.checkout", count=1)]
+        )
+        s = Svc()
+        assert s.checkout(3) == 6
+        with pytest.raises(BlockException):
+            s.checkout(4)
+        assert s._internal(5) == 5  # never enters the slot chain
+        assert not hasattr(Svc._internal, "__sentinel_resource__")
+
+    def test_method_level_binding_wins(self, manual_clock):
+        from sentinel_tpu.adapters import sentinel_intercept
+
+        @sentinel_intercept()
+        class Svc:
+            @sentinel_resource("custom_name")
+            def pay(self, x):
+                return x
+
+        assert Svc.pay.__sentinel_resource__ == "custom_name"
+        FlowRuleManager.load_rules(
+            [FlowRule(resource="custom_name", count=1)]
+        )
+        s = Svc()
+        assert s.pay(1) == 1
+        with pytest.raises(BlockException):
+            s.pay(2)
+
+    def test_binding_level_fallback_and_static_methods(self, manual_clock):
+        from sentinel_tpu.adapters import sentinel_intercept
+
+        def fb(*args, ex=None, **kwargs):
+            return "fallback"
+
+        @sentinel_intercept(fallback=fb)
+        class Svc:
+            def boom(self):
+                raise ValueError("business error")
+
+            @staticmethod
+            def tally(x):
+                return x + 1
+
+        s = Svc()
+        assert s.boom() == "fallback"  # traced, then binding fallback
+        assert Svc.tally(1) == 2  # staticmethod rebound and callable
+        assert Svc.__dict__["tally"].__func__.__sentinel_resource__ == (
+            "Svc.tally"
+        )
+
+    def test_include_exclude_narrow_the_binding(self, manual_clock):
+        from sentinel_tpu.adapters import sentinel_intercept
+
+        @sentinel_intercept(exclude=("skip_me",))
+        class Svc:
+            def a(self):
+                return 1
+
+            def skip_me(self):
+                return 2
+
+        assert hasattr(Svc.a, "__sentinel_resource__")
+        assert not hasattr(Svc.skip_me, "__sentinel_resource__")
+
+    def test_nested_classes_and_callable_instances_untouched(
+        self, manual_clock
+    ):
+        import functools
+
+        from sentinel_tpu.adapters import sentinel_intercept
+
+        @sentinel_intercept()
+        class Svc:
+            class Config:  # nested class: callable, must not be wrapped
+                pass
+
+            handler = functools.partial(int, "7")  # callable instance
+
+            def work(self):
+                return 1
+
+        assert isinstance(Svc.Config, type)
+        assert isinstance(Svc().Config(), Svc.Config)
+        assert Svc().handler() == 7  # no self injected
+        assert hasattr(Svc.work, "__sentinel_resource__")

@@ -1,0 +1,66 @@
+"""Class-level guard binding: ``@sentinel_intercept`` on a service class.
+
+The CDI-interceptor deployment shape
+(``sentinel-annotation-cdi-interceptor/.../SentinelResourceInterceptor.java:35-70``):
+bind once at the class, and every public business method becomes a guarded
+resource — with a method-level ``@sentinel_resource`` override keeping its
+own name and handlers, exactly as the CDI interceptor consults the method
+annotation first.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from sentinel_tpu.adapters import sentinel_intercept, sentinel_resource
+from sentinel_tpu.local import BlockException
+from sentinel_tpu.local.flow import FlowRule, FlowRuleManager
+
+
+def degraded_quote(*args, ex=None, **kwargs):
+    return {"price": None, "degraded": True}
+
+
+@sentinel_intercept(fallback=degraded_quote)
+class PricingService:
+    """Every public method below is a resource: PricingService.quote,
+    PricingService.refresh — guarded with the binding-level fallback."""
+
+    def quote(self, sku: str):
+        return {"price": 42.0, "sku": sku}
+
+    def refresh(self):
+        raise RuntimeError("upstream catalog down")  # traced, then fallback
+
+    @sentinel_resource("pricing:vip-quote")  # method-level binding wins
+    def vip_quote(self, sku: str):
+        return {"price": 13.37, "sku": sku}
+
+
+def main() -> None:
+    FlowRuleManager.load_rules([
+        FlowRule(resource="PricingService.quote", count=2.0),
+        FlowRule(resource="pricing:vip-quote", count=1.0),
+    ])
+    svc = PricingService()
+
+    print("two quotes pass:", svc.quote("a"), svc.quote("b"))
+    print("third is shed to the binding fallback:", svc.quote("c"))
+
+    print("vip passes once:", svc.vip_quote("v"))
+    try:
+        svc.vip_quote("v2")
+    except BlockException as e:
+        print("vip blocked under its OWN name (no class fallback):",
+              type(e).__name__)
+
+    print("business error degrades:", svc.refresh())
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ FAST_EXAMPLES = [
     "namespace_partition_demo.py",
     "envoy_rls_scale_demo.py",
     "decorator_degrade_demo.py",
+    "interceptor_service_demo.py",
     "datasource_cluster_demo.py",
     "gateway_demo.py",
     "http_origin_demo.py",

@@ -28,6 +28,9 @@ def bench(monkeypatch):
     monkeypatch.setattr(
         mod, "_served_rate", lambda: {"verdicts_per_sec": 1}
     )
+    # safety net: the real probe spawns a subprocess that would claim the
+    # actual device from a test — stub it; tests override as needed
+    monkeypatch.setattr(mod, "_wait_device_free", lambda budget_s: True)
     return mod
 
 
@@ -38,8 +41,42 @@ def _doc(backend):
     }
 
 
-def test_dead_tunnel_skips_remaining_tpu_attempts(bench, monkeypatch, capsys):
+def test_sick_signature_skips_remaining_tpu_attempts(bench, monkeypatch,
+                                                     capsys):
+    """A tpu attempt that self-terminates with the deterministic
+    sick-terminal signature (~1502s per claim) marks the tunnel dead:
+    tpu-retry is skipped without burning its deadline, and no probe (no
+    potential client kill) is needed."""
     calls = []
+    probes = []
+
+    def fake_attempt(name, cfg, deadline_s):
+        calls.append(name)
+        if cfg.get("platform") != "cpu":
+            return (None, "RuntimeError: backend init failed with "
+                    "sick-terminal signature: UNAVAILABLE: "
+                    "TPU backend setup/compile error", False)
+        return _doc("cpu"), None, False
+
+    monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
+    monkeypatch.setattr(
+        bench, "_wait_device_free", lambda b: probes.append(1) or True
+    )
+    monkeypatch.setattr(bench, "_latest_tpu_result", lambda: {"value": 5})
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert calls == ["tpu-full", "cpu-fallback"]
+    assert probes == []  # clean self-exit: no probe, no kill risk
+    assert "skipped" in out["extra"]["prior_failures"]["tpu-retry"]
+    assert "sick-terminal" in out["extra"]["prior_failures"]["tpu-full"]
+    assert out["extra"]["last_tpu_result"] == {"value": 5}
+
+
+def test_midrun_wedge_skips_remaining_tpu_attempts(bench, monkeypatch, capsys):
+    """Pregate healthy, but the tunnel wedges during tpu-full: the
+    post-attempt probe (False) must skip tpu-retry."""
+    calls = []
+    probes = []
 
     def fake_attempt(name, cfg, deadline_s):
         calls.append(name)
@@ -47,12 +84,15 @@ def test_dead_tunnel_skips_remaining_tpu_attempts(bench, monkeypatch, capsys):
             return None, "timeout after Ns with no JSON line", True
         return _doc("cpu"), None, False
 
+    def probe(budget_s):
+        probes.append(budget_s)
+        return False  # post-attempt probe: tunnel wedged
+
     monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
-    monkeypatch.setattr(bench, "_wait_device_free", lambda budget_s: False)
+    monkeypatch.setattr(bench, "_wait_device_free", probe)
     monkeypatch.setattr(bench, "_latest_tpu_result", lambda: {"value": 5})
     bench.main()
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    # tpu-full ran, tpu-retry was skipped (probe said dead), cpu ran
     assert calls == ["tpu-full", "cpu-fallback"]
     assert "skipped" in out["extra"]["prior_failures"]["tpu-retry"]
     assert out["extra"]["last_tpu_result"] == {"value": 5}

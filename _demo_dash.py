@@ -120,6 +120,10 @@ def _assign_cycle():
     assert per_m, "no per-machine samples"
     print(f"CYCLE per-machine chart: {len(per_m)} samples from {mkeys[0]}, "
           f"last passQps={per_m[-1]['passQps']}", flush=True)
+    # identity.js analog: that machine's own resource list by volume
+    res = _dash_json(f"resources?app={app}&machine={mkeys[0]}")
+    assert "GET:/checkout" in res, res
+    print(f"CYCLE machine resources: {res}", flush=True)
     print("CYCLE OK", flush=True)
 
 

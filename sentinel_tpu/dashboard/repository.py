@@ -144,20 +144,48 @@ class InMemoryMetricsRepository:
                 and any(t >= horizon for t in series)
             )
 
+    @staticmethod
+    def _by_volume(pairs, now: int, horizon: int) -> List[str]:
+        """Resources sorted by last-minute pass+block volume, live
+        (in-retention) series only — the reference's sidebar order. One
+        implementation for the app-wide and per-machine views so the two
+        sidebars can never diverge."""
+        volume: Dict[str, float] = {}
+        for resource, series in pairs:
+            if not any(t >= horizon for t in series):
+                continue
+            volume[resource] = sum(
+                e.pass_qps + e.block_qps
+                for ts, e in series.items()
+                if ts >= now - 60_000
+            )
+        return sorted(volume, key=lambda r: (-volume[r], r))
+
+    def resources_of_machine(self, app: str, machine: str) -> List[str]:
+        """One machine's resources sorted by its own recent volume
+        (``identity.js`` analog: the per-machine resource view)."""
+        now = _clock.now_ms()
+        with self._lock:
+            return self._by_volume(
+                (
+                    (resource, series)
+                    for (a, m, resource), series
+                    in self._machine_store.items()
+                    if a == app and m == machine
+                ),
+                now, now - self.retention_ms,
+            )
+
     def resources_of_app(self, app: str) -> List[str]:
         """Resources sorted by recent pass+block volume (the reference sorts
         the sidebar by last-minute QPS); past-retention series are excluded."""
         now = _clock.now_ms()
-        horizon = now - self.retention_ms
         with self._lock:
-            volume: Dict[str, float] = {}
-            for (a, resource), series in self._store.items():
-                if a != app or not any(t >= horizon for t in series):
-                    continue
-                v = sum(
-                    e.pass_qps + e.block_qps
-                    for ts, e in series.items()
-                    if ts >= now - 60_000
-                )
-                volume[resource] = v
-            return sorted(volume, key=lambda r: (-volume[r], r))
+            return self._by_volume(
+                (
+                    (resource, series)
+                    for (a, resource), series in self._store.items()
+                    if a == app
+                ),
+                now, now - self.retention_ms,
+            )

@@ -85,6 +85,10 @@ _INDEX_HTML = """<!doctype html>
  <h2>cluster monitor: <span id="clusterapp"></span></h2>
  <div id="clusterview"></div>
 </div>
+<div id="machined" style="display:none">
+ <h2>machine: <span id="machineres"></span></h2>
+ <div id="machineview"></div>
+</div>
 <div id="chartwrap" style="display:none">
  <h2>timeline: <span id="chartres"></span></h2>
  <div class="legend">machine <select id="chartmachine"
@@ -302,9 +306,9 @@ async function assign(app, machine){
 // selector switches between the app-wide sum and one machine's own series
 let chartData = null;
 let chartCtx = {app:'', resource:'', machine:''};
-async function openChart(app, resource){
+async function openChart(app, resource, machine){
   document.getElementById('chartwrap').style.display = '';
-  chartCtx = {app, resource, machine:''};
+  chartCtx = {app, resource, machine: machine || ''};
   const sel = document.getElementById('chartmachine');
   sel.innerHTML = '';
   const all = document.createElement('option');
@@ -317,8 +321,50 @@ async function openChart(app, resource){
       o.value = mk; o.textContent = mk; sel.appendChild(o);
     }
   } catch(e){}
-  sel.value = '';
+  if (chartCtx.machine &&
+      ![...sel.options].some(o => o.value === chartCtx.machine)){
+    // the machines fetch failed or lagged: add the requested machine so
+    // the selector always names the series actually plotted
+    const o = document.createElement('option');
+    o.value = chartCtx.machine; o.textContent = chartCtx.machine;
+    sel.appendChild(o);
+  }
+  sel.value = chartCtx.machine;
   await loadChart();
+}
+// ---- per-machine resource view (identity.js analog) ----
+let machineSeq = 0;
+async function openMachine(app, mkey){
+  const seq = ++machineSeq;  // a newer click supersedes this render
+  const d = document.getElementById('machined');
+  d.style.display = '';
+  document.getElementById('machineres').textContent = mkey;
+  const view = document.getElementById('machineview');
+  view.innerHTML = '';
+  const res = await api(`resources?app=${encodeURIComponent(app)}` +
+    `&machine=${encodeURIComponent(mkey)}`);
+  const now = Date.now();
+  const series = await Promise.all(res.map(r =>
+    api(`metric?app=${encodeURIComponent(app)}` +
+      `&identity=${encodeURIComponent(r)}&machine=${encodeURIComponent(mkey)}` +
+      `&startTime=${now-15000}&endTime=${now}`).catch(() => [])));
+  if (seq !== machineSeq) return;  // superseded while fetching
+  const t = document.createElement('table');
+  row(t, ['resource', 'pass qps', 'block qps', 'rt ms', ''], 'th');
+  res.forEach((r, i) => {
+    const last = series[i][series[i].length-1] || {};
+    const cb = document.createElement('button');
+    cb.textContent = 'timeline';
+    cb.onclick = () => openChart(app, r, mkey);
+    row(t, [r, last.passQps??'', last.blockQps??'', last.rt??'', cb]);
+  });
+  view.appendChild(t);
+  if (!res.length){
+    const p = document.createElement('p');
+    p.className = 'legend';
+    p.textContent = 'no live samples from this machine';
+    view.appendChild(p);
+  }
 }
 async function loadChart(){
   const {app, resource, machine} = chartCtx;
@@ -571,9 +617,14 @@ async function refresh(){
       const abtn = document.createElement('button');
       abtn.textContent = 'make token server';
       abtn.onclick = () => assign(app.name, key);
+      const rbtn = document.createElement('button');
+      rbtn.textContent = 'resources';
+      rbtn.onclick = () => openMachine(app.name, key);
+      const cell = document.createElement('span');
+      cell.appendChild(rbtn); cell.appendChild(abtn);
       row(mt, [key, m.version,
                {text: m.healthy?'healthy':'dead', cls: m.healthy?'ok':'dead'},
-               MODES[String(modes[key])] ?? '?', abtn]);
+               MODES[String(modes[key])] ?? '?', cell]);
     }
     root.appendChild(mt);
     const res = await api('resources?app='+encodeURIComponent(app.name));
@@ -721,6 +772,13 @@ class DashboardServer:
                 for app in self.apps.apps()
             ]
         if path == "resources":
+            # app-wide, or one machine's own resource list when
+            # ``machine=ip:port`` is given (identity.js analog)
+            machine = params.get("machine", "")
+            if machine:
+                return self.repository.resources_of_machine(
+                    params.get("app", ""), machine
+                )
             return self.repository.resources_of_app(params.get("app", ""))
         if path == "metric":
             # app-wide merged series, or one machine's own series when

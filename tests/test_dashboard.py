@@ -731,6 +731,10 @@ class TestPerMachineDrilldown:
             assert [e["passQps"] for e in per_m] == [5]
             machines = _get(dash.port, "metric/machines?app=svc&identity=res")
             assert machines == ["10.0.0.1:1", "10.0.0.2:1"]
+            # identity.js analog: one machine's own resource list
+            res = _get(dash.port, "resources?app=svc&machine=10.0.0.1:1")
+            assert res == ["res"]
+            assert _get(dash.port, "resources?app=svc&machine=10.9.9.9:1") == []
         finally:
             dash.stop()
 

@@ -694,21 +694,21 @@ SICK_SIGNATURE = "TPU backend setup/compile error"
 def main() -> None:
     errors = {}
     prev_terminated = False
-    tpu_dead = False
+    tpu_dead = None  # None = unknown; else the skip reason string
     for name, cfg, deadline_s in ATTEMPTS:
         if cfg.get("platform") != "cpu":
             if tpu_dead:
-                # the probe already proved the tunnel can't grant a claim;
-                # burning this attempt's deadline would end the same way
-                errors[name] = "skipped: device probe could not claim TPU"
+                # a prior attempt already proved the tunnel can't grant a
+                # claim; burning this deadline would end the same way
+                errors[name] = f"skipped: {tpu_dead}"
                 continue
             # probe budget = this attempt's own deadline: if a claim can't
             # land inside it, the attempt itself couldn't have measured
             # anything — so skipping on a False probe is provably safe even
             # for a transiently draining grant queue
             if prev_terminated and not _wait_device_free(deadline_s):
-                tpu_dead = True
-                errors[name] = "skipped: device probe could not claim TPU"
+                tpu_dead = "device probe could not claim TPU"
+                errors[name] = f"skipped: {tpu_dead}"
                 continue
         doc, err, prev_terminated = _run_attempt(name, cfg, deadline_s)
         if doc is not None:
@@ -741,7 +741,7 @@ def main() -> None:
             # clean self-terminated failure carrying the deterministic
             # sick-terminal signature: every later claim this run would
             # fail identically — skip straight to the CPU rung
-            tpu_dead = True
+            tpu_dead = f"prior attempt hit sick-terminal signature ({name})"
     # Every attempt failed — still emit the JSON line the driver parses.
     out = json.dumps(
         {

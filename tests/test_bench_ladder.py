@@ -67,7 +67,8 @@ def test_sick_signature_skips_remaining_tpu_attempts(bench, monkeypatch,
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert calls == ["tpu-full", "cpu-fallback"]
     assert probes == []  # clean self-exit: no probe, no kill risk
-    assert "skipped" in out["extra"]["prior_failures"]["tpu-retry"]
+    assert ("skipped: prior attempt hit sick-terminal signature (tpu-full)"
+            == out["extra"]["prior_failures"]["tpu-retry"])
     assert "sick-terminal" in out["extra"]["prior_failures"]["tpu-full"]
     assert out["extra"]["last_tpu_result"] == {"value": 5}
 

@@ -314,3 +314,87 @@ class TestFrontdoorFuzz:
         # around arena-full mid-hostility without wedging
         out = run_fuzz(iters=40, seed=99, arena_cap=16, oracle_every=5)
         assert out["oracle_checks"] >= 9
+
+
+class TestNativeMixedSoak:
+    def test_mixed_planes_under_reload(self, native_server):
+        """Data-plane BATCH_FLOW, control-plane PARAM_FLOW and
+        CONCURRENT acquire/release, all interleaved over several
+        connections while rules reload continuously: the arena, control
+        queue, pipelined dispatch, and rules mutex must never hand back a
+        FAIL or raise. (The interaction spot the per-plane tests can't
+        reach.)"""
+        import numpy as np
+
+        from sentinel_tpu.cluster.concurrent import ConcurrentFlowRule
+        from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
+
+        server, svc = native_server
+        svc.load_param_rules([ClusterParamFlowRule(flow_id=3, count=1e9)])
+        svc.load_concurrent_rules(
+            [ConcurrentFlowRule(flow_id=9, concurrency_level=8)]
+        )
+        stop = threading.Event()
+        failures = []
+
+        def flow_pump():
+            c = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+            ids = np.full(32, 2, np.int64)  # flow 2: count 1e9
+            while not stop.is_set():
+                out = c.request_batch_arrays(ids)
+                if out is None:
+                    failures.append("flow timeout")
+                    break
+                if (out[0] == int(TokenStatus.FAIL)).any():
+                    failures.append("flow FAIL status")
+                    break
+            c.close()
+
+        def param_pump():
+            c = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+            k = 0
+            while not stop.is_set():
+                k += 1
+                r = c.request_params_token(3, 1, [k % 50, 7])
+                if int(r.status) == int(TokenStatus.FAIL):
+                    failures.append("param FAIL")
+                    break
+            c.close()
+
+        def conc_pump():
+            c = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+            while not stop.is_set():
+                r = c.request_concurrent_token(9)
+                if r.ok and r.token_id:
+                    rel = c.release_concurrent_token(r.token_id)
+                    if not rel.ok:
+                        failures.append("release failed")
+                        break
+                elif int(r.status) == int(TokenStatus.FAIL):
+                    failures.append("concurrent FAIL")
+                    break
+            c.close()
+
+        threads = [
+            threading.Thread(target=flow_pump),
+            threading.Thread(target=flow_pump),
+            threading.Thread(target=param_pump),
+            threading.Thread(target=conc_pump),
+        ]
+        for t in threads:
+            t.start()
+        from sentinel_tpu.engine import ClusterFlowRule
+
+        for i in range(20):  # continuous reloads against live traffic
+            svc.load_rules([
+                ClusterFlowRule(flow_id=1, count=5.0, mode=G),
+                ClusterFlowRule(flow_id=2, count=1e9, mode=G),
+                ClusterFlowRule(flow_id=50 + i, count=1.0, mode=G),
+            ])
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert failures == []
+        # semaphore fully released after the soak
+        assert svc.concurrency.now_calls(9) == 0

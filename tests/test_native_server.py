@@ -322,58 +322,70 @@ class TestNativeMixedSoak:
         CONCURRENT acquire/release, all interleaved over several
         connections while rules reload continuously: the arena, control
         queue, pipelined dispatch, and rules mutex must never hand back a
-        FAIL or raise. (The interaction spot the per-plane tests can't
-        reach.)"""
-        import numpy as np
-
+        non-OK verdict for the always-loaded rules, raise, or wedge a
+        client. (The interaction spot the per-plane tests can't reach.)"""
         from sentinel_tpu.cluster.concurrent import ConcurrentFlowRule
         from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
 
         server, svc = native_server
         svc.load_param_rules([ClusterParamFlowRule(flow_id=3, count=1e9)])
+        # timeout far above the soak duration: a descheduled holder must
+        # not have its token swept mid-test (that would be a flake, and
+        # the final now_calls assertion covers leaks anyway)
         svc.load_concurrent_rules(
-            [ConcurrentFlowRule(flow_id=9, concurrency_level=8)]
+            [ConcurrentFlowRule(flow_id=9, concurrency_level=8,
+                                resource_timeout_ms=60_000)]
         )
         stop = threading.Event()
         failures = []
 
-        def flow_pump():
-            c = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
-            ids = np.full(32, 2, np.int64)  # flow 2: count 1e9
+        def guarded(body):
+            def run():
+                c = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+                try:
+                    body(c)
+                except Exception as e:  # a raise IS a soak failure
+                    failures.append(f"{type(e).__name__}: {e}")
+                finally:
+                    c.close()
+            return run
+
+        @guarded
+        def flow_pump(c):
+            ids = np.full(32, 2, np.int64)  # flow 2: count 1e9, always loaded
             while not stop.is_set():
                 out = c.request_batch_arrays(ids)
                 if out is None:
                     failures.append("flow timeout")
-                    break
-                if (out[0] == int(TokenStatus.FAIL)).any():
-                    failures.append("flow FAIL status")
-                    break
-            c.close()
+                    return
+                if (out[0] != int(TokenStatus.OK)).any():
+                    failures.append(
+                        f"flow non-OK statuses {set(out[0].tolist())}"
+                    )
+                    return
 
-        def param_pump():
-            c = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+        @guarded
+        def param_pump(c):
             k = 0
             while not stop.is_set():
                 k += 1
                 r = c.request_params_token(3, 1, [k % 50, 7])
-                if int(r.status) == int(TokenStatus.FAIL):
-                    failures.append("param FAIL")
-                    break
-            c.close()
+                if int(r.status) != int(TokenStatus.OK):
+                    failures.append(f"param status {r.status}")
+                    return
 
-        def conc_pump():
-            c = TokenClient("127.0.0.1", server.port, timeout_ms=5000)
+        @guarded
+        def conc_pump(c):
             while not stop.is_set():
                 r = c.request_concurrent_token(9)
                 if r.ok and r.token_id:
                     rel = c.release_concurrent_token(r.token_id)
                     if not rel.ok:
-                        failures.append("release failed")
-                        break
+                        failures.append(f"release status {rel.status}")
+                        return
                 elif int(r.status) == int(TokenStatus.FAIL):
                     failures.append("concurrent FAIL")
-                    break
-            c.close()
+                    return
 
         threads = [
             threading.Thread(target=flow_pump),
@@ -383,8 +395,6 @@ class TestNativeMixedSoak:
         ]
         for t in threads:
             t.start()
-        from sentinel_tpu.engine import ClusterFlowRule
-
         for i in range(20):  # continuous reloads against live traffic
             svc.load_rules([
                 ClusterFlowRule(flow_id=1, count=5.0, mode=G),
@@ -395,6 +405,7 @@ class TestNativeMixedSoak:
         stop.set()
         for t in threads:
             t.join(timeout=30)
+        assert [t for t in threads if t.is_alive()] == []  # no wedged pump
         assert failures == []
         # semaphore fully released after the soak
         assert svc.concurrency.now_calls(9) == 0

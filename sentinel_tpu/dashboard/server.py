@@ -27,6 +27,7 @@ from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
 from sentinel_tpu.dashboard.fetcher import MetricFetcher
 from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository
 from sentinel_tpu.dashboard.rules_repo import InMemoryRuleRepository
+from sentinel_tpu.dashboard.validation import validate_rule
 
 RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow", "gateway")
 
@@ -815,7 +816,16 @@ class DashboardServer:
             if not machines:
                 return {"error": f"no healthy machine for app {app}"}
             if method == "POST":
-                rules = json.loads(body)
+                try:
+                    rules = json.loads(body)
+                except (json.JSONDecodeError, TypeError):
+                    return {"error": "body is not valid JSON"}
+                if not isinstance(rules, list):
+                    return {"error": "body must be a JSON array of rules"}
+                for i, r in enumerate(rules):
+                    bad = validate_rule(rule_type, r)
+                    if bad:
+                        return {"error": f"rule[{i}]: {bad}"}
                 pushed = sum(
                     self.client.push_rules(m, rule_type, rules) for m in machines
                 )
@@ -854,14 +864,22 @@ class DashboardServer:
                 if live is None:
                     return {"error": "fetch from app failed"}
                 self.rules.sync(app, rule_type, live)
-            if method == "POST":
-                rule = json.loads(body)
+            if method in ("POST", "PUT"):
+                try:
+                    rule = json.loads(body)
+                except (json.JSONDecodeError, TypeError):
+                    return {"error": "body is not valid JSON"}
+                # reject malformed rules BEFORE storing/pushing — the
+                # reference's checkEntityInternal chains
+                # (FlowControllerV1.java:89-134); see dashboard/validation
+                bad = validate_rule(rule_type, rule)
+                if bad:
+                    return {"error": bad}
                 rule.pop("id", None)
+            if method == "POST":
                 rule_id = self.rules.add(app, rule_type, rule)
             elif method == "PUT":
                 rule_id = int(params.get("id", 0))
-                rule = json.loads(body)
-                rule.pop("id", None)
                 if not self.rules.update(app, rule_type, rule_id, rule):
                     return {"error": f"no rule with id {rule_id}"}
             elif method == "DELETE":

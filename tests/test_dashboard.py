@@ -881,3 +881,99 @@ class TestAssignManagement:
             assert state["servers"] == []
         finally:
             dash.stop()
+
+
+class TestRuleValidation:
+    """Server-side rule validation (checkEntityInternal analogs): malformed
+    rules are rejected with a named reason BEFORE storing or pushing."""
+
+    def test_validators_direct(self):
+        from sentinel_tpu.dashboard.validation import validate_rule
+
+        ok = {
+            "flow": {"resource": "r", "count": 5, "grade": 1},
+            "degrade": {"resource": "r", "grade": 2, "count": 3,
+                        "timeWindow": 10},
+            "system": {"qps": 100},
+            "authority": {"resource": "r", "limitApp": "a", "strategy": 0},
+            "paramFlow": {"resource": "r", "paramIdx": 0, "count": 5},
+            "gateway": {"resource": "r", "count": 5, "resourceMode": 0},
+        }
+        for t, rule in ok.items():
+            assert validate_rule(t, rule) is None, (t, rule)
+        bad = [
+            ("flow", {"count": 5}, "resource"),
+            ("flow", {"resource": "r", "grade": 7}, "grade"),
+            ("flow", {"resource": "r", "count": -1}, "count"),
+            ("flow", {"resource": "r", "strategy": 1}, "refResource"),
+            ("flow", {"resource": "r", "count": "x"}, "count"),
+            ("degrade", {"resource": "r", "grade": 0, "count": 1,
+                         "timeWindow": 0}, "timeWindow"),
+            ("degrade", {"resource": "r", "grade": 5, "count": 1,
+                         "timeWindow": 1}, "strategy"),
+            ("degrade", {"resource": "r", "grade": 0, "count": 1,
+                         "timeWindow": 1, "slowRatioThreshold": 2},
+             "slowRatioThreshold"),
+            ("system", {}, "threshold"),
+            ("system", {"highestCpuUsage": 3}, "highestCpuUsage"),
+            ("authority", {"resource": "r", "limitApp": ""}, "limitApp"),
+            ("paramFlow", {"resource": "r", "paramIdx": -1, "count": 1},
+             "paramIdx"),
+            ("paramFlow", {"resource": "r", "paramIdx": 0.5, "count": 1},
+             "paramIdx"),
+            ("gateway", {"resource": "r", "count": 1, "resourceMode": 9},
+             "resourceMode"),
+            ("flow", [], "JSON object"),
+        ]
+        for t, rule, needle in bad:
+            err = validate_rule(t, rule)
+            assert err and needle in err, (t, rule, err)
+
+    def test_crud_rejects_invalid_before_any_push(self):
+        from sentinel_tpu.transport.command import CommandCenter
+
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            out = _req(dash.port, "v1/rule?app=svc&type=flow", "POST",
+                       {"resource": "r", "grade": 42})
+            assert "grade" in out.get("error", "")
+            # nothing was stored or pushed: the live agent has no rules
+            assert _req(dash.port, "rules?app=svc&type=flow") == []
+            # bulk push validates each element with its index
+            out = _req(dash.port, "rules?app=svc&type=flow", "POST",
+                       [{"resource": "a", "count": 1},
+                        {"resource": "", "count": 1}])
+            assert "rule[1]" in out.get("error", "")
+            assert _req(dash.port, "rules?app=svc&type=flow") == []
+        finally:
+            cc.stop()
+            dash.stop()
+
+    def test_malformed_json_body_is_a_clean_error(self):
+        from sentinel_tpu.transport.command import CommandCenter
+
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            for path, method in (("v1/rule?app=svc&type=flow", "POST"),
+                                 ("v1/rule?app=svc&type=flow&id=1", "PUT"),
+                                 ("rules?app=svc&type=flow", "POST")):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{dash.port}/{path}",
+                    data=b"{not json", method=method,
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    out = json.loads(r.read())
+                assert out.get("error") == "body is not valid JSON", (path, out)
+        finally:
+            cc.stop()
+            dash.stop()

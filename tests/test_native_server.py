@@ -387,11 +387,13 @@ class TestNativeMixedSoak:
                     failures.append("concurrent FAIL")
                     return
 
+        # daemon: a wedged pump must FAIL the test (the is_alive assert),
+        # not hang interpreter shutdown joining a non-daemon thread forever
         threads = [
-            threading.Thread(target=flow_pump),
-            threading.Thread(target=flow_pump),
-            threading.Thread(target=param_pump),
-            threading.Thread(target=conc_pump),
+            threading.Thread(target=flow_pump, daemon=True),
+            threading.Thread(target=flow_pump, daemon=True),
+            threading.Thread(target=param_pump, daemon=True),
+            threading.Thread(target=conc_pump, daemon=True),
         ]
         for t in threads:
             t.start()

@@ -393,50 +393,70 @@ class _LoopWorker:
                 results[i] = (int(TokenStatus.FAIL), 0, 0, 0)
 
         host_side = [
-            run_one(i, req)
+            (i, req)
             for i, (req, _) in enumerate(batch)
             if not isinstance(req, _BatchFrame)
             and req.msg_type != P.MsgType.FLOW
         ]
-        if host_side:
-            await asyncio.gather(*host_side)
+        is_host_side = {i for i, _ in host_side}
 
-        writers_to_drain = set()
-        for i, (item, writer) in enumerate(batch):
-            try:
-                if isinstance(item, _BatchFrame):
-                    sliced = frame_slices.get(i)
-                    if sliced is None:  # only when the frame was empty
-                        k = len(item.flow_ids)
-                        sliced = (
-                            np.full(k, int(TokenStatus.FAIL), np.int8),
-                            np.zeros(k, np.int32),
-                            np.zeros(k, np.int32),
-                        )
-                    status, remaining, wait = sliced
-                    writer.write(
-                        P.encode_batch_response(item.xid, status, remaining, wait)
-                    )
-                else:
-                    st, remaining, wait, token_id = results.get(
-                        i, (int(TokenStatus.FAIL), 0, 0, 0)
-                    )
-                    writer.write(
-                        P.encode_response(
-                            P.FlowResponse(
-                                item.xid, item.msg_type, st, remaining, wait,
-                                token_id,
+        async def write_out(indices) -> None:
+            writers_to_drain = set()
+            for i in indices:
+                item, writer = batch[i]
+                try:
+                    if isinstance(item, _BatchFrame):
+                        sliced = frame_slices.get(i)
+                        if sliced is None:  # only when the frame was empty
+                            k = len(item.flow_ids)
+                            sliced = (
+                                np.full(k, int(TokenStatus.FAIL), np.int8),
+                                np.zeros(k, np.int32),
+                                np.zeros(k, np.int32),
+                            )
+                        status, remaining, wait = sliced
+                        writer.write(
+                            P.encode_batch_response(
+                                item.xid, status, remaining, wait
                             )
                         )
-                    )
-                writers_to_drain.add(writer)
-            except Exception:
-                pass
-        for writer in writers_to_drain:
-            try:
-                await writer.drain()
-            except Exception:
-                pass
+                    else:
+                        st, remaining, wait, token_id = results.get(
+                            i, (int(TokenStatus.FAIL), 0, 0, 0)
+                        )
+                        writer.write(
+                            P.encode_response(
+                                P.FlowResponse(
+                                    item.xid, item.msg_type, st, remaining,
+                                    wait, token_id,
+                                )
+                            )
+                        )
+                    writers_to_drain.add(writer)
+                except Exception:
+                    pass
+            for writer in writers_to_drain:
+                try:
+                    await writer.drain()
+                except Exception:
+                    pass
+
+        # flow verdicts go out the moment they're materialized, CONCURRENT
+        # with the host-side (param/concurrent) work — neither plane may
+        # queue behind the other (a stalled flow client's drain must not
+        # delay another client's CONCURRENT_RELEASE, and vice versa;
+        # responses are xid-correlated, order-free)
+        async def host_side_then_write() -> None:
+            await asyncio.gather(*(run_one(i, req) for i, req in host_side))
+            await write_out(is_host_side)
+
+        flow_write = write_out(
+            i for i in range(len(batch)) if i not in is_host_side
+        )
+        if host_side:
+            await asyncio.gather(flow_write, host_side_then_write())
+        else:
+            await flow_write
 
 
 class TokenServer:

@@ -75,6 +75,8 @@ _INDEX_HTML = """<!doctype html>
   placeholder="password"> <button onclick="login()">sign in</button>
  <span id="loginmsg" class="dead"></span>
 </div>
+<div class="legend" id="resfilterwrap" style="display:none">filter resources
+ <input id="resfilter" oninput="filterChanged()" placeholder="substring"></div>
 <div id="apps"></div>
 <div id="ruled" style="display:none">
  <h2>rules: <span id="ruleapp"></span></h2>
@@ -591,9 +593,30 @@ async function manageAssign(app, payload){
   openCluster(app);
 }
 const MODES = {'-1':'off','0':'client','1':'server'};
+// single-flight refresh: overlapping runs (interval + filter keystrokes)
+// would interleave their async appends into #apps and duplicate sections
+let refreshBusy = false, refreshAgain = false, filterTimer = null;
+function filterChanged(){
+  // debounce: the filter is client-side, but the repaint walks the full
+  // fetch loop — one run per typing pause, not per keystroke
+  clearTimeout(filterTimer);
+  filterTimer = setTimeout(refresh, 300);
+}
 async function refresh(){
+  if (refreshBusy){ refreshAgain = true; return; }
+  refreshBusy = true;
+  try { await refreshOnce(); }
+  finally {
+    refreshBusy = false;
+    if (refreshAgain){ refreshAgain = false; refresh(); }
+  }
+}
+async function refreshOnce(){
   let apps;
   try { apps = await api('apps'); } catch(e){ return; }
+  // authenticated and serving: reveal the filter control (it starts
+  // hidden so the login screen shows no stray live input)
+  document.getElementById('resfilterwrap').style.display = '';
   const root = document.getElementById('apps');
   root.innerHTML = '';
   for (const app of apps){
@@ -628,7 +651,11 @@ async function refresh(){
                MODES[String(modes[key])] ?? '?', cell]);
     }
     root.appendChild(mt);
-    const res = await api('resources?app='+encodeURIComponent(app.name));
+    let res = await api('resources?app='+encodeURIComponent(app.name));
+    // client-side substring filter (the reference sidebar's search box);
+    // the input lives outside #apps so it survives the 3s re-render
+    const f = (document.getElementById('resfilter').value || '').toLowerCase();
+    if (f) res = res.filter(r => r.toLowerCase().includes(f));
     const rt = document.createElement('table');
     row(rt, ['resource', 'pass qps', 'block qps', 'rt ms', ''], 'th');
     const now = Date.now();

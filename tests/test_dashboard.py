@@ -977,3 +977,35 @@ class TestRuleValidation:
         finally:
             cc.stop()
             dash.stop()
+
+
+class TestHeartbeatFailover:
+    def test_second_dashboard_receives_when_first_is_dead(self):
+        """Multiple dashboard addresses are tried in order
+        (HeartbeatSenderInitFunc's comma list): a dead first address must
+        not lose the registration."""
+        from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+        dash = DashboardServer(port=0).start()
+        try:
+            hb = HeartbeatSender(
+                dashboard_addrs=["127.0.0.1:1", f"127.0.0.1:{dash.port}"],
+                command_port=4321, client_ip="127.0.0.1",
+            )
+            assert hb.send_once() is True
+            machines = [
+                m for app in dash.apps.apps()
+                for m in dash.apps.machines(app)
+            ]
+            assert [m.port for m in machines] == [4321]
+        finally:
+            dash.stop()
+
+    def test_all_dead_reports_false(self):
+        from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+        hb = HeartbeatSender(
+            dashboard_addrs=["127.0.0.1:1", "127.0.0.1:2"],
+            command_port=1, client_ip="127.0.0.1",
+        )
+        assert hb.send_once() is False

@@ -521,6 +521,12 @@ class TokenServer:
     def start(self) -> None:
         if self._workers:
             return
+        # trigger the native library's lazy autobuild (fresh checkouts) at
+        # STARTUP, alongside kernel warmup — never inside the first
+        # request's frame decode
+        from sentinel_tpu.native import lib as _native_lib
+
+        _native_lib.load()
         warmup = getattr(self.service, "warmup", None)
         if warmup is not None:
             warmup()  # compile the decision kernels before accepting traffic

@@ -24,6 +24,11 @@ def build(verbose: bool = True) -> str:
     cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
     if cxx is None:
         raise RuntimeError("no C++ compiler found (need g++ or c++ on PATH)")
+    # compile to a temp name, then atomically rename: a concurrent loader
+    # must never CDLL a half-written library, and an interrupted compile
+    # must not leave a corrupt artifact that pins every later run to the
+    # pure-Python fallback
+    tmp = f"{OUTPUT}.build-{os.getpid()}"
     cmd = [
         cxx,
         "-O3",
@@ -34,12 +39,26 @@ def build(verbose: bool = True) -> str:
         "-shared",
         "-pthread",
         "-o",
-        OUTPUT,
+        tmp,
         *SOURCES,
     ]
     if verbose:
         print("+", " ".join(cmd), file=sys.stderr)
-    subprocess.run(cmd, check=True)
+    try:
+        # quiet mode captures compiler chatter: the lazy autobuild promises
+        # to degrade silently, so -Wall noise must not hit the host app's
+        # stderr (the output is surfaced in the raised error on failure)
+        subprocess.run(
+            cmd, check=True,
+            capture_output=not verbose, text=True,
+        )
+        os.replace(tmp, OUTPUT)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
     return OUTPUT
 
 

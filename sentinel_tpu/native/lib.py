@@ -117,13 +117,35 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def load() -> Optional[ctypes.CDLL]:
-    """Load (once) the native library; None if not built or unloadable."""
+    """Load (once) the native library; on a fresh checkout, build it first.
+
+    The ``.so`` is a build artifact (gitignored), so first use on a clean
+    tree compiles it with the ambient C++ toolchain (~seconds; same
+    command as ``make -C native``). Failures degrade to the pure-Python
+    paths exactly as a missing library always has. Set
+    ``SENTINEL_NATIVE_AUTOBUILD=0`` to disable, or ``SENTINEL_NATIVE_SO``
+    to point at a prebuilt library (never auto-built over).
+    """
     global _lib, _load_failed
     if _lib is not None or _load_failed:
         return _lib
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
+        if not os.path.exists(_SO_PATH):
+            if (
+                "SENTINEL_NATIVE_SO" in os.environ
+                or os.environ.get("SENTINEL_NATIVE_AUTOBUILD") == "0"
+            ):
+                _load_failed = True
+                return None
+            try:
+                from sentinel_tpu.native.build import build
+
+                build(verbose=False)
+            except Exception:
+                _load_failed = True
+                return None
         if not os.path.exists(_SO_PATH):
             _load_failed = True
             return None

@@ -378,7 +378,7 @@ def _measure(cfg: dict) -> None:
     # service actually dispatches). Same chained-scan method, smaller K.
     def _buckets():
         per_bucket = {}
-        for bucket in cfg.get("serve_buckets", (64, 1024, 4096)):
+        for bucket in cfg.get("serve_buckets", (64, 1024, 4096, 16384)):
             cfgb = config._replace(batch_size=bucket)
             slots_b = np.sort(rng.integers(0, n_flows, size=bucket)).tolist()
             batch_b = jax.tree.map(jnp.asarray, make_batch(cfgb, slots_b))

@@ -15,6 +15,15 @@ from sentinel_tpu.dashboard.repository import InMemoryMetricsRepository, MetricE
 from sentinel_tpu.dashboard.api_client import ApiClient
 from sentinel_tpu.dashboard.fetcher import MetricFetcher
 from sentinel_tpu.dashboard.server import DashboardServer
+from sentinel_tpu.dashboard.dynamic_rules import (
+    ApiRuleProvider,
+    ApiRulePublisher,
+    DynamicRuleProvider,
+    DynamicRulePublisher,
+    FileRuleStore,
+    StoreRuleProvider,
+    StoreRulePublisher,
+)
 
 __all__ = [
     "AppManagement",
@@ -24,4 +33,11 @@ __all__ = [
     "ApiClient",
     "MetricFetcher",
     "DashboardServer",
+    "DynamicRuleProvider",
+    "DynamicRulePublisher",
+    "ApiRuleProvider",
+    "ApiRulePublisher",
+    "StoreRuleProvider",
+    "StoreRulePublisher",
+    "FileRuleStore",
 ]

@@ -682,11 +682,17 @@ class DashboardServer:
         port: int = 8080,
         fetch_interval_s: float = 1.0,
         auth: Optional[Tuple[str, str]] = None,
+        rule_plugins: Optional[dict] = None,
     ):
         """``auth=(username, password)`` enables login (the reference's
         ``sentinel.dashboard.auth.username/password`` simple auth); default
         is open access, matching the reference's default ``sentinel/sentinel``
-        stance for dev use."""
+        stance for dev use.
+
+        ``rule_plugins`` maps rule type → ``(DynamicRuleProvider,
+        DynamicRulePublisher)`` and backs the ``v2/rules`` route
+        (FlowControllerV2 analog — see dashboard/dynamic_rules.py): types
+        without a plugin fall back to the direct-to-machine Api pair."""
         self.apps = AppManagement()
         self.repository = InMemoryMetricsRepository()
         self.rules = InMemoryRuleRepository()
@@ -694,6 +700,7 @@ class DashboardServer:
         self.fetcher = MetricFetcher(
             self.apps, self.repository, self.client, fetch_interval_s
         )
+        self.rule_plugins = dict(rule_plugins or {})
         self.auth = auth
         # token → expiry-ms; bounded and TTL'd (an unbounded forever-valid
         # session set would grow with every login and keep stolen cookies
@@ -858,6 +865,53 @@ class DashboardServer:
                 )
                 return {"pushed": pushed, "machines": len(machines)}
             return self.client.fetch_rules(machines[0], rule_type)
+        if path == "v2/rules":
+            # pluggable provider/publisher route (FlowControllerV2.java:63-64
+            # analog): GET reads the authoritative list through the type's
+            # DynamicRuleProvider, POST validates then hands the WHOLE list
+            # to its DynamicRulePublisher — with a store-backed plugin the
+            # dashboard never touches the machines; their datasource
+            # watchers converge on the store
+            app = params.get("app", "")
+            rule_type = params.get("type", "flow")
+            if rule_type not in RULE_TYPES:
+                return {"error": f"unknown rule type {rule_type}"}
+            plugin = self.rule_plugins.get(rule_type)
+            if plugin is None:
+                from sentinel_tpu.dashboard.dynamic_rules import (
+                    ApiRuleProvider,
+                    ApiRulePublisher,
+                )
+
+                plugin = (
+                    ApiRuleProvider(self.apps, self.client, rule_type),
+                    ApiRulePublisher(self.apps, self.client, rule_type),
+                )
+                self.rule_plugins[rule_type] = plugin
+            provider, publisher = plugin
+            if method == "POST":
+                try:
+                    rules = json.loads(body)
+                except (json.JSONDecodeError, TypeError):
+                    return {"error": "body is not valid JSON"}
+                if not isinstance(rules, list):
+                    return {"error": "body must be a JSON array of rules"}
+                for i, r in enumerate(rules):
+                    bad = validate_rule(rule_type, r)
+                    if bad:
+                        return {"error": f"rule[{i}]: {bad}"}
+                try:
+                    publisher.publish(app, rules)
+                except Exception as e:
+                    return {"error": f"publish failed: {e}"}
+                return {"published": len(rules)}
+            try:
+                rules = provider.get_rules(app)
+            except Exception as e:
+                return {"error": f"provider failed: {e}"}
+            return rules if rules is not None else {
+                "error": f"no rules available for app {app}"
+            }
         if path == "v1/rules":
             # per-rule-type console view: fetch live, sync ids, return
             # entities (FlowControllerV1.apiQueryMachineRules analog)

@@ -1009,3 +1009,110 @@ class TestHeartbeatFailover:
             command_port=1, client_ip="127.0.0.1",
         )
         assert hb.send_once() is False
+
+
+class TestDynamicRulePlugins:
+    """v2 pluggable provider/publisher route (FlowControllerV2 analog)."""
+
+    def test_store_publish_and_agent_convergence(self, tmp_path):
+        """Dashboard publishes to a store; the agent converges by WATCHING
+        the same store through its datasource — no dashboard→machine push
+        (the config-center model of DynamicRulePublisher.java:22)."""
+        import time
+
+        from sentinel_tpu.dashboard.dynamic_rules import FileRuleStore
+        from sentinel_tpu.datasource import (
+            FileRefreshableDataSource,
+            flow_rules_from_json,
+        )
+        from sentinel_tpu.local import FlowRuleManager
+
+        store = FileRuleStore(str(tmp_path))
+        dash = DashboardServer(
+            port=0, rule_plugins=store.plugins(("flow",))
+        ).start()
+        try:
+            # publish through v2 — note: NO machines are registered; the
+            # store pair never needs the fleet reachable from the console
+            code, out, _ = _post(
+                dash.port, "v2/rules?app=demo&type=flow",
+                [{"resource": "v2_res", "count": 11}],
+            )
+            assert out == {"published": 1}
+            assert _get(dash.port, "v2/rules?app=demo&type=flow") == [
+                {"resource": "v2_res", "count": 11}
+            ]
+            ds = FileRefreshableDataSource(
+                store.path_for("demo-flow-rules"), flow_rules_from_json,
+                refresh_interval_s=0.05,
+            )
+            FlowRuleManager.register_property(ds.property)
+            ds.start()
+            try:
+                rules = FlowRuleManager.get_rules("v2_res")
+                assert rules and rules[0].count == 11
+                _post(
+                    dash.port, "v2/rules?app=demo&type=flow",
+                    [{"resource": "v2_res", "count": 23}],
+                )
+                for _ in range(100):
+                    rules = FlowRuleManager.get_rules("v2_res")
+                    if rules and rules[0].count == 23:
+                        break
+                    time.sleep(0.02)
+                else:
+                    raise AssertionError(
+                        "agent never converged on the published update"
+                    )
+            finally:
+                ds.close()
+        finally:
+            dash.stop()
+
+    def test_v2_validates_and_defaults_empty(self, tmp_path):
+        from sentinel_tpu.dashboard.dynamic_rules import FileRuleStore
+
+        store = FileRuleStore(str(tmp_path))
+        dash = DashboardServer(
+            port=0, rule_plugins=store.plugins(("flow", "degrade"))
+        ).start()
+        try:
+            # nothing published yet → empty authoritative list, not an error
+            assert _get(dash.port, "v2/rules?app=demo&type=degrade") == []
+            # a malformed rule is rejected BEFORE reaching the publisher
+            code, out, _ = _post(
+                dash.port, "v2/rules?app=demo&type=flow",
+                [{"count": 5}],  # missing resource
+            )
+            assert "error" in out
+            assert _get(dash.port, "v2/rules?app=demo&type=flow") == []
+        finally:
+            dash.stop()
+
+    def test_v2_api_fallback_pushes_to_machines(self):
+        """Without a plugin the v2 route falls back to the direct
+        Api pair — same fleet behavior as v1 behind the v2 contract."""
+        from sentinel_tpu.local import FlowRuleManager
+        from sentinel_tpu.transport.command import CommandCenter
+
+        dash = DashboardServer(port=0).start()
+        cc = CommandCenter(port=0)
+        cc.start()
+        try:
+            dash.apps.register(
+                MachineInfo(app="svc", ip="127.0.0.1", port=cc.port)
+            )
+            code, out, _ = _post(
+                dash.port, "v2/rules?app=svc&type=flow",
+                [{"resource": "v2_direct", "count": 3}],
+            )
+            assert out == {"published": 1}
+            assert any(
+                r.resource == "v2_direct" and r.count == 3
+                for r in FlowRuleManager.all_rules()
+            )
+            fetched = _get(dash.port, "v2/rules?app=svc&type=flow")
+            assert any(r["resource"] == "v2_direct" for r in fetched)
+        finally:
+            cc.stop()
+            dash.stop()

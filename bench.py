@@ -54,7 +54,8 @@ ATTEMPTS = [
     # (observed 2026-07-31: healthy first claim, deadline-killed mid-stage,
     # immediate sick-signature on the very next claim)
     ("tpu-full", dict(platform="tpu", n_flows=100_000, batch=16384, chain=64,
-                      repeats=5, budget_s=2000), 2400),
+                      repeats=5, budget_s=2000,
+                      upgrade=[(32768, 32), (65536, 16)]), 2400),
     ("tpu-retry", dict(platform="tpu", n_flows=100_000, batch=16384, chain=64,
                        repeats=3, budget_s=450), 600),
     # 16384-batch measured 43% faster than 4096 on the CPU backend
@@ -325,28 +326,54 @@ def _measure(cfg: dict) -> None:
     # dispatch-bound, see roofline), so 2× batch projects 1.1–1.3×. The
     # headline only ever moves UP: a slower/failed candidate leaves it.
     def _shape_upgrade():
-        cand_batch, cand_chain = cfg.get("upgrade", (32768, 32))
-        if cand_batch <= config.batch_size:
-            return
-        cfg_u = EngineConfig(
-            max_flows=n_flows, max_namespaces=64, batch_size=cand_batch
+        upgrade = cfg.get("upgrade", (32768, 32))
+        candidates = (
+            list(upgrade) if isinstance(upgrade[0], (list, tuple))
+            else [upgrade]
         )
-        table_u, _ = build_rule_table(cfg_u, rules, ns_max_qps=1e9)
-        # same repeat count as the headline so adoption compares equal
-        # sample sizes (r4 advisor)
-        mu = timed_chained(cfg_u, table_u, cand_chain, repeats)
+        best = None
+        tried = []
+        for cand_batch, cand_chain in candidates:
+            if cand_batch <= config.batch_size:
+                continue
+            if best is not None and _budget_left() < 3 * STAGE_FLOOR_S:
+                break  # keep the candidate already measured; budget is low
+            cfg_u = EngineConfig(
+                max_flows=n_flows, max_namespaces=64, batch_size=cand_batch
+            )
+            table_u, _ = build_rule_table(cfg_u, rules, ns_max_qps=1e9)
+            # same repeat count as the headline so adoption compares equal
+            # sample sizes (r4 advisor)
+            mu = timed_chained(cfg_u, table_u, cand_chain, repeats)
+            tried.append({
+                "batch": cand_batch, "chain": cand_chain,
+                "decisions_per_sec": round(mu["rate"]),
+                "ok_frac": round(mu["ok_frac"], 3),
+            })
+            if mu["ok_frac"] > 0.5 and (
+                best is None or mu["rate"] > best[0]["rate"]
+            ):
+                best = (mu, cand_batch, cand_chain)
+        if best is None:
+            if tried:
+                doc["extra"]["shape_upgrade"] = {
+                    "tried": tried, "adopted": False,
+                }
+            return
+        mu, cand_batch, cand_chain = best
         rate_u = mu["rate"]
         lat_u_ms = mu["lat_ms"]
         # same methodology AND same sanity gate as the headline (both come
         # from timed_chained), so adoption is apples-to-apples and a
         # degenerate table/shape can never publish a fast-but-meaningless
         # rate
-        adopted = mu["ok_frac"] > 0.5 and rate_u > doc["value"]
+        adopted = rate_u > doc["value"]
         doc["extra"]["shape_upgrade"] = {
             "batch": cand_batch, "chain": cand_chain,
             "decisions_per_sec": round(rate_u),
             "ok_frac": round(mu["ok_frac"], 3),
             "adopted": adopted,
+            **({"tried": tried} if len(tried) > 1 else {}),
         }
         if adopted:
             # keep the pre-upgrade shape's stats coherent under their own

@@ -441,6 +441,9 @@ def _measure(cfg: dict) -> None:
     def _buckets():
         per_bucket = {}
         for bucket in cfg.get("serve_buckets", (64, 1024, 4096, 16384)):
+            if _budget_left() < STAGE_FLOOR_S:
+                per_bucket[str(bucket)] = "skipped: child budget exhausted"
+                continue
             cfgb = config._replace(batch_size=bucket)
             slots_b = np.sort(rng.integers(0, n_flows, size=bucket)).tolist()
             batch_b = jax.tree.map(jnp.asarray, make_batch(cfgb, slots_b))
@@ -468,6 +471,9 @@ def _measure(cfg: dict) -> None:
                 )
                 reps.append((time.perf_counter() - t0) / iters * 1e3)
             per_bucket[str(bucket)] = round(min(reps), 4)
+            # progressive emit: a mid-compile kill keeps the rungs done
+            doc["extra"]["per_bucket_step_ms"] = per_bucket
+            _emit(doc)
         doc["extra"]["per_bucket_step_ms"] = per_bucket
         # co-located projection: on the dev tunnel every dispatch pays an
         # RTT a co-located server would not (the served_rate stage measures
@@ -477,6 +483,8 @@ def _measure(cfg: dict) -> None:
         # the executing one). Clearly a projection, clearly labeled.
         best = None
         for b_str, d_ms in per_bucket.items():
+            if not isinstance(d_ms, (int, float)):
+                continue  # skipped rung
             proj = {
                 "bucket": int(b_str),
                 "decisions_per_sec": round(int(b_str) / d_ms * 1e3),
@@ -519,23 +527,41 @@ def _measure(cfg: dict) -> None:
             )
             row = {}
             for impl in impls:
-                prefix = segment_prefix_builder(keys, impl)
+                # budget check per VARIANT, not just per stage: each jit
+                # here can be a multi-ten-second remote compile, and 12
+                # uncheckable variants once overran the child budget into
+                # the parent's SIGTERM (abandoning a live TPU claim)
+                if _budget_left() < STAGE_FLOOR_S:
+                    row[impl] = "skipped: child budget exhausted"
+                    continue
+                try:
+                    prefix = segment_prefix_builder(keys, impl)
 
-                def many(c):
-                    def body(acc, _):
-                        out = prefix(acc)
-                        # feed output back (rescaled) so iterations chain
-                        return out * 0.5 + c, out[0]
+                    def many(c):
+                        def body(acc, _):
+                            out = prefix(acc)
+                            # feed output back (rescaled) so iterations
+                            # chain
+                            return out * 0.5 + c, out[0]
 
-                    return jax.lax.scan(body, c, None, length=100)
+                        return jax.lax.scan(body, c, None, length=100)
 
-                f = jax.jit(many)
-                jax.block_until_ready(f(contrib))
-                t0 = time.perf_counter()
-                jax.block_until_ready(f(contrib))
-                row[impl] = round((time.perf_counter() - t0) / 100 * 1e6, 1)
+                    f = jax.jit(many)
+                    jax.block_until_ready(f(contrib))
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(f(contrib))
+                    row[impl] = round(
+                        (time.perf_counter() - t0) / 100 * 1e6, 1
+                    )
+                except Exception as e:  # pragma: no cover - env dependent
+                    # one impl failing (e.g. a Pallas remote-compile 500)
+                    # must not discard the measured impls — the failure
+                    # itself is the fate evidence
+                    row[impl] = f"error: {type(e).__name__}: {e}"[:160]
             res[str(n)] = row
-        doc["extra"]["prefix_impl_us"] = res
+            # progressive emit: a later kill keeps the sizes already done
+            doc["extra"]["prefix_impl_us"] = res
+            _emit(doc)
 
 
     # hot-param path: the CMS decide+update kernel, Pallas vs pure-XLA, on
@@ -552,6 +578,9 @@ def _measure(cfg: dict) -> None:
         res = {}
         N = 1024
         for impl in ("jax", "pallas"):
+            if _budget_left() < STAGE_FLOOR_S:
+                res[impl] = "skipped: child budget exhausted"
+                continue
             pcfg = ParamConfig(max_param_rules=256, impl=impl)
             slots = jnp.asarray(
                 rng.integers(0, 256, size=N).astype(np.int32)
@@ -576,12 +605,19 @@ def _measure(cfg: dict) -> None:
                 ts = now0 + jnp.arange(iters, dtype=jnp.int32)
                 return jax.lax.scan(body, st, ts)
 
-            f = jax.jit(many)
-            st0 = make_param_state(pcfg)
-            jax.block_until_ready(f(st0, jnp.int32(now)))
-            t0 = time.perf_counter()
-            jax.block_until_ready(f(st0, jnp.int32(now)))
-            res[impl] = round((time.perf_counter() - t0) / iters * 1e3, 4)
+            try:
+                f = jax.jit(many)
+                st0 = make_param_state(pcfg)
+                jax.block_until_ready(f(st0, jnp.int32(now)))
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(st0, jnp.int32(now)))
+                res[impl] = round(
+                    (time.perf_counter() - t0) / iters * 1e3, 4
+                )
+            except Exception as e:  # pragma: no cover - env dependent
+                # a Pallas remote-compile failure is itself the fate
+                # evidence; it must not discard the jax number
+                res[impl] = f"error: {type(e).__name__}: {e}"[:160]
         res["batch"] = N
         doc["extra"]["param_pallas_vs_xla_step_ms"] = res
 

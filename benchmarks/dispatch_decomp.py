@@ -1,0 +1,143 @@
+"""Decompose measured step time into per-dispatch overhead vs true device
+step time.
+
+Every dispatch through the axon dev tunnel pays a round-trip the co-located
+production host would not. A single-iteration-count measurement of a
+chained scan folds that RTT into the per-step quotient:
+
+    measured(iters) = (RTT + iters * d) / iters
+
+Timing the SAME chained kernel at two iteration counts separates the two:
+
+    d    = (t(hi) - t(lo)) / (hi - lo)          # true per-step device time
+    RTT  = t(lo) - lo * d                       # per-dispatch overhead
+
+The slope ``d`` is what a co-located server's pipelined steps actually pay
+(the reference's netty loop pays its own sub-ms dispatch, not a tunnel RTT
+— ``NettyTransportServer.java:73-101``), so the SLO projection in bench.py
+uses the slope, while the intercept is reported alongside as the honest
+tunnel tax.  Prints ONE JSON line; safe to run standalone on any backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def measure(n_flows: int = 100_000, buckets=(64, 1024, 4096, 16384),
+            iters_lo: int = 100, iters_hi: int = 400, reps: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cache = os.path.join(REPO, ".jax_cache")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from sentinel_tpu.engine import (
+        ClusterFlowRule,
+        EngineConfig,
+        build_rule_table,
+        make_batch,
+        make_state,
+    )
+    from sentinel_tpu.engine.decide import _decide_core
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    config = EngineConfig(max_flows=n_flows, max_namespaces=64, batch_size=64)
+    rules = [
+        ClusterFlowRule(flow_id=i, count=100.0 + (i % 100),
+                        mode=ThresholdMode.GLOBAL, namespace=f"ns{i % 64}")
+        for i in range(n_flows)
+    ]
+    table, _ = build_rule_table(config, rules, ns_max_qps=1e9)
+
+    # per-dispatch overhead floor on a trivial kernel (scalar add): the
+    # pure tunnel/jit tax with no kernel work to speak of
+    one = jnp.float32(1.0)
+    triv = jax.jit(lambda x: x + 1.0)
+    jax.block_until_ready(triv(one))
+    triv_ms = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(triv(one))
+        triv_ms.append((time.perf_counter() - t0) * 1e3)
+    triv_ms.sort()
+
+    out = {
+        "backend": dev.platform,
+        "device": str(dev),
+        "trivial_dispatch_ms": {
+            "p50": round(triv_ms[len(triv_ms) // 2], 3),
+            "min": round(triv_ms[0], 3),
+        },
+        "iters": [iters_lo, iters_hi],
+        "per_bucket": {},
+    }
+
+    for bucket in buckets:
+        cfgb = config._replace(batch_size=bucket)
+        slots = np.sort(rng.integers(0, n_flows, size=bucket)).tolist()
+        batch_b = jax.tree.map(jnp.asarray, make_batch(cfgb, slots))
+
+        def chained(iters):
+            def run(state, batch, now0):
+                def body(st, t):
+                    st, verdicts = _decide_core(
+                        cfgb, st, table, batch, t, grouped=True, uniform=True
+                    )
+                    return st, verdicts.status[0]
+
+                ts = now0 + jnp.arange(iters, dtype=jnp.int32)
+                return jax.lax.scan(body, state, ts)
+
+            step = jax.jit(run)
+            out_w = step(make_state(config), batch_b, jnp.int32(10_000))
+            jax.block_until_ready(out_w)
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(
+                    step(make_state(config), batch_b, jnp.int32(10_000))
+                )
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e3  # ms per dispatch
+
+        t_lo = chained(iters_lo)
+        t_hi = chained(iters_hi)
+        d_ms = (t_hi - t_lo) / (iters_hi - iters_lo)
+        rtt_ms = t_lo - iters_lo * d_ms
+        out["per_bucket"][str(bucket)] = {
+            "step_ms_slope": round(d_ms, 4),
+            "dispatch_overhead_ms": round(rtt_ms, 2),
+            "naive_step_ms_at_lo": round(t_lo / iters_lo, 4),
+        }
+    return out
+
+
+def main() -> None:
+    doc = measure()
+    line = json.dumps(doc)
+    print(line, flush=True)
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(
+            d, f"decomp-{time.strftime('%Y%m%d-%H%M%S')}.json"), "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -73,23 +73,38 @@ def _recv_frames(sock: socket.socket, frames: P.FrameReader, want_xid=None):
 def run_closed(port: int, batch: int, pipeline: int, seconds: float,
                n_flows: int, seed: int) -> dict:
     rng = np.random.default_rng(seed)
-    stop_at = time.perf_counter() + seconds
     totals = []
     rtts: list = []
+    windows: list = []  # (meas_start, meas_end) per thread
     lock = threading.Lock()
 
     def pump(t: int) -> None:
-        sock = _connect(port)
-        frames = P.FrameReader()
-        # per-thread generator: np.random.Generator is not thread-safe
-        t_rng = np.random.default_rng([seed, t])
-        flow_ids = t_rng.integers(0, n_flows, size=batch)
         n_ok = n_err = 0
         local_rtt = []
-        xid = t * 1_000_000 + 1
-        # warmup round trip (connection + compiled-shape route)
-        sock.sendall(P.encode_batch_request(xid, flow_ids))
-        _recv_frames(sock, frames)
+        try:
+            sock = _connect(port)
+            frames = P.FrameReader()
+            # per-thread generator: np.random.Generator is not thread-safe
+            t_rng = np.random.default_rng([seed, t])
+            flow_ids = t_rng.integers(0, n_flows, size=batch)
+            xid = t * 1_000_000 + 1
+            # warmup round trip (connection + compiled-shape route)
+            sock.sendall(P.encode_batch_request(xid, flow_ids))
+            _recv_frames(sock, frames)
+        except (ConnectionError, socket.timeout, OSError):
+            # a failed warmup must be VISIBLE as an error, never a silent
+            # zero-verdict thread (the artifact shape this file once
+            # produced when warmup consumed the measurement window)
+            with lock:
+                totals.append((0, batch))
+                windows.append((time.perf_counter(), time.perf_counter()))
+            return
+        # the measurement clock starts AFTER the warmup round trip: a
+        # slow first response (server-side compile, connection setup)
+        # must shorten nothing — it once consumed the entire window and
+        # produced a 0-verdict closed-loop artifact
+        t_meas0 = time.perf_counter()
+        stop_at = t_meas0 + seconds
         while time.perf_counter() < stop_at:
             xid += 1
             t0 = time.perf_counter()
@@ -101,6 +116,7 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
                 break
             local_rtt.append(time.perf_counter() - t0)
             n_ok += batch
+        t_meas1 = time.perf_counter()
         try:
             sock.close()
         except OSError:
@@ -108,23 +124,33 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
         with lock:
             totals.append((n_ok, n_err))
             rtts.extend(local_rtt)
+            windows.append((t_meas0, t_meas1))
 
     threads = [
         threading.Thread(target=pump, args=(t,)) for t in range(pipeline)
     ]
-    t_start = time.perf_counter()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    wall = time.perf_counter() - t_start
+    # denominator = full span from the first thread's measurement start to
+    # the last thread's end: warmup time is excluded, and staggered windows
+    # can only UNDERstate the concurrent rate, never inflate it (summing
+    # verdicts over max(per-thread wall) would credit a late straggler's
+    # solo throughput as if all channels were concurrent)
+    if windows:
+        wall = max(e for _, e in windows) - min(s for s, _ in windows)
+        start_skew = max(s for s, _ in windows) - min(s for s, _ in windows)
+    else:
+        wall, start_skew = seconds, 0.0
     rtt_ms = (np.asarray(rtts) * 1e3) if rtts else np.empty(0)
     if rtt_ms.size > MAX_RTT_SAMPLES:
         rtt_ms = rng.choice(rtt_ms, MAX_RTT_SAMPLES, replace=False)
     return {
         "verdicts_ok": int(sum(n for n, _ in totals)),
         "verdicts_err": int(sum(e for _, e in totals)),
-        "wall_s": round(wall, 3),
+        "wall_s": round(max(wall, 1e-9), 3),
+        "start_skew_s": round(start_skew, 3),
         "rtt_ms": [round(float(x), 4) for x in np.sort(rtt_ms)],
     }
 

@@ -119,12 +119,15 @@ def measure(n_flows: int = 100_000, buckets=(64, 1024, 4096, 16384),
         t_lo = chained(iters_lo)
         t_hi = chained(iters_hi)
         d_ms = (t_hi - t_lo) / (iters_hi - iters_lo)
-        rtt_ms = t_lo - iters_lo * d_ms
-        out["per_bucket"][str(bucket)] = {
-            "step_ms_slope": round(d_ms, 4),
-            "dispatch_overhead_ms": round(rtt_ms, 2),
-            "naive_step_ms_at_lo": round(t_lo / iters_lo, 4),
-        }
+        row = {"naive_step_ms_at_lo": round(t_lo / iters_lo, 4)}
+        if d_ms > 0:
+            row["step_ms_slope"] = round(d_ms, 4)
+            row["dispatch_overhead_ms"] = round(t_lo - iters_lo * d_ms, 2)
+        else:
+            # jitter swamped the two-point fit — never publish a negative
+            # slope or an overhead exceeding the measured wall time
+            row["fit_failed"] = True
+        out["per_bucket"][str(bucket)] = row
     return out
 
 

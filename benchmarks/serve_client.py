@@ -94,10 +94,11 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
         except (ConnectionError, socket.timeout, OSError):
             # a failed warmup must be VISIBLE as an error, never a silent
             # zero-verdict thread (the artifact shape this file once
-            # produced when warmup consumed the measurement window)
+            # produced when warmup consumed the measurement window). No
+            # window entry: a zero-width marker stamped at failure time
+            # would re-include warmup skew in the denominator.
             with lock:
                 totals.append((0, batch))
-                windows.append((time.perf_counter(), time.perf_counter()))
             return
         # the measurement clock starts AFTER the warmup round trip: a
         # slow first response (server-side compile, connection setup)
@@ -149,7 +150,9 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
     return {
         "verdicts_ok": int(sum(n for n, _ in totals)),
         "verdicts_err": int(sum(e for _, e in totals)),
-        "wall_s": round(max(wall, 1e-9), 3),
+        # floor AFTER rounding: an all-threads-failed run must report a
+        # usable nonzero denominator, not round a guard down to 0.0
+        "wall_s": max(round(wall, 3), 0.001),
         "start_skew_s": round(start_skew, 3),
         "rtt_ms": [round(float(x), 4) for x in np.sort(rtt_ms)],
     }

@@ -98,6 +98,74 @@ class TestServeLive:
             server.stop()
             service.close()
 
+    def test_warmup_latency_excluded_from_closed_loop_window(self):
+        """A slow FIRST response (server-side compile) must not consume
+        the measurement window: each pump thread's clock starts after its
+        warmup round trip. Regression: a remote-compile warmup once ate
+        the whole window and produced a 0-verdict, 0-error artifact."""
+        import socket
+        import threading
+        import time as _time
+
+        from sentinel_tpu.cluster import protocol as P
+
+        delay_s = 1.2
+        seconds = 0.8
+        srv = socket.create_server(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        stop = threading.Event()
+
+        def serve():
+            srv.settimeout(0.2)
+            conns = []
+            while not stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                t = threading.Thread(
+                    target=handle, args=(conn,), daemon=True
+                )
+                t.start()
+                conns.append(t)
+
+        def handle(conn):
+            frames = P.FrameReader()
+            first = True
+            try:
+                while not stop.is_set():
+                    data = conn.recv(65536)
+                    if not data:
+                        return
+                    for payload in frames.feed(data):
+                        xid, ids, _c, _p = P.decode_batch_request(payload)
+                        if first:
+                            _time.sleep(delay_s)  # simulated cold compile
+                            first = False
+                        n = len(ids)
+                        conn.sendall(P.encode_batch_response(
+                            xid, np.zeros(n, np.int8),
+                            np.zeros(n, np.int32), np.zeros(n, np.int32),
+                        ))
+            except OSError:
+                return
+
+        st = threading.Thread(target=serve, daemon=True)
+        st.start()
+        try:
+            out = serve_bench.run_closed(
+                port, clients=1, batch=64, pipeline=2,
+                seconds=seconds, n_flows=64,
+            )
+            # the old clock placement yielded 0 verdicts here (delay_s >
+            # seconds); the fixed clock measures a full post-warmup window
+            assert out["verdicts_ok"] > 0
+            assert out["errors"] == 0
+            assert out["p99_ms"] is not None
+        finally:
+            stop.set()
+            srv.close()
+
     def test_client_subprocess_never_claims_accelerator(self):
         """The client pins jax to CPU before anything else imports it —
         the env var alone is too late under the axon sitecustomize."""

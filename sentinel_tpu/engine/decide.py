@@ -164,19 +164,55 @@ def _decide_core(
     #    — computed identically on every device from global inputs
     # ------------------------------------------------------------------
     ns_id = psum(jnp.where(owned, rules.namespace_id[safe_slot], 0))
-    ns_already = W.window_sum_at(spec, state.ns, now, 0, ns_id).astype(jnp.float32)
-    # the namespace key space is small and static — sort-free one-hot prefix;
-    # the one-hot matrix is reused below for the guard-counter matvec update
+    # the namespace key space is small and static — sort-free one-hot; the
+    # matrix is reused for the guard-counter matvec update below
     live_f = live.astype(jnp.float32)
     ns_oh = (
         ns_id[:, None] == jnp.arange(config.max_namespaces)[None, :]
     ).astype(jnp.float32)
-    ns_incl = _blocked_cumsum(ns_oh * live_f[:, None])
-    ns_prefix = (
-        jnp.take_along_axis(ns_incl, ns_id[:, None], axis=1)[:, 0] - live_f
+    # Dense per-namespace view ([NS], cheap): a request's verdict needs the
+    # per-request in-batch prefix ONLY when a namespace's budget boundary
+    # falls inside this batch. With already = valid-window count and
+    # total = live requests of that namespace in the batch:
+    #   fits-all:   already + total <= budget  → every request passes
+    #   none-pass:  already + 1     >  budget  → every request blocks
+    # and both reduce to ok = (already + 1 <= budget) applied per
+    # namespace. Only a boundary-crossing namespace (already+total >
+    # budget AND already+1 <= budget) needs the [N, NS] cumsum — rare in
+    # steady state, so it lives behind a cond. All inputs here are global
+    # (ns window replicated, ns_id/live psum-stitched), making the
+    # predicate mesh-uniform and the cond safe under shard_map.
+    ns_live_tot = jnp.einsum(
+        "nk,n->k", ns_oh, live_f, precision=jax.lax.Precision.HIGHEST
     )
-    ns_budget = rules.ns_max_qps[ns_id] * (spec.interval_ms / 1000.0)
-    ns_ok = (ns_already + ns_prefix + 1.0) <= ns_budget
+    ns_ids_dense = jnp.arange(config.max_namespaces, dtype=jnp.int32)
+    ns_already_dense = W.window_sum_at(
+        spec, state.ns, now, 0, ns_ids_dense
+    ).astype(jnp.float32)
+    ns_budget_dense = rules.ns_max_qps * (spec.interval_ms / 1000.0)
+    crossing = (
+        (ns_live_tot > 0)
+        & (ns_already_dense + ns_live_tot > ns_budget_dense)
+        & (ns_already_dense + 1.0 <= ns_budget_dense)
+    )
+
+    def ns_ok_precise(_):
+        ns_incl = _blocked_cumsum(ns_oh * live_f[:, None])
+        ns_prefix = (
+            jnp.take_along_axis(ns_incl, ns_id[:, None], axis=1)[:, 0]
+            - live_f
+        )
+        ns_already = ns_already_dense[ns_id]
+        ns_budget = ns_budget_dense[ns_id]
+        return (ns_already + ns_prefix + 1.0) <= ns_budget
+
+    def ns_ok_fast(_):
+        ok_ns = (ns_already_dense + 1.0) <= ns_budget_dense
+        return ok_ns[ns_id]
+
+    ns_ok = jax.lax.cond(
+        jnp.any(crossing), ns_ok_precise, ns_ok_fast, None
+    )
     too_many = live & ~ns_ok
     ns_admitted = live & ns_ok  # global mask — identical on every device
     active = ns_admitted & owned  # flow evaluation happens on the owner

@@ -104,6 +104,36 @@ class TestNamespaceGuard:
         assert (st == TokenStatus.OK).sum() == 5
         assert (st == TokenStatus.TOO_MANY_REQUEST).sum() == 5
 
+    def test_guard_none_pass_when_already_over(self):
+        """Fast-path arm 2: the window already holds >= budget requests, so
+        the whole batch gets TOO_MANY without the in-batch prefix."""
+        cfg = CFG
+        table, index = build_rule_table(
+            cfg, [ClusterFlowRule(flow_id=1, count=1e9)], ns_max_qps=5.0
+        )
+        state = make_state(cfg)
+        slot = index.lookup(1)
+        state, _ = run(state, table, [slot] * 10, now=10_000)  # fills to 5
+        state, v = run(state, table, [slot] * 4, now=10_001)
+        st = np.asarray(v.status)[:4]
+        assert (st == TokenStatus.TOO_MANY_REQUEST).all()
+
+    def test_guard_boundary_accumulates_across_batches(self):
+        """already > 0 AND the boundary inside the batch: the precise arm
+        must count prior-window admissions, admitting exactly the rest."""
+        cfg = CFG
+        table, index = build_rule_table(
+            cfg, [ClusterFlowRule(flow_id=1, count=1e9)], ns_max_qps=7.0
+        )
+        state = make_state(cfg)
+        slot = index.lookup(1)
+        state, v1 = run(state, table, [slot] * 3, now=10_000)  # fits whole
+        assert (np.asarray(v1.status)[:3] == TokenStatus.OK).all()
+        state, v2 = run(state, table, [slot] * 10, now=10_001)
+        st = np.asarray(v2.status)[:10]
+        assert (st == TokenStatus.OK).sum() == 4  # 7 - 3 already admitted
+        assert (st == TokenStatus.TOO_MANY_REQUEST).sum() == 6
+
 
 class TestPriorityOccupy:
     def test_should_wait_and_borrow_accounting(self, setup):

@@ -77,6 +77,56 @@ class TestShardedParity:
                 np.asarray(v1.remaining), np.asarray(v8.remaining)
             )
 
+    def test_parity_with_ns_guard_boundary_crossing(self, mesh):
+        """The namespace guard's precise arm (budget boundary inside the
+        batch → [N, NS] prefix behind the mesh-uniform cond) must produce
+        byte-identical TOO_MANY placement on the mesh: a tight per-ns
+        budget forces crossing batches, and repeated steps walk the window
+        through fits-all, crossing, and none-pass regimes."""
+        rules = [
+            ClusterFlowRule(
+                flow_id=i, count=1e9, mode=G, namespace=f"ns{i % 3}"
+            )
+            for i in range(12)
+        ]
+        table, index = build_rule_table(CFG, rules, ns_max_qps=7.0)
+        sharded_step = make_sharded_decide(CFG, mesh)
+        state_1 = make_state(CFG)
+        state_8 = shard_state(make_state(CFG), mesh)
+        table_8 = shard_rules(table, mesh)
+        rng = np.random.default_rng(7)
+        now = 10_000
+        saw_crossing = False
+        for step in range(5):
+            now += int(rng.integers(20, 300))
+            flows = rng.integers(0, 12, size=48)
+            slots = [index.lookup(int(f)) for f in flows]
+            batch = make_batch(CFG, slots)
+            state_1, v1 = decide(CFG, state_1, table, batch, jnp.int32(now))
+            state_8, v8 = sharded_step(state_8, table_8, batch, jnp.int32(now))
+            np.testing.assert_array_equal(
+                np.asarray(v1.status), np.asarray(v8.status),
+                err_msg=f"step {step} status diverged under ns guard",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(v1.wait_ms), np.asarray(v8.wait_ms)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(v1.remaining), np.asarray(v8.remaining)
+            )
+            # crossing regime = one namespace with BOTH verdicts in one
+            # batch (the precise prefix arm decides the split point);
+            # whole-namespace rejection would only exercise the fast arm
+            st = np.asarray(v1.status)[:48]
+            ns_of = np.asarray([int(f) % 3 for f in flows])
+            for ns in range(3):
+                sel = st[ns_of == ns]
+                saw_crossing |= bool(
+                    (sel == TokenStatus.OK).any()
+                    and (sel == TokenStatus.TOO_MANY_REQUEST).any()
+                )
+        assert saw_crossing, "scenario never hit the precise (crossing) arm"
+
     def test_state_actually_sharded(self, mesh):
         state = shard_state(make_state(CFG), mesh)
         shards = state.flow.counts.addressable_shards

@@ -62,7 +62,8 @@ ATTEMPTS = [
     # (benchmarks/shape_sweep.py — same per-batch-overhead amortization
     # argument as on TPU)
     ("cpu-fallback", dict(platform="cpu", n_flows=100_000, batch=16384,
-                          chain=16, repeats=3, upgrade=(32768, 8),
+                          chain=16, repeats=3,
+                          upgrade=[(32768, 8), (65536, 4)],
                           budget_s=340), 420),
 ]
 

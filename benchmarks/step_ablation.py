@@ -11,7 +11,8 @@ per-dispatch overhead cancels — see ``dispatch_decomp.py``):
 - ``scatter4_sorted`` — same scatter with ``indices_are_sorted=True``
   (legal on the serving path: the batcher sorts the batch by flow slot,
   padding sorts after every real slot as out-of-range drop rows)
-- ``scatter1``/``scatter1_sorted`` — one channel instead of four
+- ``scatter2``/``scatter1``/``scatter1_sorted`` — two/one channel(s)
+  instead of four (channel-count scaling of the window write)
 - ``gather``          — the windowed PASS read (2× window_sum_at + compare)
 - ``nsguard_precise_arm`` — one-hot + blocked cumsum + einsum + dense
   column add: the guard's boundary-crossing arm, which production
@@ -91,6 +92,9 @@ def build_variants(config, table, stacked, n_flows):
     def scatter4_sorted(state, xs):
         return _scatter(state, xs[0], xs[1], 4, True)
 
+    def scatter2(state, xs):
+        return _scatter(state, xs[0], xs[1], 2, False)
+
     def scatter1(state, xs):
         return _scatter(state, xs[0], xs[1], 1, False)
 
@@ -161,6 +165,7 @@ def build_variants(config, table, stacked, n_flows):
         "full": full,
         "scatter4": scatter4,
         "scatter4_sorted": scatter4_sorted,
+        "scatter2": scatter2,
         "scatter1": scatter1,
         "scatter1_sorted": scatter1_sorted,
         "gather": gather,

@@ -25,6 +25,7 @@ FAST_EXAMPLES = [
     "prometheus_exporter_demo.py",
     "asgi_app_demo.py",
     "multi_pod_demo.py",
+    "mesh_sharded_server.py",
 ]
 
 

@@ -57,10 +57,10 @@ def main() -> None:
     )
     service.warmup()  # compile the sharded step outside the serving window
 
-    shards = service._state.flow.counts.addressable_shards
+    n_dev = len(mesh.devices.flat)
     print(
-        f"flow window tensor: {len(shards)} shards of "
-        f"{shards[0].data.shape[0]} flow slots each"
+        f"flow window tensor: {n_dev} shards of "
+        f"{config.max_flows // n_dev} flow slots each (flow axis over ICI)"
     )
 
     server = TokenServer(service, host="127.0.0.1", port=0, max_batch=64)

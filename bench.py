@@ -1005,6 +1005,7 @@ def _served_rate() -> dict:
                 "service_ceiling_vps": extra.get("service_ceiling_vps"),
                 "served_over_ceiling": extra.get("served_over_ceiling"),
                 "host_cores": extra.get("host_cores"),
+                "stage_latency_ms": extra.get("stage_latency_ms"),
                 "harness": (
                     f"{extra.get('clients', '?')} fork clients, pipelined "
                     f"{extra.get('batch_per_frame', '?')}-batch frames, "

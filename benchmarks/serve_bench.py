@@ -266,12 +266,20 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
     try:
         candidates = (closed_kw if isinstance(closed_kw, (list, tuple))
                       else [closed_kw or {}])
+        # server-side stage breakdown per candidate: the server runs
+        # in-process, so its pipeline histograms (queue wait / decide /
+        # write / batch size) are snapshotted per closed-loop round and
+        # ride the artifact next to the client-observed RTTs
+        from sentinel_tpu.metrics.server import server_metrics
+        stage_metrics = server_metrics()
         closed, alts = None, []
         for kw in candidates:
             if closed is not None and deadline_ts is not None \
                     and time.perf_counter() > deadline_ts:
                 break  # keep what we have; budget exhausted
+            stage_metrics.reset()
             c = run_closed(server.port, n_flows=n_flows, **kw)
+            c["stage_latency_ms"] = stage_metrics.stage_snapshot()
             if closed is None or c["verdicts_per_sec"] > \
                     closed["verdicts_per_sec"]:
                 if closed is not None:

@@ -111,6 +111,11 @@ def run(n_clients: int = 8, batch: int = 1024, pipeline: int = 3,
     server.start()
     port = server.port
 
+    # stage histograms cover exactly the measurement window (warmup/compile
+    # excluded) so the artifact's p50/p99 are steady-state
+    from sentinel_tpu.metrics.server import server_metrics
+    server_metrics().reset()
+
     ctx = mp.get_context("fork")  # children use sockets+numpy only
     out_q = ctx.Queue()
     procs = [
@@ -126,6 +131,7 @@ def run(n_clients: int = 8, batch: int = 1024, pipeline: int = 3,
     for p in procs:
         p.join(timeout=30)
     wall = time.perf_counter() - t0
+    stage_latency = server_metrics().stage_snapshot()
     server.stop()
     service.close()
 
@@ -170,6 +176,7 @@ def run(n_clients: int = 8, batch: int = 1024, pipeline: int = 3,
             "service_ceiling_vps": round(ceiling),
             "served_over_ceiling": round(rps / ceiling, 3),
             "host_cores": os.cpu_count(),
+            "stage_latency_ms": stage_latency,
         },
     }
 

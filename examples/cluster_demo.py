@@ -9,6 +9,7 @@ transport; clients speak the 5-type binary protocol.
 import os
 import sys
 import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -35,9 +36,10 @@ def main() -> None:
     svc.load_rules([
         ClusterFlowRule(flow_id=101, count=30.0, mode=ThresholdMode.GLOBAL)
     ])
-    server = TokenServer(svc, port=0)
+    server = TokenServer(svc, port=0, metrics_port=0)
     server.start()
-    print(f"token server on :{server.port} — flow 101 global budget 30/s")
+    print(f"token server on :{server.port} — flow 101 global budget 30/s "
+          f"(metrics on :{server.metrics_port})")
     clients = [
         TokenClient("127.0.0.1", server.port, timeout_ms=2000) for _ in range(3)
     ]
@@ -56,6 +58,19 @@ def main() -> None:
         print(f"total granted {sum(granted)} ≤ {30 * windows} "
               f"(30/s GLOBAL budget × {windows} window(s)) — the three "
               f"clients share ONE budget")
+        # the embedded Prometheus surface saw every verdict go by — see
+        # docs/OBSERVABILITY.md for the full series reference
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.metrics_port}/metrics", timeout=5
+        ) as rsp:
+            scrape = rsp.read().decode()
+        print("pipeline metrics scrape says:")
+        for line in scrape.splitlines():
+            name = line.split("{")[0].split(" ")[0]
+            if name == "sentinel_server_verdicts_total" or (
+                name.startswith("sentinel_server_") and name.endswith("_count")
+            ):
+                print(" ", line)
     finally:
         for c in clients:
             c.close()

@@ -2,7 +2,10 @@
 
 reference: ``sentinel-metric-exporter`` (JMX MBeans per resource) — the
 Python-ecosystem analog is a pull-based scrape endpoint rendering straight
-off the live ClusterNode windows.
+off the live ClusterNode windows. Besides the per-resource QPS gauges shown
+here, the same body carries cumulative ``sentinel_pass_total`` /
+``sentinel_block_total`` counters and the ``sentinel_server_*`` token-server
+pipeline series — the full reference is ``docs/OBSERVABILITY.md``.
 """
 
 import os
@@ -46,6 +49,7 @@ def main() -> None:
             line for line in text.splitlines()
             if "GET:/orders" in line and (
                 "pass_qps" in line or "block_qps" in line
+                or "pass_total" in line or "block_total" in line
             )
         ]
         print(f"served {passed} / blocked {blocked}; scrape says:")
@@ -53,6 +57,9 @@ def main() -> None:
             print(" ", line)
         assert any("sentinel_pass_qps" in w for w in wanted)
         assert any("sentinel_block_qps" in w for w in wanted)
+        # cumulative counters ride the same scrape (rate() these in PromQL
+        # instead of trusting the instantaneous QPS gauges)
+        assert any("sentinel_pass_total" in w for w in wanted)
     finally:
         exporter.stop()
         FlowRuleManager.load_rules([])

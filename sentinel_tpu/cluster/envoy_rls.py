@@ -32,6 +32,7 @@ from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import ClusterFlowRule, TokenStatus
 from sentinel_tpu.engine.rules import ThresholdMode
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
+from sentinel_tpu.metrics.server import server_metrics
 
 SEPARATOR = "|"  # EnvoySentinelRuleConverter.SEPARATOR
 
@@ -184,6 +185,11 @@ class RlsService:
                     limit_remaining=max(0, result.remaining),
                 )
             )
+        # RLS-shaped view of the same verdicts (sentinel_server_verdicts_
+        # total{namespace="rls:<domain>"}); the engine path already counted
+        # each descriptor under its rule namespace
+        ok_n = sum(1 for st in statuses if st.code == CODE_OK)
+        server_metrics().count_rls(domain, ok_n, len(statuses) - ok_n)
         return RlsVerdict(CODE_OVER_LIMIT if blocked else CODE_OK, statuses)
 
 

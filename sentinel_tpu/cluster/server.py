@@ -32,6 +32,7 @@ import asyncio
 import os
 import socket
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,6 +42,10 @@ from sentinel_tpu.cluster.connection import ConnectionManager
 from sentinel_tpu.cluster.token_service import TokenService
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
+from sentinel_tpu.metrics.profiler import ProfilerHook
+from sentinel_tpu.metrics.server import server_metrics
+
+_SM = server_metrics()
 
 
 class _BatchFrame:
@@ -168,7 +173,7 @@ class _LoopWorker:
                             record_log.warning("bad batch frame; closing")
                             return
                         srv.connections.touch(address)
-                        await self.queue.put((item, writer))
+                        await self.queue.put((item, writer, loop.time()))
                         continue
                     try:
                         req = P.decode_request(payload)
@@ -193,7 +198,7 @@ class _LoopWorker:
                         await writer.drain()
                     else:
                         srv.connections.touch(address)
-                        await self.queue.put((req, writer))
+                        await self.queue.put((req, writer, loop.time()))
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -226,7 +231,7 @@ class _LoopWorker:
         loop = asyncio.get_running_loop()
         while True:
             first = await self.queue.get()
-            batch: List[Tuple[object, asyncio.StreamWriter]] = [first]
+            batch: List[Tuple[object, asyncio.StreamWriter, float]] = [first]
             total = self._n_requests(first[0])
             while total < srv.max_batch:
                 try:
@@ -252,6 +257,16 @@ class _LoopWorker:
                         break
                     batch.append(item)
                     total += self._n_requests(item[0])
+            # stage metrics: enqueue→drain wait per queue item (one frame =
+            # one item, so this stays O(items), not O(requests)) + the batch
+            # size distribution the adaptive batcher actually produced
+            t_drain = loop.time()
+            for queued_item in batch:
+                _SM.queue_wait_ms.record(
+                    (t_drain - queued_item[2]) * 1e3,
+                    self._n_requests(queued_item[0]),
+                )
+            _SM.batch_size.record(total)
             await sem.acquire()
             self.inflight += 1
             task = loop.create_task(self._process(batch, total))
@@ -276,7 +291,7 @@ class _LoopWorker:
         # acquire/release to the host-side semaphore path
         flow_singles: List[Tuple[int, P.FlowRequest]] = []
         batch_frames: List[Tuple[int, _BatchFrame]] = []
-        for i, (item, _) in enumerate(batch):
+        for i, (item, _w, _t) in enumerate(batch):
             if isinstance(item, _BatchFrame):
                 batch_frames.append((i, item))
             elif item.msg_type == P.MsgType.FLOW:
@@ -315,6 +330,7 @@ class _LoopWorker:
             flow_ids = ids_parts[0] if len(ids_parts) == 1 else np.concatenate(ids_parts)
             counts = cnt_parts[0] if len(cnt_parts) == 1 else np.concatenate(cnt_parts)
             prios = prio_parts[0] if len(prio_parts) == 1 else np.concatenate(prio_parts)
+            t_decide = time.perf_counter()
             try:
                 dispatch = getattr(service, "dispatch_batch_arrays", None)
                 if dispatch is not None:
@@ -351,6 +367,7 @@ class _LoopWorker:
                 status = np.full(n_flow, int(TokenStatus.FAIL), np.int8)
                 remaining = np.zeros(n_flow, np.int32)
                 wait = np.zeros(n_flow, np.int32)
+            _SM.decide_ms.record((time.perf_counter() - t_decide) * 1e3)
             off = 0
             for i, f in batch_frames:
                 k = len(f.flow_ids)
@@ -394,16 +411,17 @@ class _LoopWorker:
 
         host_side = [
             (i, req)
-            for i, (req, _) in enumerate(batch)
+            for i, (req, _w, _t) in enumerate(batch)
             if not isinstance(req, _BatchFrame)
             and req.msg_type != P.MsgType.FLOW
         ]
         is_host_side = {i for i, _ in host_side}
 
         async def write_out(indices) -> None:
+            t_write = time.perf_counter()
             writers_to_drain = set()
             for i in indices:
-                item, writer = batch[i]
+                item, writer, _t_enq = batch[i]
                 try:
                     if isinstance(item, _BatchFrame):
                         sliced = frame_slices.get(i)
@@ -440,6 +458,7 @@ class _LoopWorker:
                     await writer.drain()
                 except Exception:
                     pass
+            _SM.write_ms.record((time.perf_counter() - t_write) * 1e3)
 
         # flow verdicts go out the moment they're materialized, CONCURRENT
         # with the host-side (param/concurrent) work — neither plane may
@@ -472,6 +491,7 @@ class TokenServer:
         max_inflight: int = 2,
         idle_ttl_s: Optional[float] = 600.0,
         profile_dir: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ):
         self.service = service
         self.host = host
@@ -501,7 +521,15 @@ class TokenServer:
         self.profile_dir = profile_dir or os.environ.get(
             "SENTINEL_PROFILE_DIR"
         ) or None
-        self._profiling = False
+        # on-demand trace control for the cluster/server/profiler command;
+        # start() opens an always-on trace through it when profile_dir is set
+        self.profiler = ProfilerHook(default_dir=self.profile_dir)
+        # optional standalone Prometheus endpoint (GET /metrics): the command
+        # center already serves the same body at /metric/prometheus, but a
+        # token server often runs without one — 0 picks a free port
+        self.metrics_port = metrics_port
+        self._metrics_exporter = None
+        self._gauge_fns: Dict[str, object] = {}
 
     def tuning_kwargs(self) -> dict:
         """Operator-tunable constructor kwargs, for rebuilding this server on
@@ -515,6 +543,7 @@ class TokenServer:
             max_inflight=self.max_inflight,
             idle_ttl_s=self.idle_ttl_s,
             profile_dir=self.profile_dir,
+            metrics_port=self.metrics_port,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -535,11 +564,7 @@ class TokenServer:
             reopen()  # re-arm background sweeps a prior stop() released
         if self.profile_dir:
             try:
-                import jax.profiler
-
-                jax.profiler.start_trace(self.profile_dir)
-                self._profiling = True
-                record_log.info("profiling serve loop to %s", self.profile_dir)
+                self.profiler.start(self.profile_dir)
             except Exception:
                 record_log.exception("profiler start failed; serving anyway")
         if self.n_loops > 1 and not hasattr(socket, "SO_REUSEPORT"):
@@ -567,16 +592,40 @@ class TokenServer:
                 self.connections, ttl_s=self.idle_ttl_s
             )
             self._idle_task.start()
+        # live gauges: scrape-time reads off the running workers (queue.qsize
+        # is loop-thread-unsafe only for mutation; a racy read is fine for a
+        # gauge). Registered per start() and torn down matched in stop() so
+        # a replacement server's readers survive the old one's teardown.
+        self._gauge_fns = {
+            "queue_depth": lambda: sum(
+                w.queue.qsize() for w in self._workers if w.queue is not None
+            ),
+            "inflight_batches": lambda: sum(
+                w.inflight for w in self._workers
+            ),
+            "connections": lambda: sum(
+                len(addrs) for addrs in self.connections.snapshot().values()
+            ),
+        }
+        for name, fn in self._gauge_fns.items():
+            _SM.register_gauge(name, fn)
+        if self.metrics_port is not None:
+            from sentinel_tpu.metrics.exporter import PrometheusExporter
+
+            self._metrics_exporter = PrometheusExporter(
+                host="0.0.0.0", port=self.metrics_port
+            ).start()
+            self.metrics_port = self._metrics_exporter.port  # resolve port 0
 
     def stop(self) -> None:
-        if self._profiling:
-            self._profiling = False
-            try:
-                import jax.profiler
-
-                jax.profiler.stop_trace()
-            except Exception:
-                record_log.exception("profiler stop failed")
+        if self.profiler.active:
+            self.profiler.stop()
+        if self._metrics_exporter is not None:
+            self._metrics_exporter.stop()
+            self._metrics_exporter = None
+        for name, fn in getattr(self, "_gauge_fns", {}).items():
+            _SM.unregister_gauge(name, fn)
+        self._gauge_fns = {}
         if self._idle_task is not None:
             self._idle_task.stop()
             self._idle_task = None

@@ -26,6 +26,7 @@ device step (the same overlap the asyncio server got from ``to_thread``).
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -35,6 +36,10 @@ from sentinel_tpu.cluster.connection import ConnectionManager
 from sentinel_tpu.cluster.token_service import TokenService
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
+from sentinel_tpu.metrics.profiler import ProfilerHook
+from sentinel_tpu.metrics.server import server_metrics
+
+_SM = server_metrics()
 
 
 def native_available() -> bool:
@@ -56,6 +61,8 @@ class NativeTokenServer:
         n_dispatchers: int = 2,
         idle_ttl_s: Optional[float] = 600.0,
         arena_cap: int = 65536,
+        profile_dir: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ):
         from sentinel_tpu.native.lib import Frontdoor  # raises if unbuilt
 
@@ -74,6 +81,13 @@ class NativeTokenServer:
         self.connections = ConnectionManager(on_count_changed=notify)
         self._addr_by_conn = {}  # (fd, gen) → address
         self._addr_lock = threading.Lock()
+        # same observability surface as the asyncio front door: opt-in
+        # profiler command target + optional standalone /metrics endpoint
+        self.profile_dir = profile_dir
+        self.profiler = ProfilerHook(default_dir=profile_dir)
+        self.metrics_port = metrics_port
+        self._metrics_exporter = None
+        self._gauge_fns: dict = {}
 
     def tuning_kwargs(self) -> dict:
         return dict(
@@ -81,6 +95,8 @@ class NativeTokenServer:
             n_dispatchers=self.n_dispatchers,
             idle_ttl_s=self.idle_ttl_s,
             arena_cap=self.arena_cap,
+            profile_dir=self.profile_dir,
+            metrics_port=self.metrics_port,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -113,6 +129,32 @@ class NativeTokenServer:
         )
         t.start()
         self._threads.append(t)
+        if self.profile_dir:
+            try:
+                self.profiler.start(self.profile_dir)
+            except Exception:
+                record_log.exception("profiler start failed; serving anyway")
+        # gauges: the native door keeps its own counters (stats()); surface
+        # the in-flight depth and the namespace connection groups. The C++
+        # plane owns the request queue, so queue_depth reads pending frames
+        # when the door exports them, else 0.
+        self._gauge_fns = {
+            "queue_depth": lambda: float(
+                (self.stats() or {}).get("pending_frames", 0)
+            ),
+            "connections": lambda: sum(
+                len(addrs) for addrs in self.connections.snapshot().values()
+            ),
+        }
+        for name, fn in self._gauge_fns.items():
+            _SM.register_gauge(name, fn)
+        if self.metrics_port is not None:
+            from sentinel_tpu.metrics.exporter import PrometheusExporter
+
+            self._metrics_exporter = PrometheusExporter(
+                host="0.0.0.0", port=self.metrics_port
+            ).start()
+            self.metrics_port = self._metrics_exporter.port
         record_log.info(
             "native token server listening on %s:%d (%d dispatchers)",
             self.host, self.port, self.n_dispatchers,
@@ -121,6 +163,14 @@ class NativeTokenServer:
     def stop(self) -> None:
         if self._door is None:
             return
+        if self.profiler.active:
+            self.profiler.stop()
+        if self._metrics_exporter is not None:
+            self._metrics_exporter.stop()
+            self._metrics_exporter = None
+        for name, fn in self._gauge_fns.items():
+            _SM.unregister_gauge(name, fn)
+        self._gauge_fns = {}
         self._stop.set()
         self._door.stop()
         for t in self._threads:
@@ -155,6 +205,8 @@ class NativeTokenServer:
             if got is None:
                 continue
             ids, counts, prios, frames = got
+            _SM.batch_size.record(len(ids))
+            t_decide = time.perf_counter()
             try:
                 # pulls larger than the engine batch size pipeline
                 # internally: request_batch_arrays dispatches ALL chunk
@@ -172,11 +224,14 @@ class NativeTokenServer:
                 status = np.full(n, int(TokenStatus.FAIL), np.int8)
                 remaining = np.zeros(n, np.int32)
                 wait = np.zeros(n, np.int32)
+            t_write = time.perf_counter()
+            _SM.decide_ms.record((t_write - t_decide) * 1e3)
             try:
                 door.submit(frames, status, remaining, wait)
             except Exception:
                 if not self._stop.is_set():
                     record_log.exception("native submit failed")
+            _SM.write_ms.record((time.perf_counter() - t_write) * 1e3)
 
     # -- control plane ------------------------------------------------------
     def _control_loop(self) -> None:

@@ -41,6 +41,10 @@ from sentinel_tpu.engine.param import (
     param_decide,
 )
 from sentinel_tpu.engine.rules import RuleIndex
+from sentinel_tpu.metrics.server import server_metrics
+from sentinel_tpu.metrics.stat_logger import log_cluster
+
+_SM = server_metrics()
 
 
 @dataclass(frozen=True)
@@ -181,6 +185,12 @@ class DefaultTokenService(TokenService):
         # vectorized flow_id → slot lookup: one (sorted keys, slots) tuple,
         # swapped atomically on rule load, read lock-free on the hot path
         self._lookup = (np.empty(0, np.int64), np.empty(0, np.int32))
+        # slot → namespace row snapshot for per-namespace verdict counters,
+        # same atomic-swap discipline: (names tuple, int32[max_flows] of
+        # namespace indices, -1 where the slot holds no rule)
+        self._ns_snapshot: Tuple[Tuple[str, ...], np.ndarray] = (
+            (), np.full(self.config.max_flows, -1, np.int32),
+        )
         self._epoch_ms: Optional[int] = None
         self._connected: Dict[str, int] = {}  # namespace → client count
         self._ns_max_qps = 30_000.0
@@ -301,6 +311,19 @@ class DefaultTokenService(TokenService):
                 np.fromiter((k for k, _ in items), np.int64, len(items)),
                 np.fromiter((v for _, v in items), np.int32, len(items)),
             )
+            # rebuild the slot → namespace snapshot for the verdict counters
+            # (ns_of rows persist across reloads, so removed namespaces keep
+            # their index; only live rules point at them)
+            n_ns = max(self._index.ns_of.values(), default=-1) + 1
+            ns_names = [""] * n_ns
+            for ns_name, row in self._index.ns_of.items():
+                ns_names[row] = ns_name
+            slot_ns = np.full(self.config.max_flows, -1, np.int32)
+            for r in rules:
+                slot_ns[self._index.slot_of[r.flow_id]] = (
+                    self._index.ns_of[r.namespace]
+                )
+            self._ns_snapshot = (tuple(ns_names), slot_ns)
 
     def load_namespace_rules(
         self, namespace: str, rules: List[ClusterFlowRule]
@@ -583,10 +606,17 @@ class DefaultTokenService(TokenService):
                 status[order] = status_sorted
                 remaining[order] = remaining_sorted
                 wait[order] = wait_sorted
+            # per-namespace verdict counters (sentinel_server_verdicts_total):
+            # attribute each request's verdict to its rule's namespace via
+            # the lock-free slot→namespace snapshot. `slots` is request-order
+            # (the closure sees the re-prepped assignment after a reload).
+            ns_names, slot_ns = self._ns_snapshot
+            ns_idx = np.where(
+                slots >= 0, slot_ns[np.maximum(slots, 0)], np.int32(-1)
+            )
+            _SM.record_verdict_batch(status, ns_idx, ns_names)
             # cluster server stat log (ClusterServerStatLogUtil analog): one
             # aggregated counter per verdict class per window
-            from sentinel_tpu.metrics.stat_logger import log_cluster
-
             for event, code in (
                 ("pass", int(TokenStatus.OK)),
                 ("block", int(TokenStatus.BLOCKED)),

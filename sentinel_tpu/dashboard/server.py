@@ -1047,6 +1047,10 @@ class DashboardServer:
                             m, "cluster/server/info") or {},
                         "metrics": self.client.fetch_json(
                             m, "cluster/server/metrics") or {},
+                        # pipeline breakdown: verdict counters by namespace,
+                        # stage latency histograms, queue/connection gauges
+                        "stats": self.client.fetch_json(
+                            m, "clusterServerStats") or {},
                     })
                 elif mode == 0:
                     out["clients"].append({

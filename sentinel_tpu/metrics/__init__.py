@@ -15,6 +15,13 @@ from sentinel_tpu.metrics.extension import (
     register_extension,
     clear_extensions_for_tests,
 )
+from sentinel_tpu.metrics.histogram import LatencyHistogram, log_buckets
+from sentinel_tpu.metrics.profiler import ProfilerHook
+from sentinel_tpu.metrics.server import (
+    ServerMetrics,
+    reset_server_metrics_for_tests,
+    server_metrics,
+)
 from sentinel_tpu.metrics.exporter import PrometheusExporter, render
 
 __all__ = [
@@ -25,6 +32,12 @@ __all__ = [
     "MetricExtension",
     "register_extension",
     "clear_extensions_for_tests",
+    "LatencyHistogram",
+    "log_buckets",
+    "ProfilerHook",
+    "ServerMetrics",
+    "server_metrics",
+    "reset_server_metrics_for_tests",
     "PrometheusExporter",
     "render",
 ]

@@ -13,6 +13,15 @@ Exposed series (labels: ``resource``):
 - ``sentinel_rt_avg_ms`` — average response time over the window
 - ``sentinel_concurrency`` — current in-flight entries
 
+Alongside the window gauges, two cumulative ``counter`` series
+(``sentinel_pass_total`` / ``sentinel_block_total``, fed by a built-in
+:class:`MetricExtension` on the entry hot path) give scrapers proper
+``rate()``-able totals, and the body ends with the token server's
+``sentinel_server_*`` section (:mod:`sentinel_tpu.metrics.server`). The
+exposition is 0.0.4: newline-terminated, no ``# EOF`` marker (that is
+OpenMetrics 1.0; sending it under the 0.0.4 content type breaks strict
+parsers).
+
 Serve standalone via :class:`PrometheusExporter` (its own port, like the
 JMX exporter's own registry), or mount :func:`render` under any existing
 HTTP surface (the command center registers it at ``/metric/prometheus``).
@@ -20,11 +29,14 @@ HTTP surface (the command center registers it at ``/metric/prometheus``).
 
 from __future__ import annotations
 
-from typing import Optional
+import threading
+from typing import Dict, Optional, Tuple
 
 from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.httpd import HttpService, Response
 from sentinel_tpu.local import chain as _chain
+from sentinel_tpu.metrics import extension as _ext
+from sentinel_tpu.metrics.server import server_metrics
 
 _HELP = """\
 # HELP sentinel_pass_qps Admitted requests per second (1s sliding window).
@@ -46,11 +58,68 @@ def _escape(label: str) -> str:
     return label.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+class _CumulativeCounters(_ext.MetricExtension):
+    """Built-in extension feeding ``sentinel_pass_total`` /
+    ``sentinel_block_total`` — the window gauges answer "how fast right
+    now", these answer "how much since start", which is what Prometheus
+    ``rate()``/``increase()`` want as input."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pass: Dict[str, int] = {}
+        self._block: Dict[str, int] = {}
+
+    def add_pass(self, resource: str, n: int, args) -> None:
+        with self._lock:
+            self._pass[resource] = self._pass.get(resource, 0) + n
+
+    def add_block(self, resource: str, n: int, origin, error, args) -> None:
+        with self._lock:
+            self._block[resource] = self._block.get(resource, 0) + n
+
+    def totals(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        with self._lock:
+            return dict(self._pass), dict(self._block)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pass.clear()
+            self._block.clear()
+
+
+_COUNTERS = _CumulativeCounters()
+_ENSURE_LOCK = threading.Lock()
+
+
+def _ensure_counters_registered() -> None:
+    """(Re)register the counter extension. ``clear_extensions_for_tests``
+    wipes the registry between tests; re-arming at render time (with a data
+    reset, so each re-arm starts a fresh cumulative epoch) keeps production
+    monotonic and tests deterministic."""
+    with _ENSURE_LOCK:
+        if _COUNTERS not in _ext.get_extensions():
+            _COUNTERS.reset()
+            _ext.register_extension(_COUNTERS)
+
+
+_ensure_counters_registered()
+
+_COUNTER_HELP = """\
+# HELP sentinel_pass_total Admitted requests since process start.
+# TYPE sentinel_pass_total counter
+# HELP sentinel_block_total Blocked requests since process start.
+# TYPE sentinel_block_total counter\
+"""
+
+
 def render(now_ms: Optional[int] = None) -> str:
-    """Prometheus text exposition of every resource's live window stats."""
+    """Prometheus text exposition: per-resource window gauges + cumulative
+    counters + the token server's ``sentinel_server_*`` section."""
+    _ensure_counters_registered()
     now = _clock.now_ms() if now_ms is None else now_ms
-    lines = [_HELP]
-    for name, node in sorted(_chain.cluster_node_map().items()):
+    lines = [_HELP.rstrip("\n")]
+    node_map = _chain.cluster_node_map()
+    for name, node in sorted(node_map.items()):
         label = f'{{resource="{_escape(name)}"}}'
         success = node.success_qps(now)
         avg_rt = node.avg_rt(now)
@@ -63,6 +132,13 @@ def render(now_ms: Optional[int] = None) -> str:
             ("sentinel_concurrency", node.cur_thread_num),
         ):
             lines.append(f"{metric}{label} {value:g}")
+    passed, blocked = _COUNTERS.totals()
+    lines.append(_COUNTER_HELP)
+    for name in sorted(set(node_map) | set(passed) | set(blocked)):
+        label = f'{{resource="{_escape(name)}"}}'
+        lines.append(f"sentinel_pass_total{label} {passed.get(name, 0)}")
+        lines.append(f"sentinel_block_total{label} {blocked.get(name, 0)}")
+    lines.append(server_metrics().render())
     return "\n".join(lines) + "\n"
 
 

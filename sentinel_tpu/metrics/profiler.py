@@ -1,0 +1,73 @@
+"""Opt-in JAX profiler control for a live server.
+
+``TokenServer``/``NativeTokenServer`` own one :class:`ProfilerHook` each so
+the ``cluster/server/profiler`` command can start/stop a device trace on a
+serving process without a restart (the always-on ``profile_dir`` /
+``SENTINEL_PROFILE_DIR`` path stays — this is the on-demand variant).
+jax.profiler allows ONE active trace per process; the hook serializes
+start/stop and reports a clean error instead of the profiler's RuntimeError
+when a trace is already running.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from sentinel_tpu.core.log import record_log
+
+
+class ProfilerHook:
+    def __init__(self, default_dir: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.default_dir = default_dir
+        self.trace_dir: Optional[str] = None
+
+    @property
+    def active(self) -> bool:
+        return self.trace_dir is not None
+
+    def start(self, trace_dir: Optional[str] = None) -> dict:
+        with self._lock:
+            if self.trace_dir is not None:
+                return {
+                    "error": f"already profiling to {self.trace_dir}",
+                    "profiling": True, "dir": self.trace_dir,
+                }
+            target = trace_dir or self.default_dir
+            if not target:
+                return {"error": "trace dir required (dir= or profile_dir)"}
+            import jax.profiler
+
+            jax.profiler.start_trace(target)
+            self.trace_dir = target
+            record_log.info("profiler trace started → %s", target)
+            return {"profiling": True, "dir": target}
+
+    def stop(self) -> dict:
+        with self._lock:
+            if self.trace_dir is None:
+                return {"error": "not profiling", "profiling": False}
+            target, self.trace_dir = self.trace_dir, None
+            import jax.profiler
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                record_log.exception("profiler stop failed")
+                return {"error": "profiler stop failed", "dir": target,
+                        "profiling": False}
+            record_log.info("profiler trace written → %s", target)
+            return {"profiling": False, "dir": target}
+
+    def status(self) -> dict:
+        return {"profiling": self.active, "dir": self.trace_dir}
+
+
+_DEFAULT = ProfilerHook()
+
+
+def default_hook() -> ProfilerHook:
+    """Process-wide hook for the command surface when no token server is
+    embedded (profiles whatever JAX work this process runs)."""
+    return _DEFAULT

@@ -1,0 +1,289 @@
+"""Server-side pipeline metrics: stage histograms, verdict counters, gauges.
+
+The analog of the reference's ``ClusterServerStatLogUtil`` + dashboard state
+commands, grown into an always-on Prometheus surface: the ``TokenServer``
+micro-batcher (asyncio and native front doors) records per-stage timings
+here, ``DefaultTokenService`` feeds per-namespace verdict counters from each
+materialized batch, and the Envoy RLS adapter mirrors its OK/OVER_LIMIT
+responses in. One process-wide singleton — multiple servers in one process
+(tests, port moves) share it, which matches Prometheus's per-process scrape
+model.
+
+Everything here renders under the ``sentinel_server_*`` prefix via
+:func:`ServerMetrics.render` (appended to the exporter body) and as JSON via
+:func:`ServerMetrics.snapshot` (the ``clusterServerStats`` command).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.metrics.histogram import LatencyHistogram
+
+# TokenStatus codes that appear on the flow batch path → series label.
+# (RELEASE_OK / ALREADY_RELEASE ride the host-side concurrent path, which
+# answers per-request, not per-batch — they never reach this counter.)
+VERDICT_NAMES: Dict[int, str] = {
+    0: "pass",            # OK
+    1: "block",           # BLOCKED
+    2: "should_wait",     # SHOULD_WAIT (occupied-ahead admission)
+    3: "no_rule",         # NO_RULE_EXISTS
+    4: "too_many_request",  # namespace guard tripped
+    5: "fail",            # device step failed / degraded
+}
+
+NO_RULE_NAMESPACE = "(no-rule)"  # requests whose flow_id has no loaded rule
+
+
+def _escape(label: str) -> str:
+    return label.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _RateWindow:
+    """Windowed events/sec over the last ``seconds`` wall seconds, current
+    second included (so short-lived tests and fresh servers report > 0)."""
+
+    def __init__(self, seconds: int = 8):
+        self.seconds = max(1, int(seconds))
+        self._slots = [(-1, 0)] * self.seconds  # (second, count)
+        self._lock = threading.Lock()
+
+    def add(self, n: int) -> None:
+        sec = _clock.now_ms() // 1000
+        i = sec % self.seconds
+        with self._lock:
+            slot_sec, count = self._slots[i]
+            self._slots[i] = (sec, count + n if slot_sec == sec else n)
+
+    def rate(self) -> float:
+        sec = _clock.now_ms() // 1000
+        lo = sec - self.seconds + 1
+        with self._lock:
+            total = sum(c for s, c in self._slots if s >= lo)
+        return total / float(self.seconds)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slots = [(-1, 0)] * self.seconds
+
+
+class ServerMetrics:
+    """All ``sentinel_server_*`` state for this process's token server(s)."""
+
+    # gauges every scrape shows even before a server registers a live reader
+    _GAUGE_NAMES = ("queue_depth", "inflight_batches", "connections")
+
+    def __init__(self):
+        # stage histograms, all in milliseconds except batch_size (requests).
+        # 1µs..10s covers a sub-100µs device step and a 1s cold compile alike.
+        self.queue_wait_ms = LatencyHistogram(lo=0.001, hi=10_000.0)
+        self.decide_ms = LatencyHistogram(lo=0.001, hi=10_000.0)
+        self.write_ms = LatencyHistogram(lo=0.001, hi=10_000.0)
+        self.batch_size = LatencyHistogram(
+            bounds=[float(1 << i) for i in range(17)]  # 1..65536, ×2 ladder
+        )
+        self._verdicts: Dict[Tuple[str, str], int] = {}
+        self._verdict_lock = threading.Lock()
+        self._rate = _RateWindow()
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._gauge_lock = threading.Lock()
+
+    # -- verdict counters ---------------------------------------------------
+    def count_verdict(self, verdict: str, namespace: str, n: int = 1) -> None:
+        key = (verdict, namespace)
+        with self._verdict_lock:
+            self._verdicts[key] = self._verdicts.get(key, 0) + n
+
+    def record_verdict_batch(
+        self,
+        status: np.ndarray,
+        ns_idx: Optional[np.ndarray],
+        ns_names: Tuple[str, ...],
+    ) -> None:
+        """Count one materialized batch: ``status`` int8[N] TokenStatus
+        codes, ``ns_idx`` int32[N] namespace row per request (-1 → no rule;
+        None → attribute everything to ``(no-rule)``). Vectorized — a few
+        masked bincounts per batch, never a Python loop over requests."""
+        status = np.asarray(status)
+        n = int(status.shape[0])
+        if n == 0:
+            return
+        self._rate.add(n)
+        updates: Dict[Tuple[str, str], int] = {}
+        for code, vname in VERDICT_NAMES.items():
+            mask = status == code
+            hits = int(mask.sum())
+            if not hits:
+                continue
+            if ns_idx is None or not len(ns_names):
+                updates[(vname, NO_RULE_NAMESPACE)] = hits
+                continue
+            counts = np.bincount(
+                ns_idx[mask] + 1, minlength=len(ns_names) + 1
+            )
+            if counts[0]:
+                updates[(vname, NO_RULE_NAMESPACE)] = int(counts[0])
+            for j in np.nonzero(counts[1:])[0]:
+                updates[(vname, ns_names[int(j)])] = int(counts[1 + j])
+        with self._verdict_lock:
+            for key, v in updates.items():
+                self._verdicts[key] = self._verdicts.get(key, 0) + v
+
+    def count_rls(self, domain: str, ok_n: int, over_n: int) -> None:
+        """Envoy RLS responses, per domain. The descriptors already counted
+        once on the engine path under their rule namespace; this adds the
+        RLS-shaped view (``namespace="rls:<domain>"``) without touching the
+        verdicts/sec rate (no double counting)."""
+        ns = f"rls:{domain}"
+        with self._verdict_lock:
+            if ok_n:
+                key = ("pass", ns)
+                self._verdicts[key] = self._verdicts.get(key, 0) + int(ok_n)
+            if over_n:
+                key = ("block", ns)
+                self._verdicts[key] = self._verdicts.get(key, 0) + int(over_n)
+
+    # -- gauges -------------------------------------------------------------
+    def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._gauge_lock:
+            self._gauges[name] = fn
+
+    def unregister_gauge(self, name: str, fn: Optional[Callable] = None) -> None:
+        """Remove a gauge; with ``fn`` given, only if it is still the
+        registered reader (a replacement server's gauge survives the old
+        server's teardown)."""
+        with self._gauge_lock:
+            if fn is None or self._gauges.get(name) is fn:
+                self._gauges.pop(name, None)
+
+    def _gauge_values(self) -> Dict[str, float]:
+        with self._gauge_lock:
+            readers = dict(self._gauges)
+        out = {name: 0.0 for name in self._GAUGE_NAMES}
+        for name, fn in readers.items():
+            try:
+                out[name] = float(fn())
+            except Exception:
+                out[name] = 0.0  # a dying server's reader must not 500 a scrape
+        return out
+
+    # -- snapshots ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON shape served by the ``clusterServerStats`` command — the
+        same numbers the Prometheus surface renders."""
+        with self._verdict_lock:
+            verdicts = [
+                {"verdict": v, "namespace": ns, "count": c}
+                for (v, ns), c in sorted(self._verdicts.items())
+            ]
+        return {
+            "verdicts": verdicts,
+            "verdictsPerSec": self._rate.rate(),
+            "stages": {
+                "queue_wait_ms": self.queue_wait_ms.snapshot(),
+                "decide_ms": self.decide_ms.snapshot(),
+                "write_ms": self.write_ms.snapshot(),
+                "batch_size": self.batch_size.snapshot(),
+            },
+            "gauges": self._gauge_values(),
+        }
+
+    def stage_snapshot(self) -> Dict[str, dict]:
+        """Trimmed per-stage view for bench artifacts: p50/p99/count."""
+        out = {}
+        for name, hist in (
+            ("queue_wait_ms", self.queue_wait_ms),
+            ("decide_ms", self.decide_ms),
+            ("write_ms", self.write_ms),
+            ("batch_size", self.batch_size),
+        ):
+            snap = hist.snapshot()
+            out[name] = {
+                "p50": snap["p50"], "p99": snap["p99"],
+                "count": snap["count"],
+            }
+        return out
+
+    def render(self) -> str:
+        """``sentinel_server_*`` Prometheus exposition (no trailing
+        newline; the exporter joins sections)."""
+        lines = [
+            "# HELP sentinel_server_verdicts_total Cluster token verdicts "
+            "by class and namespace (cumulative).",
+            "# TYPE sentinel_server_verdicts_total counter",
+        ]
+        with self._verdict_lock:
+            items = sorted(self._verdicts.items())
+        if items:
+            for (verdict, ns), count in items:
+                lines.append(
+                    "sentinel_server_verdicts_total"
+                    f'{{verdict="{_escape(verdict)}",'
+                    f'namespace="{_escape(ns)}"}} {count}'
+                )
+        else:
+            # zero-sample so the series exists on an idle server and rate()
+            # queries don't gap at startup
+            lines.append(
+                'sentinel_server_verdicts_total{verdict="pass",'
+                'namespace="default"} 0'
+            )
+        lines.append(
+            "# HELP sentinel_server_verdicts_per_sec Verdicts per second "
+            "(8s window)."
+        )
+        lines.append("# TYPE sentinel_server_verdicts_per_sec gauge")
+        lines.append(f"sentinel_server_verdicts_per_sec {self._rate.rate():g}")
+        gauges = self._gauge_values()
+        for name, help_text in (
+            ("queue_depth", "Requests queued awaiting a device step."),
+            ("inflight_batches", "Batches currently in the device pipeline."),
+            ("connections", "Open client connections."),
+        ):
+            lines.append(f"# HELP sentinel_server_{name} {help_text}")
+            lines.append(f"# TYPE sentinel_server_{name} gauge")
+            lines.append(f"sentinel_server_{name} {gauges[name]:g}")
+        for name, help_text, hist in (
+            ("sentinel_server_queue_wait_ms",
+             "Enqueue-to-batch-drain wait per queue item (ms).",
+             self.queue_wait_ms),
+            ("sentinel_server_decide_ms",
+             "Device decide step per batch, dispatch to materialized (ms).",
+             self.decide_ms),
+            ("sentinel_server_write_ms",
+             "Host write-out per batch: verdict encode + socket write (ms).",
+             self.write_ms),
+            ("sentinel_server_batch_size",
+             "Requests per device batch.",
+             self.batch_size),
+        ):
+            lines.append(hist.render_prometheus(name, help_text))
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        """Zero counters and histograms in place (gauge readers stay —
+        their owners' lifecycles manage them). Benches call this between
+        load points; tests via :func:`reset_server_metrics_for_tests`."""
+        self.queue_wait_ms.reset()
+        self.decide_ms.reset()
+        self.write_ms.reset()
+        self.batch_size.reset()
+        with self._verdict_lock:
+            self._verdicts.clear()
+        self._rate.reset()
+
+
+_SINGLETON = ServerMetrics()
+
+
+def server_metrics() -> ServerMetrics:
+    """The process-wide server metrics registry."""
+    return _SINGLETON
+
+
+def reset_server_metrics_for_tests() -> None:
+    _SINGLETON.reset()

@@ -421,6 +421,42 @@ def cmd_cluster_client_fetch_config(params, body):
     return dict(_CLUSTER_CLIENT_CONFIG)
 
 
+@command_mapping(
+    "clusterServerStats",
+    "token-server pipeline stats: verdict counters, stage histograms, gauges",
+)
+def cmd_cluster_server_stats(params, body):
+    """JSON twin of the ``sentinel_server_*`` Prometheus section — the
+    dashboard/command-center view of the serving pipeline."""
+    from sentinel_tpu.metrics.server import server_metrics
+
+    return server_metrics().snapshot()
+
+
+@command_mapping(
+    "cluster/server/profiler",
+    "JAX profiler trace control; action=start|stop|status [&dir=/tmp/trace]",
+)
+def cmd_cluster_server_profiler(params, body):
+    """Opt-in device-trace capture on a LIVE server: start writes a
+    TensorBoard/XProf trace of every device step until stop. Targets the
+    embedded token server's hook when one is running, else the process-wide
+    hook (profiles local JAX work)."""
+    from sentinel_tpu.metrics.profiler import default_hook
+
+    with _EMBEDDED_LOCK:
+        server = _EMBEDDED_SERVER["server"]
+    hook = getattr(server, "profiler", None) or default_hook()
+    action = params.get("action", "status")
+    if action == "start":
+        return hook.start(params.get("dir"))
+    if action == "stop":
+        return hook.stop()
+    if action == "status":
+        return hook.status()
+    return {"error": "action must be start|stop|status"}
+
+
 @command_mapping("cluster/server/metrics", "token-server per-flow metrics")
 def cmd_cluster_server_metrics(params, body):
     from sentinel_tpu.cluster import api as cluster_api

@@ -1,0 +1,156 @@
+"""LatencyHistogram: bucket layout, quantiles, concurrency, exposition."""
+
+import threading
+
+import pytest
+
+from sentinel_tpu.metrics.histogram import LatencyHistogram, log_buckets
+
+
+class TestLogBuckets:
+    def test_boundaries_geometric_and_rounded(self):
+        bounds = log_buckets(0.01, 100.0, per_decade=2)
+        assert bounds[0] == 0.01
+        assert bounds[-1] == 100.0
+        assert len(bounds) == 9  # 4 decades × 2 + the closing bound
+        assert all(b2 > b1 for b1, b2 in zip(bounds, bounds[1:]))
+        # intermediate bounds are rounded to 4 significant digits so the
+        # rendered `le` labels stay stable and readable
+        assert 0.03162 in bounds
+        assert 31.62 in bounds
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(ValueError):
+            log_buckets(0, 10)
+        with pytest.raises(ValueError):
+            log_buckets(10, 10)
+        with pytest.raises(ValueError):
+            log_buckets(1, 10, per_decade=0)
+
+    def test_bad_explicit_bounds_raise(self):
+        with pytest.raises(ValueError):
+            LatencyHistogram(bounds=[])
+        with pytest.raises(ValueError):
+            LatencyHistogram(bounds=[2.0, 1.0])
+        with pytest.raises(ValueError):
+            LatencyHistogram(bounds=[0.0, 1.0])
+
+
+class TestRecording:
+    def test_le_inclusive_bucketing(self):
+        h = LatencyHistogram(bounds=[1.0, 10.0, 100.0])
+        h.record(1.0)  # value == bound lands in that bucket (le semantics)
+        h.record(1.5)
+        h.record(100.0)
+        h.record(1000.0)  # above the last bound → +Inf overflow
+        text = h.render_prometheus("x_ms", "t")
+        assert 'x_ms_bucket{le="1"} 1' in text
+        assert 'x_ms_bucket{le="10"} 2' in text
+        assert 'x_ms_bucket{le="100"} 3' in text
+        assert 'x_ms_bucket{le="+Inf"} 4' in text
+        assert "x_ms_count 4" in text
+
+    def test_rejects_negative_nan_and_nonpositive_n(self):
+        h = LatencyHistogram(bounds=[1.0])
+        h.record(-0.5)
+        h.record(float("nan"))
+        h.record(1.0, n=0)
+        h.record(1.0, n=-3)
+        assert h.count == 0
+        assert h.snapshot()["p50"] is None
+
+    def test_weighted_record_and_reset(self):
+        h = LatencyHistogram(bounds=[1.0, 2.0])
+        h.record(0.5, n=10)
+        assert h.count == 10
+        assert h.sum == pytest.approx(5.0)
+        h.reset()
+        assert h.count == 0
+        assert h.snapshot()["count"] == 0
+
+
+class TestQuantiles:
+    def test_empty_snapshot(self):
+        snap = LatencyHistogram().snapshot()
+        assert snap["count"] == 0
+        assert snap["avg"] is None
+        assert snap["p50"] is None
+        assert snap["max"] is None
+
+    def test_interpolation_stays_inside_bucket(self):
+        h = LatencyHistogram(bounds=[1.0, 2.0, 4.0, 8.0])
+        for _ in range(100):
+            h.record(1.5)
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert snap["avg"] == pytest.approx(1.5)
+        assert snap["max"] == 1.5
+        # all mass in (1, 2]; interpolation is clamped to the observed max
+        assert 1.0 <= snap["p50"] <= 1.5
+        assert 1.0 <= snap["p99"] <= 1.5
+
+    def test_quantiles_order_across_buckets(self):
+        h = LatencyHistogram(bounds=[1.0, 2.0, 4.0, 8.0, 16.0])
+        for v in (0.5, 1.5, 3.0, 6.0, 12.0):
+            h.record(v, n=20)
+        p50, p90, p99 = h.quantile(0.5), h.quantile(0.9), h.quantile(0.99)
+        assert p50 <= p90 <= p99 <= 12.0
+
+    def test_overflow_bucket_clamps_to_observed_max(self):
+        h = LatencyHistogram(bounds=[1.0, 10.0])
+        h.record(5_000.0)
+        assert h.snapshot()["max"] == 5_000.0
+        # an outlier reports its real magnitude, not "somewhere above 10"
+        assert 10.0 <= h.quantile(0.5) <= 5_000.0
+        assert h.quantile(0.99) <= 5_000.0
+
+
+class TestConcurrentRecording:
+    def test_no_lost_counts_under_contention(self):
+        h = LatencyHistogram(bounds=[1.0, 2.0, 4.0])
+        n_threads, per_thread = 8, 5_000
+
+        def pump(k: int) -> None:
+            v = 0.5 * (k % 4 + 1)  # 0.5 / 1.0 / 1.5 / 2.0 — spread buckets
+            for _ in range(per_thread):
+                h.record(v)
+
+        threads = [
+            threading.Thread(target=pump, args=(k,)) for k in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count == n_threads * per_thread
+        # two threads per value → Σ = 2 × per_thread × (0.5+1+1.5+2)
+        assert h.sum == pytest.approx(2 * per_thread * 5.0)
+        text = h.render_prometheus("c_ms", "t")
+        assert f'c_ms_bucket{{le="+Inf"}} {n_threads * per_thread}' in text
+
+
+class TestRenderPrometheus:
+    def test_labels_merge_with_le(self):
+        h = LatencyHistogram(bounds=[1.0, 2.0])
+        h.record(1.5)
+        text = h.render_prometheus("y_ms", "help here", labels='stage="decide"')
+        assert "# HELP y_ms help here" in text
+        assert "# TYPE y_ms histogram" in text
+        assert 'y_ms_bucket{stage="decide",le="1"} 0' in text
+        assert 'y_ms_bucket{stage="decide",le="2"} 1' in text
+        assert 'y_ms_bucket{stage="decide",le="+Inf"} 1' in text
+        assert 'y_ms_sum{stage="decide"} 1.5' in text
+        assert 'y_ms_count{stage="decide"} 1' in text
+
+    def test_buckets_are_cumulative(self):
+        h = LatencyHistogram(bounds=[1.0, 2.0, 4.0])
+        for v in (0.5, 1.5, 3.0, 9.0):
+            h.record(v)
+        text = h.render_prometheus("z_ms", "t")
+        counts = [
+            int(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("z_ms_bucket")
+        ]
+        assert counts == [1, 2, 3, 4]
+        assert all(b >= a for a, b in zip(counts, counts[1:]))

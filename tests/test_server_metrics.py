@@ -1,0 +1,171 @@
+"""Token-server observability: sentinel_server_* surface + stats command."""
+
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.server import TokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.metrics.server import (
+    reset_server_metrics_for_tests,
+    server_metrics,
+)
+
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+G = ThresholdMode.GLOBAL
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    # the registry is process-wide (Prometheus scrape model) — other tests'
+    # server traffic would otherwise leak into these assertions
+    reset_server_metrics_for_tests()
+    yield
+    reset_server_metrics_for_tests()
+
+
+class TestServerMetricsRegistry:
+    def test_empty_render_exposes_every_series(self):
+        text = server_metrics().render()
+        # zero-sample so rate() queries don't gap on an idle server
+        assert (
+            'sentinel_server_verdicts_total{verdict="pass",'
+            'namespace="default"} 0' in text
+        )
+        assert "# TYPE sentinel_server_verdicts_total counter" in text
+        assert "sentinel_server_verdicts_per_sec 0" in text
+        assert "sentinel_server_queue_depth 0" in text
+        assert "sentinel_server_inflight_batches 0" in text
+        assert "sentinel_server_connections 0" in text
+        for h in ("queue_wait_ms", "decide_ms", "write_ms", "batch_size"):
+            assert f"# TYPE sentinel_server_{h} histogram" in text
+            assert f"sentinel_server_{h}_count 0" in text
+
+    def test_record_verdict_batch_attributes_namespaces(self):
+        m = server_metrics()
+        status = np.array([0, 0, 1, 3], np.int8)
+        ns_idx = np.array([0, 1, 0, -1], np.int32)
+        m.record_verdict_batch(status, ns_idx, ("ns-a", "ns-b"))
+        got = {
+            (v["verdict"], v["namespace"]): v["count"]
+            for v in m.snapshot()["verdicts"]
+        }
+        assert got[("pass", "ns-a")] == 1
+        assert got[("pass", "ns-b")] == 1
+        assert got[("block", "ns-a")] == 1
+        assert got[("no_rule", "(no-rule)")] == 1
+
+    def test_record_verdict_batch_without_ns_map(self):
+        m = server_metrics()
+        m.record_verdict_batch(np.array([0, 1], np.int8), None, ())
+        got = {
+            (v["verdict"], v["namespace"]): v["count"]
+            for v in m.snapshot()["verdicts"]
+        }
+        assert got[("pass", "(no-rule)")] == 1
+        assert got[("block", "(no-rule)")] == 1
+
+    def test_count_rls_labels_domain(self):
+        m = server_metrics()
+        m.count_rls("edge", ok_n=3, over_n=2)
+        text = m.render()
+        assert (
+            'sentinel_server_verdicts_total{verdict="pass",'
+            'namespace="rls:edge"} 3' in text
+        )
+        assert (
+            'sentinel_server_verdicts_total{verdict="block",'
+            'namespace="rls:edge"} 2' in text
+        )
+
+    def test_gauge_unregister_is_fn_matched(self):
+        m = server_metrics()
+        old = lambda: 5.0  # noqa: E731
+        new = lambda: 7.0  # noqa: E731
+        m.register_gauge("queue_depth", old)
+        m.register_gauge("queue_depth", new)  # replacement server took over
+        m.unregister_gauge("queue_depth", old)  # old server teardown: no-op
+        assert m._gauge_values()["queue_depth"] == 7.0
+        m.unregister_gauge("queue_depth", new)
+        assert m._gauge_values()["queue_depth"] == 0.0
+
+    def test_broken_gauge_reader_must_not_fail_a_scrape(self):
+        m = server_metrics()
+
+        def boom() -> float:
+            raise RuntimeError("dying server")
+
+        m.register_gauge("connections", boom)
+        assert m._gauge_values()["connections"] == 0.0
+        assert "sentinel_server_connections 0" in m.render()
+        m.unregister_gauge("connections", boom)
+
+
+class TestLiveServerSurface:
+    def test_scrape_and_stats_command_reflect_traffic(self):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([
+            ClusterFlowRule(flow_id=7, count=5.0, mode=G, namespace="ns-a")
+        ])
+        server = TokenServer(svc, port=0, metrics_port=0, batch_window_ms=0.5)
+        server.start()
+        client = None
+        try:
+            client = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+            oks = sum(1 for _ in range(8) if client.request_token(7).ok)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.metrics_port}/metrics", timeout=5
+            ) as rsp:
+                ctype = rsp.headers.get("Content-Type", "")
+                body = rsp.read().decode()
+
+            # plain 0.0.4 exposition: versioned content type, newline
+            # terminated, no OpenMetrics EOF marker
+            assert "version=0.0.4" in ctype
+            assert body.endswith("\n")
+            assert "# EOF" not in body
+
+            assert (
+                f'sentinel_server_verdicts_total{{verdict="pass",'
+                f'namespace="ns-a"}} {oks}' in body
+            )
+            decide = re.search(
+                r"^sentinel_server_decide_ms_count (\d+)$", body, re.M
+            )
+            assert decide and int(decide.group(1)) > 0
+            batches = re.search(
+                r"^sentinel_server_batch_size_count (\d+)$", body, re.M
+            )
+            assert batches and int(batches.group(1)) > 0
+            assert re.search(r"^sentinel_server_queue_depth \d", body, re.M)
+            assert re.search(r"^sentinel_server_connections \d", body, re.M)
+            # local-engine cumulative counters ride the same body
+            assert "sentinel_pass_total" in body
+
+            # the stats command serves the same numbers as JSON
+            import sentinel_tpu.transport.handlers  # noqa: F401  (registers commands)
+            from sentinel_tpu.transport.command import get_command
+
+            stats = get_command("clusterServerStats")({}, "")
+            got = {
+                (v["verdict"], v["namespace"]): v["count"]
+                for v in stats["verdicts"]
+            }
+            assert got[("pass", "ns-a")] == oks
+            assert stats["stages"]["decide_ms"]["count"] == int(
+                decide.group(1)
+            )
+            assert "queue_depth" in stats["gauges"]
+
+            prof = get_command("cluster/server/profiler")({}, "")
+            assert prof.get("profiling") is False
+        finally:
+            if client is not None:
+                client.close()
+            server.stop()
+            svc.close()

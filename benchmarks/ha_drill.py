@@ -1,0 +1,219 @@
+"""Kill-the-primary fault-injection drill (CI smoke + runbook rehearsal).
+
+Two real token servers run as subprocesses on ephemeral ports; a
+``FailoverTokenClient`` drives load against the ordered pair. The drill
+then:
+
+1. **SIGKILLs the primary mid-load** and measures convergence: the wall
+   time from the kill until a request is served by the standby. Must land
+   inside the configured failover deadline (``--deadline-ms``, default the
+   subsystem's 500ms).
+2. **SIGKILLs the standby too** and asserts every subsequent request still
+   resolves — pass/block/throttle via the per-rule local fallback policy,
+   never an unhandled exception — recording the fallback window's
+   blocked-rate.
+
+Subprocess servers (same pattern as ``native/fuzz_frontdoor.py``'s
+standalone mode) make the kill honest: no in-process shutdown hooks soften
+it. Importable (``run_drill``) so the serve bench and the pytest smoke can
+reuse the in-process variant. Exit code is nonzero on any violated
+invariant, so CI can gate on it directly::
+
+    JAX_PLATFORMS=cpu python benchmarks/ha_drill.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DRILL_FLOW = 42
+N_FALLBACK_PROBES = 400
+
+
+def _serve_forever() -> None:
+    """Child mode: one token server on an ephemeral port, announced as a
+    JSON line on stdout; runs until killed (that's the point)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    svc = DefaultTokenService(
+        EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+    )
+    svc.load_rules(
+        [ClusterFlowRule(DRILL_FLOW, 1e9, ThresholdMode.GLOBAL)]
+    )
+    server = TokenServer(svc, port=0)
+    server.start()
+    print(json.dumps({"port": server.port}), flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _spawn_server(timeout_s: float = 120.0) -> tuple:
+    """Start one server child; returns (Popen, port)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # never register against a TPU tunnel
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env,
+    )
+    deadline = time.monotonic() + timeout_s
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("{"):
+            return proc, json.loads(line)["port"]
+        if proc.poll() is not None:
+            break
+    proc.kill()
+    raise RuntimeError(f"server child never became ready (last: {line!r})")
+
+
+def run_drill(deadline_ms: float = None, request_timeout_ms: int = 200):
+    """The drill against two live subprocess servers; returns the artifact
+    dict with a ``failures`` list (empty = drill passed)."""
+    from sentinel_tpu.engine import TokenStatus
+    from sentinel_tpu.ha import (
+        FailoverTokenClient,
+        FallbackAction,
+        FallbackRule,
+        LocalFallbackPolicy,
+    )
+
+    if deadline_ms is None:
+        from sentinel_tpu.core.config import SentinelConfig
+        from sentinel_tpu.ha.failover import KEY_FAILOVER_DEADLINE_MS
+
+        deadline_ms = SentinelConfig.get_float(KEY_FAILOVER_DEADLINE_MS, 500.0)
+    failures = []
+    primary_proc, primary_port = _spawn_server()
+    standby_proc, standby_port = _spawn_server()
+    # the fallback rule throttles to a local window so the all-down phase
+    # measures a real blocked-rate, not a constant verdict
+    policy = LocalFallbackPolicy(
+        [FallbackRule(DRILL_FLOW, FallbackAction.THROTTLE,
+                      count=N_FALLBACK_PROBES / 4)]
+    )
+    client = FailoverTokenClient(
+        [("127.0.0.1", primary_port), ("127.0.0.1", standby_port)],
+        timeout_ms=request_timeout_ms,
+        failure_threshold=1,
+        deadline_ms=deadline_ms,
+        fallback=policy,
+    )
+    standby = f"127.0.0.1:{standby_port}"
+    converged_ms = None
+    try:
+        # steady load on the primary until verdicts flow
+        warm_deadline = time.monotonic() + 30.0
+        while time.monotonic() < warm_deadline:
+            if client.request_token(DRILL_FLOW).ok:
+                break
+        else:
+            failures.append("primary never served before the kill")
+        for _ in range(50):
+            client.request_token(DRILL_FLOW)
+
+        # phase 1: kill the primary mid-load, converge on the standby
+        primary_proc.kill()
+        primary_proc.wait()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            r = client.request_token(DRILL_FLOW)  # must never raise
+            if r.ok and str(client.active_endpoint) == standby:
+                converged_ms = (time.monotonic() - t0) * 1e3
+                break
+        if converged_ms is None:
+            failures.append("never converged on the standby")
+        elif converged_ms > deadline_ms:
+            failures.append(
+                f"convergence {converged_ms:.1f}ms exceeds the "
+                f"{deadline_ms:.0f}ms deadline"
+            )
+        for _ in range(50):
+            if not client.request_token(DRILL_FLOW).ok:
+                failures.append("standby dropped a request after takeover")
+                break
+
+        # phase 2: kill the standby too — every request must resolve via
+        # the per-rule local fallback, never an unhandled exception
+        standby_proc.kill()
+        standby_proc.wait()
+        resolved = blocked = 0
+        try:
+            for _ in range(N_FALLBACK_PROBES):
+                r = client.request_token(DRILL_FLOW)
+                resolved += 1
+                if r.status == TokenStatus.BLOCKED:
+                    blocked += 1
+        except Exception as e:  # the one outcome the subsystem forbids
+            failures.append(f"fallback raised: {e!r}")
+        if resolved and not blocked:
+            failures.append(
+                "throttle fallback never blocked above the local window"
+            )
+        stats = policy.stats()
+    finally:
+        client.close()
+        for proc in (primary_proc, standby_proc):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    return {
+        "failover_convergence_ms": (
+            round(converged_ms, 1) if converged_ms is not None else None
+        ),
+        "deadline_ms": deadline_ms,
+        "fallback_requests": resolved,
+        "fallback_blocked_rate": stats["blocked_rate"],
+        "endpoints": client.health_snapshot(),
+        "failures": failures,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true",
+                    help="internal: run one server child")
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    args = ap.parse_args()
+    if args.serve:
+        _serve_forever()
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    t0 = time.time()
+    doc = run_drill(deadline_ms=args.deadline_ms)
+    doc["wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(doc, indent=2))
+    if doc["failures"]:
+        print(f"HA DRILL FAILED: {doc['failures']}", file=sys.stderr)
+        sys.exit(1)
+    print(
+        f"ha drill ok: converged in {doc['failover_convergence_ms']}ms "
+        f"(deadline {doc['deadline_ms']:.0f}ms), "
+        f"{doc['fallback_requests']} all-down requests resolved "
+        f"(blocked rate {doc['fallback_blocked_rate']:.2f})"
+    )
+
+
+if __name__ == "__main__":
+    main()

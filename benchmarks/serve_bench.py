@@ -232,6 +232,75 @@ def operating_point(points) -> dict | None:
     return best
 
 
+def measure_ha(deadline_ms: float = 500.0,
+               fallback_probes: int = 400) -> dict:
+    """Lightweight in-process failover probe for the bench artifact: two
+    small token servers, stop the primary mid-load, record how long the
+    failover client takes to converge on the standby; then stop the standby
+    and record the fallback window's blocked-rate with every request still
+    resolving locally. In-process ``stop()`` stands in for the kill here —
+    the honest SIGKILL variant is ``benchmarks/ha_drill.py`` (CI smoke)."""
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+    from sentinel_tpu.ha import (
+        FailoverTokenClient,
+        FallbackAction,
+        FallbackRule,
+        LocalFallbackPolicy,
+    )
+
+    flow = 42
+
+    def _server():
+        svc = DefaultTokenService(
+            EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+        )
+        svc.load_rules([ClusterFlowRule(flow, 1e9, ThresholdMode.GLOBAL)])
+        server = TokenServer(svc, port=0)
+        server.start()
+        return server
+
+    primary, standby = _server(), _server()
+    policy = LocalFallbackPolicy(
+        [FallbackRule(flow, FallbackAction.THROTTLE,
+                      count=fallback_probes / 4)]
+    )
+    client = FailoverTokenClient(
+        [("127.0.0.1", primary.port), ("127.0.0.1", standby.port)],
+        timeout_ms=200, failure_threshold=1, deadline_ms=deadline_ms,
+        fallback=policy,
+    )
+    converged_ms = None
+    try:
+        for _ in range(20):
+            client.request_token(flow)
+        primary.stop()
+        t0 = time.perf_counter()
+        standby_ep = f"127.0.0.1:{standby.port}"
+        while time.perf_counter() - t0 < 10.0:
+            r = client.request_token(flow)
+            if r.ok and str(client.active_endpoint) == standby_ep:
+                converged_ms = (time.perf_counter() - t0) * 1e3
+                break
+        standby.stop()
+        for _ in range(fallback_probes):
+            client.request_token(flow)  # resolves via the local fallback
+    finally:
+        client.close()
+        primary.stop()
+        standby.stop()
+    return {
+        "failover_convergence_ms": (
+            round(converged_ms, 1) if converged_ms is not None else None
+        ),
+        "failover_deadline_ms": deadline_ms,
+        "fallback_blocked_rate": policy.stats()["blocked_rate"],
+        "fallback_requests": fallback_probes,
+    }
+
+
 def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                   n_flows: int = 100_000, max_batch: int = 16384,
                   n_dispatchers: int = None, budget_s: float = None) -> dict:
@@ -306,6 +375,14 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
         server.stop()
         service.close()
     op = operating_point(curve)
+    # HA probe rides the artifact: failover convergence + the all-down
+    # fallback window's blocked-rate. Never aborts the measurement — a
+    # broken probe surfaces as ha=None next to valid serve numbers.
+    try:
+        ha = measure_ha()
+    except Exception as e:
+        print(f"serve_bench: ha probe failed: {e!r}", file=sys.stderr)
+        ha = None
     return {
         "backend": backend,
         # only the native door has dispatcher threads; the asyncio fallback
@@ -327,6 +404,7 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
         "served_over_ceiling": round(
             closed["verdicts_per_sec"] / ceiling, 3
         ) if ceiling else None,
+        "ha": ha,
         "host_cores": os.cpu_count(),
     }
 

@@ -48,6 +48,17 @@ def set_client(client: TokenService) -> None:
         _close_quietly(prev)
 
 
+def clear_client() -> None:
+    """Drop (and close) the installed token client WITHOUT switching modes —
+    the client holds a socket + reader thread, so a node promoted to SERVER
+    (or switched off) must not leak one per transition."""
+    global _client
+    with _lock:
+        prev, _client = _client, None
+    if prev is not None:
+        _close_quietly(prev)
+
+
 def set_embedded_server(service: TokenService) -> None:
     global _embedded, _mode
     with _lock:

@@ -4,7 +4,9 @@ Analog of ``DefaultClusterTokenClient.java:45`` over
 ``NettyTransportClient.java:61``: an atomic xid generator, a pending-promise
 map (``TokenClientPromiseHolder.java:30-50``), a hard request timeout
 defaulting to the reference's 20ms (``ClusterConstants.java:44``), and
-lazy reconnect with linear backoff (``NettyTransportClient.java:67``).
+lazy reconnect with bounded exponential backoff + jitter (the reference's
+fixed ``RECONNECT_DELAY_MS``, ``NettyTransportClient.java:67``, retried in
+lockstep from every caller — the reconnect storm this ladder avoids).
 
 The client is sync because its caller is the (sync) flow-checker hot path; a
 background thread owns the socket read side.
@@ -13,6 +15,7 @@ background thread owns the socket read side.
 from __future__ import annotations
 
 import itertools
+import random
 import socket
 import threading
 import time
@@ -20,10 +23,19 @@ from typing import Dict, Optional
 
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.core.config import SentinelConfig
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
 
-RECONNECT_DELAY_S = 2.0  # NettyTransportClient.RECONNECT_DELAY_MS analog
+RECONNECT_DELAY_S = 2.0  # legacy cap alias; see the backoff ladder below
+
+# reconnect backoff: first retry comes fast (a restarted server should be
+# picked up quickly), repeated failures back off exponentially with jitter
+# so a dead server isn't hammered by every request of every client in sync
+# (NettyTransportClient's fixed RECONNECT_DELAY_MS caused exactly that storm)
+RECONNECT_BASE_S = 0.1
+RECONNECT_MAX_S = 30.0
+RECONNECT_JITTER = 0.2
 
 
 class _Pending:
@@ -50,6 +62,20 @@ class TokenClient(TokenService):
         self._pending: Dict[int, _Pending] = {}
         self._reader: Optional[threading.Thread] = None
         self._last_connect_attempt = 0.0
+        # consecutive failed connect attempts since the last success; drives
+        # the reconnect backoff and is surfaced for HA health introspection
+        self._consecutive_failures = 0
+        self._reconnect_delay_s = 0.0
+        self._reconnect_base_s = SentinelConfig.get_float(
+            "sentinel.tpu.client.reconnect.base.s", RECONNECT_BASE_S
+        )
+        self._reconnect_max_s = SentinelConfig.get_float(
+            "sentinel.tpu.client.reconnect.max.s", RECONNECT_MAX_S
+        )
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self._consecutive_failures
 
     # -- connection management ---------------------------------------------
     def _ensure_connected(self) -> bool:
@@ -59,7 +85,7 @@ class TokenClient(TokenService):
             if self._sock is not None:
                 return True
             now = time.monotonic()
-            if now - self._last_connect_attempt < RECONNECT_DELAY_S:
+            if now - self._last_connect_attempt < self._reconnect_delay_s:
                 return False
             self._last_connect_attempt = now
             try:
@@ -72,8 +98,25 @@ class TokenClient(TokenService):
                 sock.settimeout(None)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._sock = sock
+                self._consecutive_failures = 0
+                self._reconnect_delay_s = 0.0
             except OSError as e:
-                record_log.warning("token server unreachable: %s", e)
+                self._consecutive_failures += 1
+                # bounded exponential backoff with jitter: without it, every
+                # request-carrying thread retries the dead address in
+                # lockstep (connect timeout × request rate = a reconnect
+                # storm). Only the first few failures log — the storm used
+                # to flood the record log too.
+                k = min(self._consecutive_failures, 16)
+                self._reconnect_delay_s = min(
+                    self._reconnect_base_s * (2 ** (k - 1)),
+                    self._reconnect_max_s,
+                ) * (1.0 + RECONNECT_JITTER * random.random())
+                if self._consecutive_failures <= 3:
+                    record_log.warning(
+                        "token server unreachable (%d consecutive): %s",
+                        self._consecutive_failures, e,
+                    )
                 return False
             self._reader = threading.Thread(
                 target=self._read_loop, args=(sock,), daemon=True,

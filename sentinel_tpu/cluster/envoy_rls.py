@@ -27,11 +27,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from sentinel_tpu.core.config import SentinelConfig
 from sentinel_tpu.core.hashing import stable_param_hash
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import ClusterFlowRule, TokenStatus
 from sentinel_tpu.engine.rules import ThresholdMode
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
+from sentinel_tpu.metrics.ha import ha_metrics
 from sentinel_tpu.metrics.server import server_metrics
 
 SEPARATOR = "|"  # EnvoySentinelRuleConverter.SEPARATOR
@@ -143,11 +145,41 @@ class RlsVerdict:
 
 
 class RlsService:
-    """``shouldRateLimit`` without the transport, testable directly."""
+    """``shouldRateLimit`` without the transport, testable directly.
 
-    def __init__(self, service: DefaultTokenService, rules: EnvoyRlsRuleManager):
+    ``failure_mode`` is Envoy's RLS failure-mode knob mirrored server-side:
+    when the token service errors mid-batch (device fault, service swapped
+    out under us, transport layer raising), every descriptor of the request
+    resolves to the configured verdict — ``allow`` (fail-open, Envoy's
+    ``failure_mode_deny=false`` default) or ``deny`` (fail-closed) — instead
+    of the exception tearing down the RPC."""
+
+    def __init__(
+        self,
+        service: DefaultTokenService,
+        rules: EnvoyRlsRuleManager,
+        failure_mode: Optional[str] = None,
+    ):
         self._service = service
         self._rules = rules
+        if failure_mode is None:
+            failure_mode = SentinelConfig.get(
+                "csp.sentinel.rls.failure.mode", "allow"
+            )
+        failure_mode = str(failure_mode).lower()
+        if failure_mode not in ("allow", "deny"):
+            raise ValueError(
+                f"failure_mode must be allow|deny, got {failure_mode!r}"
+            )
+        self.failure_mode = failure_mode
+
+    def _failure_verdict(self, n: int) -> RlsVerdict:
+        allow = self.failure_mode == "allow"
+        ha_metrics().count_fallback(
+            "rls_allow" if allow else "rls_deny", max(1, n)
+        )
+        code = CODE_OK if allow else CODE_OVER_LIMIT
+        return RlsVerdict(code, [DescriptorStatus(code) for _ in range(n)])
 
     def should_rate_limit(
         self,
@@ -169,12 +201,38 @@ class RlsService:
             for i, entries in enumerate(descriptors)
         ]
         requests = [(fid, acquire, False) for _, fid in known]
-        results = self._service.request_batch(requests)
+        try:
+            results = self._service.request_batch(requests)
+            if len(results) != len(requests):
+                raise RuntimeError(
+                    f"token service returned {len(results)} results "
+                    f"for {len(requests)} descriptors"
+                )
+        except Exception:
+            # token service errored mid-batch: resolve the whole request via
+            # the configured failure mode instead of raising through the RPC
+            record_log.exception(
+                "RLS token service error; failing %s", self.failure_mode
+            )
+            return self._failure_verdict(len(descriptors))
         for (i, fid), result in zip(known, results):
             entry = self._rules.lookup(fid)
             if entry is None or result.status == TokenStatus.NO_RULE_EXISTS:
                 # absent rule → pass (SentinelEnvoyRlsServiceImpl.java:56-58)
                 statuses.append(DescriptorStatus(CODE_OK))
+                continue
+            if result.status == TokenStatus.FAIL:
+                # this descriptor's verdict degraded (e.g. the client-side
+                # TokenClient timed out): per-descriptor failure mode, not
+                # an OVER_LIMIT the rule never issued
+                allow = self.failure_mode == "allow"
+                ha_metrics().count_fallback(
+                    "rls_allow" if allow else "rls_deny"
+                )
+                blocked = blocked or not allow
+                statuses.append(
+                    DescriptorStatus(CODE_OK if allow else CODE_OVER_LIMIT)
+                )
                 continue
             ok = result.status == TokenStatus.OK
             blocked = blocked or not ok

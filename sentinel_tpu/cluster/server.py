@@ -492,6 +492,8 @@ class TokenServer:
         idle_ttl_s: Optional[float] = 600.0,
         profile_dir: Optional[str] = None,
         metrics_port: Optional[int] = None,
+        snapshot_dir: Optional[str] = None,
+        snapshot_period_s: Optional[float] = None,
     ):
         self.service = service
         self.host = host
@@ -530,6 +532,15 @@ class TokenServer:
         self.metrics_port = metrics_port
         self._metrics_exporter = None
         self._gauge_fns: Dict[str, object] = {}
+        # HA state snapshots (sentinel_tpu.ha.snapshot): with a directory
+        # set, start() restores the newest artifact into a COLD service and
+        # arms the periodic writer; stop() takes a final save. Honored from
+        # the env too so an operator can arm it without code changes.
+        self.snapshot_dir = snapshot_dir or os.environ.get(
+            "SENTINEL_SNAPSHOT_DIR"
+        ) or None
+        self.snapshot_period_s = snapshot_period_s
+        self._snapshots = None
 
     def tuning_kwargs(self) -> dict:
         """Operator-tunable constructor kwargs, for rebuilding this server on
@@ -544,6 +555,8 @@ class TokenServer:
             idle_ttl_s=self.idle_ttl_s,
             profile_dir=self.profile_dir,
             metrics_port=self.metrics_port,
+            snapshot_dir=self.snapshot_dir,
+            snapshot_period_s=self.snapshot_period_s,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -559,6 +572,14 @@ class TokenServer:
         warmup = getattr(self.service, "warmup", None)
         if warmup is not None:
             warmup()  # compile the decision kernels before accepting traffic
+        if self.snapshot_dir and hasattr(self.service, "import_state"):
+            from sentinel_tpu.ha.snapshot import restore_latest
+
+            # only a COLD service restores (no rules loaded yet): a port
+            # move reuses a live service whose in-memory state is newer
+            # than any artifact on disk
+            if not self.service.current_rules():
+                restore_latest(self.service, self.snapshot_dir)
         reopen = getattr(self.service, "reopen", None)
         if reopen is not None:
             reopen()  # re-arm background sweeps a prior stop() released
@@ -616,8 +637,20 @@ class TokenServer:
                 host="0.0.0.0", port=self.metrics_port
             ).start()
             self.metrics_port = self._metrics_exporter.port  # resolve port 0
+        if self.snapshot_dir and hasattr(self.service, "export_state"):
+            from sentinel_tpu.ha.snapshot import SnapshotManager
+
+            self._snapshots = SnapshotManager(
+                self.service, self.snapshot_dir,
+                period_s=self.snapshot_period_s,
+            ).start()
 
     def stop(self) -> None:
+        if self._snapshots is not None:
+            # final save: the artifact a restarted primary (or a standby
+            # picking up this node's directory) restores from
+            self._snapshots.stop(final_save=True)
+            self._snapshots = None
         if self.profiler.active:
             self.profiler.stop()
         if self._metrics_exporter is not None:

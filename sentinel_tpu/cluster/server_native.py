@@ -25,6 +25,7 @@ device step (the same overlap the asyncio server got from ``to_thread``).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import List, Optional
@@ -63,6 +64,8 @@ class NativeTokenServer:
         arena_cap: int = 65536,
         profile_dir: Optional[str] = None,
         metrics_port: Optional[int] = None,
+        snapshot_dir: Optional[str] = None,
+        snapshot_period_s: Optional[float] = None,
     ):
         from sentinel_tpu.native.lib import Frontdoor  # raises if unbuilt
 
@@ -88,6 +91,12 @@ class NativeTokenServer:
         self.metrics_port = metrics_port
         self._metrics_exporter = None
         self._gauge_fns: dict = {}
+        # HA state snapshots: same contract as the asyncio front door
+        self.snapshot_dir = snapshot_dir or os.environ.get(
+            "SENTINEL_SNAPSHOT_DIR"
+        ) or None
+        self.snapshot_period_s = snapshot_period_s
+        self._snapshots = None
 
     def tuning_kwargs(self) -> dict:
         return dict(
@@ -97,6 +106,8 @@ class NativeTokenServer:
             arena_cap=self.arena_cap,
             profile_dir=self.profile_dir,
             metrics_port=self.metrics_port,
+            snapshot_dir=self.snapshot_dir,
+            snapshot_period_s=self.snapshot_period_s,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -106,6 +117,11 @@ class NativeTokenServer:
         warmup = getattr(self.service, "warmup", None)
         if warmup is not None:
             warmup()
+        if self.snapshot_dir and hasattr(self.service, "import_state"):
+            from sentinel_tpu.ha.snapshot import restore_latest
+
+            if not self.service.current_rules():  # cold service only
+                restore_latest(self.service, self.snapshot_dir)
         reopen = getattr(self.service, "reopen", None)
         if reopen is not None:
             reopen()
@@ -155,6 +171,13 @@ class NativeTokenServer:
                 host="0.0.0.0", port=self.metrics_port
             ).start()
             self.metrics_port = self._metrics_exporter.port
+        if self.snapshot_dir and hasattr(self.service, "export_state"):
+            from sentinel_tpu.ha.snapshot import SnapshotManager
+
+            self._snapshots = SnapshotManager(
+                self.service, self.snapshot_dir,
+                period_s=self.snapshot_period_s,
+            ).start()
         record_log.info(
             "native token server listening on %s:%d (%d dispatchers)",
             self.host, self.port, self.n_dispatchers,
@@ -163,6 +186,9 @@ class NativeTokenServer:
     def stop(self) -> None:
         if self._door is None:
             return
+        if self._snapshots is not None:
+            self._snapshots.stop(final_save=True)
+            self._snapshots = None
         if self.profiler.active:
             self.profiler.stop()
         if self._metrics_exporter is not None:

@@ -787,6 +787,138 @@ class DefaultTokenService(TokenService):
     def release_concurrent_token(self, token_id):
         return TokenResult(self.concurrency.release(token_id))
 
+    # -- state snapshot / restore (ha.snapshot backing) ----------------------
+    def export_state(self) -> Dict[str, object]:
+        """Device→host capture of everything a warm standby needs to resume
+        counting: rule sources, slot assignments, the flow/occupy/ns window
+        tensors, the CMS param sketch, and the engine epoch. Arrays come
+        back as host numpy copies; keys are stable (``ha.snapshot`` encodes
+        them into the versioned artifact)."""
+
+        def _win(ws) -> Dict[str, np.ndarray]:
+            return {
+                "starts": np.asarray(ws.starts),
+                "counts": np.asarray(ws.counts),
+            }
+
+        with self._rules_mutex, self._lock:
+            now = self._engine_now()  # pins the epoch, runs a due rebase
+            return {
+                "engine_now": int(now),
+                "epoch_ms": int(self._epoch_ms),
+                "wall_ms": int(_clock.now_ms()),
+                "ns_max_qps": float(self._ns_max_qps),
+                "connected": dict(self._connected),
+                "namespace_set": sorted(self.namespace_set),
+                "rules": [
+                    r for m in self._rules_by_ns.values() for r in m.values()
+                ],
+                "param_rules": list(self._param_rules_src.values()),
+                "slot_of": dict(self._index.slot_of),
+                "ns_of": dict(self._index.ns_of),
+                "param_slot_of": {
+                    fid: slot
+                    for fid, (slot, _, _) in self._param_rules.items()
+                },
+                "flow": _win(self._state.flow),
+                "occupy": _win(self._state.occupy),
+                "ns": _win(self._state.ns),
+                "param": {
+                    "starts": np.asarray(self._param_state.starts),
+                    "counts": np.asarray(self._param_state.counts),
+                },
+            }
+
+    def import_state(self, state: Dict[str, object]) -> None:
+        """Restore an :meth:`export_state` capture into THIS service.
+
+        Slot assignments are not trusted: rules reload through the normal
+        path (fresh ``RuleIndex`` slots), then counter rows remap
+        old-slot→new-slot per flow_id / namespace / param rule, so a standby
+        that loaded rules in a different order still lands every counter on
+        the right rule. Window starts carry over verbatim — engine time
+        continues from the snapshot epoch, so counters older than one window
+        expire naturally via the mask-on-read reads. Geometry (window/sketch
+        shapes) must match this service's config; mismatch raises
+        ``ValueError`` before anything mutates."""
+        from sentinel_tpu.engine.state import EngineState as _ES
+        from sentinel_tpu.stats.window import WindowState as _WS
+
+        def _check(name: str, got, want) -> np.ndarray:
+            arr = np.asarray(got)
+            if arr.shape != tuple(want.shape):
+                raise ValueError(
+                    f"snapshot geometry mismatch: {name} {arr.shape} "
+                    f"!= {tuple(want.shape)}"
+                )
+            return arr
+
+        with self._rules_mutex:
+            rules = list(state["rules"])
+            param_rules = list(state["param_rules"])
+            with self._lock:
+                cur = self._state
+                flow_c = _check("flow.counts", state["flow"]["counts"],
+                                cur.flow.counts)
+                flow_s = _check("flow.starts", state["flow"]["starts"],
+                                cur.flow.starts)
+                occ_c = _check("occupy.counts", state["occupy"]["counts"],
+                               cur.occupy.counts)
+                occ_s = _check("occupy.starts", state["occupy"]["starts"],
+                               cur.occupy.starts)
+                ns_c = _check("ns.counts", state["ns"]["counts"],
+                              cur.ns.counts)
+                ns_s = _check("ns.starts", state["ns"]["starts"],
+                              cur.ns.starts)
+                p_c = _check("param.counts", state["param"]["counts"],
+                             self._param_state.counts)
+                p_s = _check("param.starts", state["param"]["starts"],
+                             self._param_state.starts)
+            self.load_rules(
+                rules,
+                ns_max_qps=float(state["ns_max_qps"]),
+                connected=dict(state["connected"]),
+            )
+            self.load_param_rules(param_rules)
+            with self._lock:
+                self.namespace_set |= set(state["namespace_set"])
+                # remap flow/occupy rows: snapshot slot → this service's slot
+                old_slot = state["slot_of"]
+                new_flow_c = np.zeros_like(flow_c)
+                new_occ_c = np.zeros_like(occ_c)
+                for fid, new in self._index.slot_of.items():
+                    old = old_slot.get(fid)
+                    if old is None:
+                        continue
+                    new_flow_c[new] = flow_c[old]
+                    new_occ_c[new] = occ_c[old]
+                # namespace guard rows remap by name
+                old_ns = state["ns_of"]
+                new_ns_c = np.zeros_like(ns_c)
+                for name, new in self._index.ns_of.items():
+                    old = old_ns.get(name)
+                    if old is not None:
+                        new_ns_c[new] = ns_c[old]
+                # param sketch rows remap via the param slot maps
+                old_pslot = state["param_slot_of"]
+                new_p_c = np.zeros_like(p_c)
+                for fid, (new, _, _) in self._param_rules.items():
+                    old = old_pslot.get(fid)
+                    if old is not None:
+                        new_p_c[new] = p_c[old]
+                self._state = self._place_state(_ES(
+                    flow=_WS(jnp.asarray(flow_s), jnp.asarray(new_flow_c)),
+                    occupy=_WS(jnp.asarray(occ_s), jnp.asarray(new_occ_c)),
+                    ns=_WS(jnp.asarray(ns_s), jnp.asarray(new_ns_c)),
+                ))
+                self._param_state = self._param_state._replace(
+                    starts=jnp.asarray(p_s), counts=jnp.asarray(new_p_c),
+                )
+                # resume the snapshot's engine timeline: wall − epoch keeps
+                # advancing, so windows older than interval_ms expire on the
+                # next read instead of resurrecting stale quota
+                self._epoch_ms = int(state["epoch_ms"])
+
     # -- introspection (FetchClusterMetricCommandHandler analog) ------------
     def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:
         from sentinel_tpu.engine.state import ClusterEvent, flow_spec

@@ -33,6 +33,18 @@ _DEFAULTS: Dict[str, str] = {
     "sentinel.tpu.server.idle.seconds": "600",
     "csp.sentinel.api.port": "8719",
     "csp.sentinel.heartbeat.interval.ms": "10000",
+    # cluster HA (sentinel_tpu.ha): endpoint circuit breaker + failover
+    "sentinel.tpu.ha.failure.threshold": "3",
+    "sentinel.tpu.ha.backoff.base.ms": "100",
+    "sentinel.tpu.ha.backoff.max.ms": "10000",
+    "sentinel.tpu.ha.backoff.jitter": "0.2",
+    "sentinel.tpu.ha.failover.deadline.ms": "500",
+    "sentinel.tpu.ha.snapshot.period.s": "30",
+    # client reconnect backoff (cluster.client.TokenClient)
+    "sentinel.tpu.client.reconnect.base.s": "0.1",
+    "sentinel.tpu.client.reconnect.max.s": "30",
+    # Envoy RLS behavior when the token service errors: allow | deny
+    "csp.sentinel.rls.failure.mode": "allow",
 }
 
 

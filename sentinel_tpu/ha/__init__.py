@@ -1,0 +1,62 @@
+"""Cluster high availability: failover client, local fallback, snapshots.
+
+The reference Sentinel treats cluster flow control as degradable-by-design —
+``ClusterStateManager`` flips nodes between CLIENT/SERVER at runtime and a
+client falls back to local rules when the token server is unreachable
+(``FlowRuleChecker.fallbackToLocalOrPass``). This package carries those
+semantics to the TPU build and adds the piece the device-resident state
+makes necessary: a versioned snapshot of the token server's window/CMS
+tensors so a warm standby (or a restarted primary) resumes counting instead
+of forgetting every in-window verdict.
+
+- :mod:`~sentinel_tpu.ha.endpoints` — per-endpoint health with exponential
+  backoff + jitter and a half-open circuit breaker.
+- :mod:`~sentinel_tpu.ha.failover` — :class:`FailoverTokenClient`, an
+  ordered-endpoint-list ``TokenService`` that evicts dead primaries.
+- :mod:`~sentinel_tpu.ha.fallback` — per-rule local degradation (pass /
+  block / local-window throttle) riding ``local.flow`` controllers.
+- :mod:`~sentinel_tpu.ha.snapshot` — device→host state snapshot/restore and
+  the periodic :class:`SnapshotManager`.
+- :mod:`~sentinel_tpu.ha.manager` — :class:`ClusterStateManager`, runtime
+  client/server/off transitions that rewire the slot chain live.
+"""
+
+from sentinel_tpu.ha.endpoints import Endpoint, EndpointHealth, HealthState
+from sentinel_tpu.ha.failover import FailoverTokenClient
+from sentinel_tpu.ha.fallback import (
+    FallbackAction,
+    FallbackRule,
+    LocalFallbackPolicy,
+)
+from sentinel_tpu.ha.manager import ClusterStateManager
+from sentinel_tpu.ha.snapshot import (
+    SNAPSHOT_VERSION,
+    SnapshotManager,
+    decode_snapshot,
+    encode_snapshot,
+    load_latest,
+    restore_from_doc,
+    restore_latest,
+    save_snapshot,
+    snapshot_to_doc,
+)
+
+__all__ = [
+    "Endpoint",
+    "EndpointHealth",
+    "HealthState",
+    "FailoverTokenClient",
+    "FallbackAction",
+    "FallbackRule",
+    "LocalFallbackPolicy",
+    "ClusterStateManager",
+    "SNAPSHOT_VERSION",
+    "SnapshotManager",
+    "encode_snapshot",
+    "decode_snapshot",
+    "snapshot_to_doc",
+    "restore_from_doc",
+    "save_snapshot",
+    "load_latest",
+    "restore_latest",
+]

@@ -1,0 +1,264 @@
+"""FailoverTokenClient: ordered endpoint list with eviction + fallback.
+
+A drop-in ``TokenService`` for the client side of cluster flow control:
+instead of pinning one host (``cluster.client.TokenClient``), it walks an
+ordered endpoint list — primary first, standbys after — and serves each
+request from the first endpoint whose circuit breaker admits it. A FAIL
+verdict (the client-side degraded status for send failure / timeout /
+connection loss) records a failure against that endpoint; after
+``failure_threshold`` consecutive failures the endpoint is evicted (breaker
+OPEN) and the next request goes straight to the standby — so a SIGKILLed
+primary costs at most ``threshold × request_timeout`` of unhealthy verdicts
+before the standby serves, well inside the configured failover deadline.
+
+When NO endpoint is available (all breakers open, or the per-request
+failover deadline is spent), the request resolves through the
+:class:`~sentinel_tpu.ha.fallback.LocalFallbackPolicy` — pass, block, or
+local-window throttle per rule — and never raises.
+
+Wire-level behavior (timeouts, pipelined BATCH_FLOW chunks, reconnect
+backoff) stays in the wrapped per-endpoint ``TokenClient``s; this class only
+decides *where* a request goes and *what* happens when nowhere is healthy.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.core import clock as _clock
+from sentinel_tpu.core.config import SentinelConfig
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.engine import TokenStatus
+from sentinel_tpu.ha.endpoints import Endpoint, EndpointHealth
+from sentinel_tpu.ha.fallback import LocalFallbackPolicy
+from sentinel_tpu.metrics.ha import ha_metrics
+
+KEY_FAILOVER_DEADLINE_MS = "sentinel.tpu.ha.failover.deadline.ms"
+
+
+class _Member:
+    __slots__ = ("endpoint", "health", "client")
+
+    def __init__(self, endpoint: Endpoint, health: EndpointHealth, client):
+        self.endpoint = endpoint
+        self.health = health
+        self.client = client
+
+
+class FailoverTokenClient(TokenService):
+    def __init__(
+        self,
+        endpoints: Sequence,
+        timeout_ms: int = 20,
+        namespace: str = "default",
+        fallback: Optional[LocalFallbackPolicy] = None,
+        failure_threshold: Optional[int] = None,
+        backoff_base_ms: Optional[float] = None,
+        backoff_max_ms: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
+        client_factory: Callable = TokenClient,
+    ):
+        if not endpoints:
+            raise ValueError("at least one endpoint required")
+        self.namespace = namespace
+        self.timeout_ms = timeout_ms
+        # overall per-request budget for walking the endpoint list; once
+        # spent, the request degrades to fallback instead of trying further
+        # standbys (the configured failover deadline)
+        self.deadline_ms = float(
+            deadline_ms
+            if deadline_ms is not None
+            else SentinelConfig.get_float(KEY_FAILOVER_DEADLINE_MS, 500.0)
+        )
+        self.fallback = fallback if fallback is not None else (
+            LocalFallbackPolicy()
+        )
+        self._members: List[_Member] = []
+        for ep in endpoints:
+            if not isinstance(ep, Endpoint):
+                ep = Endpoint(str(ep[0]), int(ep[1]))
+            self._members.append(
+                _Member(
+                    ep,
+                    EndpointHealth(
+                        failure_threshold=failure_threshold,
+                        backoff_base_ms=backoff_base_ms,
+                        backoff_max_ms=backoff_max_ms,
+                    ),
+                    client_factory(
+                        ep.host, ep.port, timeout_ms=timeout_ms,
+                        namespace=namespace,
+                    ),
+                )
+            )
+        self._lock = threading.Lock()
+        self._active = 0  # index of the member that served last (telemetry)
+
+    # -- endpoint walk -------------------------------------------------------
+    def _available(self) -> List[Tuple[int, _Member]]:
+        return [
+            (i, m) for i, m in enumerate(self._members)
+            if m.health.allows_request()
+        ]
+
+    def _note_served(self, index: int) -> None:
+        with self._lock:
+            if index != self._active:
+                prev = self._members[self._active].endpoint
+                ha_metrics().count_failover(
+                    str(prev), str(self._members[index].endpoint),
+                    now_ms=_clock.now_ms(),
+                )
+                record_log.warning(
+                    "token client failed over: %s -> %s", prev,
+                    self._members[index].endpoint,
+                )
+                self._active = index
+
+    def _note_exhausted(self) -> None:
+        """Every endpoint refused or failed → this request degrades."""
+        with self._lock:
+            prev = self._members[self._active].endpoint
+        ha_metrics().count_failover(str(prev), "", now_ms=_clock.now_ms())
+
+    def _call(self, op: Callable, failed=None):
+        """Walk available endpoints inside the deadline; ``op(member)``
+        returns the raw result and ``failed(result)`` judges it. Returns the
+        first healthy result or None when the list is exhausted."""
+        if failed is None:
+            failed = lambda r: (
+                r is None
+                or (isinstance(r, TokenResult)
+                    and r.status == TokenStatus.FAIL)
+            )
+        deadline = _clock.now_ms() + self.deadline_ms
+        for i, member in self._available():
+            try:
+                result = op(member)
+            except Exception:
+                record_log.exception(
+                    "token endpoint %s raised; treating as failure",
+                    member.endpoint,
+                )
+                result = None
+            if failed(result):
+                member.health.record_failure()
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
+            member.health.record_success()
+            self._note_served(i)
+            return result
+        self._note_exhausted()
+        return None
+
+    # -- TokenService --------------------------------------------------------
+    def request_token(self, flow_id, acquire=1, prioritized=False):
+        result = self._call(
+            lambda m: m.client.request_token(flow_id, acquire, prioritized)
+        )
+        if result is not None:
+            return result
+        return self.fallback.decide(flow_id, acquire, prioritized)
+
+    def request_params_token(self, flow_id, acquire, param_hashes):
+        result = self._call(
+            lambda m: m.client.request_params_token(
+                flow_id, acquire, param_hashes
+            )
+        )
+        if result is not None:
+            return result
+        return self.fallback.decide(flow_id, acquire)
+
+    def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
+        result = self._call(
+            lambda m: m.client.request_concurrent_token(
+                flow_id, acquire, prioritized
+            )
+        )
+        if result is not None:
+            return result
+        return self.fallback.decide(flow_id, acquire, prioritized)
+
+    def release_concurrent_token(self, token_id):
+        result = self._call(
+            lambda m: m.client.release_concurrent_token(token_id)
+        )
+        if result is not None:
+            return result
+        # a release that can reach no server is lost either way; report OK so
+        # callers don't retry forever against a dead cluster (the server-side
+        # TTL sweep reclaims the permit)
+        ha_metrics().count_fallback("release_dropped")
+        return TokenResult(TokenStatus.RELEASE_OK)
+
+    def request_batch_arrays(self, flow_ids, acquires=None, prios=None,
+                             timeout_ms: Optional[int] = None):
+        def op(member):
+            return member.client.request_batch_arrays(
+                flow_ids, acquires, prios, timeout_ms=timeout_ms
+            )
+
+        result = self._call(op, failed=lambda r: r is None)
+        if result is not None:
+            # degraded verdicts inside an otherwise-delivered batch (FAIL
+            # statuses) stay as-is: the server answered, per-row FAIL means
+            # the server's own step degraded, not the transport
+            return result
+        return self.fallback.decide_batch_arrays(flow_ids, acquires, prios)
+
+    def request_batch(self, requests):
+        if not requests:
+            return []
+        n = len(requests)
+        status, remaining, wait = self.request_batch_arrays(
+            np.fromiter((f for f, _, _ in requests), np.int64, n),
+            np.fromiter((a for _, a, _ in requests), np.int32, n),
+            np.fromiter((p for _, _, p in requests), bool, n),
+        )
+        return [
+            TokenResult(TokenStatus(int(status[i])), int(remaining[i]),
+                        int(wait[i]))
+            for i in range(n)
+        ]
+
+    def ping(self, namespace: Optional[str] = None) -> bool:
+        result = self._call(
+            lambda m: m.client.ping(namespace) or None,
+            failed=lambda r: r is None,
+        )
+        return bool(result)
+
+    # -- lifecycle / introspection ------------------------------------------
+    def close(self) -> None:
+        for member in self._members:
+            try:
+                member.client.close()
+            except Exception:
+                pass
+
+    @property
+    def active_endpoint(self) -> Endpoint:
+        with self._lock:
+            return self._members[self._active].endpoint
+
+    def health_snapshot(self) -> List[dict]:
+        out = []
+        with self._lock:
+            active = self._active
+        for i, member in enumerate(self._members):
+            entry = {"endpoint": str(member.endpoint), "active": i == active}
+            entry.update(member.health.snapshot())
+            consecutive = getattr(
+                member.client, "consecutive_failures", None
+            )
+            if consecutive is not None:
+                entry["connectFailures"] = int(consecutive)
+            out.append(entry)
+        return out

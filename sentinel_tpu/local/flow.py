@@ -254,6 +254,19 @@ def generate_rater(rule: FlowRule) -> TrafficShapingController:
     return DefaultController(rule.count, rule.grade)
 
 
+def fallback_controller(
+    count: float, max_queueing_time_ms: int = 0
+) -> TrafficShapingController:
+    """Controller for the cluster fail-to-local path (``ha.fallback``): a
+    degraded QPS budget enforced locally while the token servers are down.
+    ``max_queueing_time_ms > 0`` paces (leaky bucket) instead of rejecting —
+    the same two shapes ``generate_rater`` picks between, minus warm-up
+    (a fallback window is too short for a ramp to mean anything)."""
+    if max_queueing_time_ms > 0:
+        return RateLimiterController(count, max_queueing_time_ms)
+    return DefaultController(count, FlowGrade.QPS)
+
+
 # ---------------------------------------------------------------------------
 # Rule manager
 # ---------------------------------------------------------------------------

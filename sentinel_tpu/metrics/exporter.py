@@ -36,6 +36,7 @@ from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.httpd import HttpService, Response
 from sentinel_tpu.local import chain as _chain
 from sentinel_tpu.metrics import extension as _ext
+from sentinel_tpu.metrics.ha import ha_metrics
 from sentinel_tpu.metrics.server import server_metrics
 
 _HELP = """\
@@ -139,6 +140,7 @@ def render(now_ms: Optional[int] = None) -> str:
         lines.append(f"sentinel_pass_total{label} {passed.get(name, 0)}")
         lines.append(f"sentinel_block_total{label} {blocked.get(name, 0)}")
     lines.append(server_metrics().render())
+    lines.append(ha_metrics().render())
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,133 @@
+"""HA counters: failover, fallback, and snapshot events.
+
+Companion of :mod:`sentinel_tpu.metrics.server` for the cluster HA subsystem
+(:mod:`sentinel_tpu.ha`): the failover client counts endpoint evictions, the
+local fallback policy counts degraded verdicts, and the snapshot manager
+counts save/restore cycles. One process-wide singleton, rendered under the
+Prometheus surface (``sentinel_failover_total`` / ``sentinel_fallback_total``
+/ ``sentinel_snapshot_total``) and as JSON for bench artifacts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+
+def _escape(label: str) -> str:
+    return label.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class HaMetrics:
+    """Failover/fallback/snapshot counters for this process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (from_endpoint, to_endpoint) → count; to="" means "no endpoint
+        # left" (the request degraded to the local fallback path)
+        self._failover: Dict[Tuple[str, str], int] = {}
+        # action → count: pass | block | throttle_pass | throttle_block |
+        # rls_allow | rls_deny
+        self._fallback: Dict[str, int] = {}
+        self._snapshot: Dict[str, int] = {}  # op → count: save | restore
+        self._last_failover_ms = 0
+
+    # -- writers ------------------------------------------------------------
+    def count_failover(self, from_endpoint: str, to_endpoint: str,
+                       now_ms: int = 0) -> None:
+        key = (from_endpoint, to_endpoint)
+        with self._lock:
+            self._failover[key] = self._failover.get(key, 0) + 1
+            if now_ms:
+                self._last_failover_ms = now_ms
+
+    def count_fallback(self, action: str, n: int = 1) -> None:
+        with self._lock:
+            self._fallback[action] = self._fallback.get(action, 0) + n
+
+    def count_snapshot(self, op: str) -> None:
+        with self._lock:
+            self._snapshot[op] = self._snapshot.get(op, 0) + 1
+
+    # -- readers ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "failover": [
+                    {"from": f, "to": t, "count": c}
+                    for (f, t), c in sorted(self._failover.items())
+                ],
+                "fallback": dict(sorted(self._fallback.items())),
+                "snapshots": dict(sorted(self._snapshot.items())),
+                "lastFailoverMs": self._last_failover_ms,
+            }
+
+    def fallback_totals(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._fallback)
+
+    def render(self) -> str:
+        """Prometheus exposition (no trailing newline; the exporter joins
+        sections)."""
+        lines = [
+            "# HELP sentinel_failover_total Token-client endpoint failovers "
+            "(from → to; to=\"\" means degraded to local fallback).",
+            "# TYPE sentinel_failover_total counter",
+        ]
+        with self._lock:
+            failover = sorted(self._failover.items())
+            fallback = sorted(self._fallback.items())
+            snapshots = sorted(self._snapshot.items())
+        if failover:
+            for (f, t), count in failover:
+                lines.append(
+                    "sentinel_failover_total"
+                    f'{{from="{_escape(f)}",to="{_escape(t)}"}} {count}'
+                )
+        else:
+            lines.append('sentinel_failover_total{from="",to=""} 0')
+        lines.append(
+            "# HELP sentinel_fallback_total Requests resolved by the local "
+            "fallback policy, by action."
+        )
+        lines.append("# TYPE sentinel_fallback_total counter")
+        if fallback:
+            for action, count in fallback:
+                lines.append(
+                    f'sentinel_fallback_total{{action="{_escape(action)}"}}'
+                    f" {count}"
+                )
+        else:
+            lines.append('sentinel_fallback_total{action="pass"} 0')
+        lines.append(
+            "# HELP sentinel_snapshot_total Token-server state snapshot "
+            "operations."
+        )
+        lines.append("# TYPE sentinel_snapshot_total counter")
+        if snapshots:
+            for op, count in snapshots:
+                lines.append(
+                    f'sentinel_snapshot_total{{op="{_escape(op)}"}} {count}'
+                )
+        else:
+            lines.append('sentinel_snapshot_total{op="save"} 0')
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._failover.clear()
+            self._fallback.clear()
+            self._snapshot.clear()
+            self._last_failover_ms = 0
+
+
+_SINGLETON = HaMetrics()
+
+
+def ha_metrics() -> HaMetrics:
+    """The process-wide HA metrics registry."""
+    return _SINGLETON
+
+
+def reset_ha_metrics_for_tests() -> None:
+    _SINGLETON.reset()

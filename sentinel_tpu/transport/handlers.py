@@ -457,6 +457,66 @@ def cmd_cluster_server_profiler(params, body):
     return {"error": "action must be start|stop|status"}
 
 
+@command_mapping(
+    "cluster/server/snapshot",
+    "token-server state snapshot; action=save|fetch|restore|status [&dir=]",
+)
+def cmd_cluster_server_snapshot(params, body):
+    """HA state snapshot surface (``sentinel_tpu.ha.snapshot``):
+
+    - ``save``: write an artifact to ``dir`` (or the server's configured
+      snapshot directory) and return its path;
+    - ``fetch``: return the encoded snapshot document inline — the warm
+      standby's pull path (restore it with action=restore, body=doc);
+    - ``restore``: load state from the JSON document in the body, or from
+      the newest artifact in ``dir``;
+    - ``status``: periodic-writer configuration and last artifact path.
+    """
+    from sentinel_tpu.cluster import api as cluster_api
+    from sentinel_tpu.ha import snapshot as ha_snapshot
+
+    service = cluster_api.get_embedded_server()
+    if service is None or not hasattr(service, "export_state"):
+        return {"error": "this machine is not a token server"}
+    action = params.get("action", "status")
+    if action == "fetch":
+        return ha_snapshot.snapshot_to_doc(service)
+    if action == "save":
+        directory = params.get("dir") or _snapshot_dir_of_embedded()
+        if not directory:
+            return {"error": "no snapshot dir configured; pass dir="}
+        return {"path": ha_snapshot.save_snapshot(service, directory)}
+    if action == "restore":
+        if body:
+            try:
+                ha_snapshot.restore_from_doc(service, json.loads(body))
+            except ValueError as e:
+                return {"error": str(e)}
+            return "success"
+        directory = params.get("dir") or _snapshot_dir_of_embedded()
+        if not directory:
+            return {"error": "no snapshot dir configured; pass dir= or body"}
+        if not ha_snapshot.restore_latest(service, directory):
+            return {"error": f"no usable snapshot in {directory}"}
+        return "success"
+    if action == "status":
+        out = {"dir": _snapshot_dir_of_embedded()}
+        with _EMBEDDED_LOCK:
+            server = _EMBEDDED_SERVER["server"]
+        manager = getattr(server, "_snapshots", None)
+        if manager is not None:
+            out["periodS"] = manager.period_s
+            out["lastPath"] = manager.last_path
+        return out
+    return {"error": "action must be save|fetch|restore|status"}
+
+
+def _snapshot_dir_of_embedded():
+    with _EMBEDDED_LOCK:
+        server = _EMBEDDED_SERVER["server"]
+    return getattr(server, "snapshot_dir", None)
+
+
 @command_mapping("cluster/server/metrics", "token-server per-flow metrics")
 def cmd_cluster_server_metrics(params, body):
     from sentinel_tpu.cluster import api as cluster_api

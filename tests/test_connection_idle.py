@@ -1,0 +1,127 @@
+"""ConnectionManager idle-connection cleanup and the periodic sweeper.
+
+Connection-group accounting feeds AVG_LOCAL threshold scaling, so a wedged
+client that stops talking must age out (its count otherwise inflates the
+per-connection budget divisor forever), while an active client must never
+be reaped. Idle judgment runs on the injectable clock; only the sweeper
+thread's period is wall-time.
+"""
+
+import time
+
+import pytest
+
+from sentinel_tpu.cluster.connection import (
+    ConnectionManager,
+    IdleConnectionSweeper,
+)
+
+
+class TestSweepIdle:
+    def test_idle_connection_closed_and_dropped(self, manual_clock):
+        closed = []
+        cm = ConnectionManager()
+        cm.attach_closer("10.0.0.1:1", lambda: closed.append("10.0.0.1:1"))
+        cm.add("ns", "10.0.0.1:1")
+        manual_clock.advance(601_000)
+        assert cm.sweep_idle(600_000) == ["10.0.0.1:1"]
+        assert closed == ["10.0.0.1:1"]
+        assert cm.connected_count("ns") == 0
+        assert cm.snapshot() == {}
+        # reaping is idempotent: a second sweep finds nothing
+        assert cm.sweep_idle(600_000) == []
+
+    def test_touch_keeps_connection_alive(self, manual_clock):
+        cm = ConnectionManager()
+        cm.add("ns", "a:1")
+        cm.add("ns", "b:2")
+        manual_clock.advance(500_000)
+        cm.touch("a:1")  # any request refreshes liveness
+        manual_clock.advance(200_000)  # a:1 idle 200s, b:2 idle 700s
+        assert cm.sweep_idle(600_000) == ["b:2"]
+        assert cm.connected_count("ns") == 1
+        assert cm.snapshot() == {"ns": ["a:1"]}
+
+    def test_ping_refreshes_liveness_too(self, manual_clock):
+        cm = ConnectionManager()
+        cm.add("ns", "a:1")
+        manual_clock.advance(500_000)
+        cm.add("ns", "a:1")  # keepalive PING re-registers
+        manual_clock.advance(200_000)
+        assert cm.sweep_idle(600_000) == []
+
+    def test_closer_exception_still_deregisters(self, manual_clock):
+        def boom():
+            raise OSError("transport already gone")
+
+        cm = ConnectionManager()
+        cm.attach_closer("a:1", boom)
+        cm.add("ns", "a:1")
+        manual_clock.advance(601_000)
+        assert cm.sweep_idle(600_000) == ["a:1"]
+        assert cm.connected_count("ns") == 0
+
+    def test_count_change_callback_fires_on_reap(self, manual_clock):
+        events = []
+        cm = ConnectionManager(
+            on_count_changed=lambda ns, n: events.append((ns, n))
+        )
+        cm.add("ns", "a:1")
+        cm.add("ns", "b:2")
+        cm.add("other", "a:1")  # one connection, two namespaces
+        manual_clock.advance(601_000)
+        cm.touch("b:2")
+        assert cm.sweep_idle(600_000) == ["a:1"]
+        # reaping a:1 shrinks BOTH groups it registered in — AVG_LOCAL
+        # budgets rescale from the new counts immediately
+        assert ("ns", 1) in events and ("other", 0) in events
+
+    def test_never_pinged_socket_ages_out(self, manual_clock):
+        # attach_closer seeds the liveness stamp, so a socket that connected
+        # but never completed the PING handshake still gets reaped
+        closed = []
+        cm = ConnectionManager()
+        cm.attach_closer("mute:9", lambda: closed.append("mute:9"))
+        manual_clock.advance(601_000)
+        assert cm.sweep_idle(600_000) == ["mute:9"]
+        assert closed == ["mute:9"]
+
+    def test_fresh_connections_survive(self, manual_clock):
+        cm = ConnectionManager()
+        cm.add("ns", "a:1")
+        manual_clock.advance(100_000)
+        assert cm.sweep_idle(600_000) == []
+        assert cm.connected_count("ns") == 1
+
+
+class TestIdleConnectionSweeper:
+    def test_periodic_sweep_reaps_idle(self, manual_clock):
+        cm = ConnectionManager()
+        cm.add("ns", "a:1")
+        manual_clock.advance(2_000)  # idle past the 1s ttl
+        sweeper = IdleConnectionSweeper(cm, ttl_s=1.0, period_s=0.02)
+        sweeper.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while cm.connected_count("ns") and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert cm.connected_count("ns") == 0
+        finally:
+            sweeper.stop()
+
+    def test_stop_is_idempotent_and_start_once(self):
+        cm = ConnectionManager()
+        sweeper = IdleConnectionSweeper(cm, ttl_s=1.0, period_s=0.02)
+        sweeper.start()
+        first_thread = sweeper._thread
+        sweeper.start()  # no second thread
+        assert sweeper._thread is first_thread
+        sweeper.stop()
+        sweeper.stop()
+        assert sweeper._thread is None
+
+    def test_default_period_is_half_ttl(self):
+        cm = ConnectionManager()
+        assert IdleConnectionSweeper(cm, ttl_s=600.0).period_s == 300.0
+        # tiny ttls still poll at a sane floor
+        assert IdleConnectionSweeper(cm, ttl_s=0.1).period_s == 0.5

@@ -1,0 +1,560 @@
+"""Cluster HA tests: endpoint breaker, failover client, local fallback,
+client reconnect backoff, RLS failure mode, and runtime mode transitions.
+
+The kill-the-primary drill at the bottom runs against two REAL token servers
+on localhost (same strategy as test_cluster's transport tests): SIGKILL-level
+death is simulated by stopping the primary mid-load, and the acceptance bar
+is the configured failover deadline.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster import api as cluster_api
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.server import TokenServer
+from sentinel_tpu.cluster.token_service import (
+    DefaultTokenService,
+    TokenResult,
+)
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.ha import (
+    Endpoint,
+    EndpointHealth,
+    FailoverTokenClient,
+    FallbackAction,
+    FallbackRule,
+    HealthState,
+    LocalFallbackPolicy,
+)
+from sentinel_tpu.ha.manager import ClusterStateManager
+from sentinel_tpu.metrics.ha import ha_metrics, reset_ha_metrics_for_tests
+
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+G = ThresholdMode.GLOBAL
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ha_metrics():
+    reset_ha_metrics_for_tests()
+    yield
+    reset_ha_metrics_for_tests()
+
+
+class StubClient:
+    """client_factory stand-in: scriptable per-endpoint behavior."""
+
+    def __init__(self, host, port, timeout_ms=20, namespace="default"):
+        self.host = host
+        self.port = port
+        self.alive = True
+        self.calls = 0
+        self.closed = False
+
+    def request_token(self, flow_id, acquire=1, prioritized=False):
+        self.calls += 1
+        if not self.alive:
+            return TokenResult(TokenStatus.FAIL)
+        return TokenResult(TokenStatus.OK, remaining=int(self.port))
+
+    def request_batch_arrays(self, flow_ids, acquires=None, prios=None,
+                             timeout_ms=None):
+        self.calls += 1
+        if not self.alive:
+            return None
+        n = len(flow_ids)
+        return (
+            np.full(n, int(TokenStatus.OK), np.int8),
+            np.full(n, int(self.port), np.int32),
+            np.zeros(n, np.int32),
+        )
+
+    def ping(self, namespace=None):
+        self.calls += 1
+        return self.alive
+
+    def close(self):
+        self.closed = True
+
+
+class TestEndpointHealth:
+    def test_closed_allows_and_failures_below_threshold_stay_closed(
+        self, manual_clock
+    ):
+        h = EndpointHealth(failure_threshold=3, backoff_base_ms=100,
+                           rand=lambda: 0.0)
+        assert h.allows_request() and h.healthy
+        h.record_failure()
+        h.record_failure()
+        assert h.state == HealthState.CLOSED
+        assert h.allows_request()
+        assert h.consecutive_failures == 2
+
+    def test_threshold_opens_and_backoff_gates_retry(self, manual_clock):
+        h = EndpointHealth(failure_threshold=2, backoff_base_ms=100,
+                           jitter=0.0, rand=lambda: 0.0)
+        h.record_failure()
+        h.record_failure()
+        assert h.state == HealthState.OPEN
+        assert not h.allows_request()
+        manual_clock.advance(99)
+        assert not h.allows_request()
+        manual_clock.advance(1)
+        # backoff elapsed: exactly ONE probe admitted
+        assert h.allows_request()
+        assert h.state == HealthState.HALF_OPEN
+        assert not h.allows_request()
+
+    def test_probe_success_closes(self, manual_clock):
+        h = EndpointHealth(failure_threshold=1, backoff_base_ms=50,
+                           jitter=0.0, rand=lambda: 0.0)
+        h.record_failure()
+        manual_clock.advance(50)
+        assert h.allows_request()
+        h.record_success()
+        assert h.state == HealthState.CLOSED
+        assert h.consecutive_failures == 0
+        assert h.allows_request()
+
+    def test_probe_failure_doubles_backoff(self, manual_clock):
+        h = EndpointHealth(failure_threshold=1, backoff_base_ms=100,
+                           backoff_max_ms=10_000, jitter=0.0,
+                           rand=lambda: 0.0)
+        h.record_failure()  # opens, retry in 100ms
+        first_retry = h.retry_at_ms
+        assert first_retry == manual_clock.now_ms() + 100
+        manual_clock.advance(100)
+        assert h.allows_request()  # half-open probe
+        h.record_failure()  # probe failed → re-open with 200ms
+        assert h.state == HealthState.OPEN
+        assert h.retry_at_ms == manual_clock.now_ms() + 200
+
+    def test_backoff_caps_at_max(self, manual_clock):
+        h = EndpointHealth(failure_threshold=1, backoff_base_ms=100,
+                           backoff_max_ms=400, jitter=0.0, rand=lambda: 0.0)
+        for _ in range(6):  # many open cycles
+            h.record_failure()
+            manual_clock.advance(int(h.retry_at_ms - manual_clock.now_ms()))
+            assert h.allows_request()
+        h.record_failure()
+        assert h.retry_at_ms - manual_clock.now_ms() == 400
+
+    def test_jitter_applied(self, manual_clock):
+        h = EndpointHealth(failure_threshold=1, backoff_base_ms=100,
+                           jitter=0.5, rand=lambda: 1.0)
+        h.record_failure()
+        assert h.retry_at_ms == manual_clock.now_ms() + 150
+
+    def test_snapshot_shape(self):
+        h = EndpointHealth(failure_threshold=1)
+        snap = h.snapshot()
+        assert snap["state"] == "CLOSED"
+        assert snap["consecutiveFailures"] == 0
+
+
+class TestFailoverClient:
+    def _client(self, fallback=None, **kw):
+        kw.setdefault("failure_threshold", 2)
+        kw.setdefault("backoff_base_ms", 50.0)
+        return FailoverTokenClient(
+            [("primary", 1), ("standby", 2)],
+            client_factory=StubClient, fallback=fallback, **kw
+        )
+
+    def test_serves_from_primary_when_healthy(self):
+        fc = self._client()
+        r = fc.request_token(7)
+        assert r.ok and r.remaining == 1  # StubClient answers its port
+        assert str(fc.active_endpoint) == "primary:1"
+
+    def test_dead_primary_evicted_standby_serves(self):
+        fc = self._client()
+        fc._members[0].client.alive = False
+        r = fc.request_token(7)
+        # the SAME request walks past the failing primary to the standby
+        assert r.ok and r.remaining == 2
+        assert str(fc.active_endpoint) == "standby:2"
+        failovers = ha_metrics().snapshot()["failover"]
+        assert {"from": "primary:1", "to": "standby:2", "count": 1} in failovers
+        # after threshold failures the primary stops being tried at all
+        fc.request_token(7)
+        calls_before = fc._members[0].client.calls
+        fc.request_token(7)
+        assert fc._members[0].client.calls == calls_before
+
+    def test_all_down_resolves_via_fallback_never_raises(self):
+        policy = LocalFallbackPolicy(
+            [FallbackRule(9, FallbackAction.BLOCK)],
+            default_action=FallbackAction.PASS,
+        )
+        fc = self._client(fallback=policy)
+        for m in fc._members:
+            m.client.alive = False
+        for _ in range(10):
+            r = fc.request_token(9)
+            assert r.status == TokenStatus.BLOCKED
+            assert fc.request_token(777).status == TokenStatus.OK
+        degraded = [
+            f for f in ha_metrics().snapshot()["failover"] if f["to"] == ""
+        ]
+        assert degraded and degraded[0]["count"] >= 1
+
+    def test_default_fallback_is_pass_through(self):
+        fc = self._client()  # no explicit policy
+        for m in fc._members:
+            m.client.alive = False
+        assert fc.request_token(1).status == TokenStatus.OK
+
+    def test_batch_arrays_degrade_to_fallback(self):
+        policy = LocalFallbackPolicy([FallbackRule(5, FallbackAction.BLOCK)])
+        fc = self._client(fallback=policy)
+        for m in fc._members:
+            m.client.alive = False
+        status, remaining, wait = fc.request_batch_arrays(
+            np.array([5, 6], np.int64)
+        )
+        assert status.tolist() == [int(TokenStatus.BLOCKED), int(TokenStatus.OK)]
+        assert remaining.shape == wait.shape == (2,)
+
+    def test_recovered_primary_serves_again(self, manual_clock):
+        fc = self._client()
+        fc._members[0].client.alive = False
+        assert fc.request_token(7).remaining == 2  # standby took over
+        fc.request_token(7)  # breaker opens on the primary
+        fc._members[0].client.alive = True
+        manual_clock.advance(10_000)  # backoff elapses → half-open probe
+        r = fc.request_token(7)
+        assert r.remaining == 1
+        assert str(fc.active_endpoint) == "primary:1"
+        assert fc._members[0].health.state == HealthState.CLOSED
+
+    def test_raising_client_treated_as_failure(self):
+        class Raising(StubClient):
+            def request_token(self, *a, **k):
+                raise ConnectionError("boom")
+
+        fc = FailoverTokenClient(
+            [("p", 1), ("s", 2)],
+            client_factory=lambda h, p, **kw: (
+                Raising(h, p, **kw) if p == 1 else StubClient(h, p, **kw)
+            ),
+            failure_threshold=1,
+        )
+        r = fc.request_token(1)
+        assert r.ok and r.remaining == 2
+
+    def test_ping_and_health_snapshot(self):
+        fc = self._client()
+        assert fc.ping() is True
+        fc._members[0].client.alive = False
+        fc._members[1].client.alive = False
+        fc.request_token(1)
+        fc.request_token(1)
+        assert fc.ping() is False
+        snap = fc.health_snapshot()
+        assert [e["endpoint"] for e in snap] == ["primary:1", "standby:2"]
+        assert all(e["state"] == "OPEN" for e in snap)
+
+    def test_close_closes_every_member(self):
+        fc = self._client()
+        fc.close()
+        assert all(m.client.closed for m in fc._members)
+
+    def test_endpoint_objects_accepted(self):
+        fc = FailoverTokenClient(
+            [Endpoint("h", 42)], client_factory=StubClient
+        )
+        assert fc.request_token(1).remaining == 42
+
+    def test_empty_endpoint_list_rejected(self):
+        with pytest.raises(ValueError):
+            FailoverTokenClient([], client_factory=StubClient)
+
+
+class TestLocalFallbackPolicy:
+    def test_action_matrix(self):
+        policy = LocalFallbackPolicy(
+            [
+                FallbackRule(1, FallbackAction.PASS),
+                FallbackRule(2, FallbackAction.BLOCK),
+            ],
+            default_action=FallbackAction.BLOCK,
+        )
+        assert policy.decide(1).status == TokenStatus.OK
+        assert policy.decide(2).status == TokenStatus.BLOCKED
+        assert policy.decide(999).status == TokenStatus.BLOCKED
+
+    def test_throttle_enforces_local_budget(self, manual_clock):
+        policy = LocalFallbackPolicy(
+            [FallbackRule(3, FallbackAction.THROTTLE, count=5.0)]
+        )
+        verdicts = [policy.decide(3).status for _ in range(8)]
+        assert verdicts.count(TokenStatus.OK) == 5
+        assert verdicts.count(TokenStatus.BLOCKED) == 3
+        # the next window refills the budget
+        manual_clock.advance(1000)
+        assert policy.decide(3).status == TokenStatus.OK
+
+    def test_throttle_pacing_mode(self, manual_clock):
+        policy = LocalFallbackPolicy(
+            [FallbackRule(4, FallbackAction.THROTTLE, count=1000.0,
+                          max_queueing_time_ms=50)]
+        )
+        # pacing admits sequential requests at 1/ms without blocking
+        for _ in range(3):
+            assert policy.decide(4).status == TokenStatus.OK
+
+    def test_stats_and_counters(self):
+        policy = LocalFallbackPolicy(
+            [FallbackRule(2, FallbackAction.BLOCK)]
+        )
+        policy.decide(1)
+        policy.decide(2)
+        stats = policy.stats()
+        assert stats == {"passed": 1, "blocked": 1, "blocked_rate": 0.5}
+        totals = ha_metrics().fallback_totals()
+        assert totals["pass"] == 1 and totals["block"] == 1
+
+    def test_reload_resets_throttle_state(self, manual_clock):
+        rule = FallbackRule(3, FallbackAction.THROTTLE, count=2.0)
+        policy = LocalFallbackPolicy([rule])
+        policy.decide(3)
+        policy.decide(3)
+        assert policy.decide(3).status == TokenStatus.BLOCKED
+        policy.load_rules([rule])  # fresh controller → fresh budget
+        assert policy.decide(3).status == TokenStatus.OK
+
+
+class TestClientReconnectBackoff:
+    def test_backoff_grows_with_consecutive_failures(self):
+        client = TokenClient("127.0.0.1", 1)  # nothing listens on port 1
+        assert client.consecutive_failures == 0
+        assert client._ensure_connected() is False
+        assert client.consecutive_failures == 1
+        first_delay = client._reconnect_delay_s
+        assert 0 < first_delay < 1.0
+        # inside the backoff window the client does NOT dial again
+        assert client._ensure_connected() is False
+        assert client.consecutive_failures == 1
+        # force the gate open repeatedly: the delay ladder doubles
+        client._last_connect_attempt = 0.0
+        client._ensure_connected()
+        assert client.consecutive_failures == 2
+        assert client._reconnect_delay_s > first_delay
+
+    def test_backoff_caps_at_max(self):
+        client = TokenClient("127.0.0.1", 1)
+        client._reconnect_max_s = 0.5
+        for _ in range(12):
+            client._last_connect_attempt = 0.0
+            client._ensure_connected()
+        assert client.consecutive_failures == 12
+        assert client._reconnect_delay_s <= 0.5 * 1.2001  # max × (1+jitter)
+
+    def test_success_resets_failure_count(self):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(1, 100.0, G)])
+        server = TokenServer(svc, port=0)
+        server.start()
+        try:
+            client = TokenClient("127.0.0.1", 1)
+            client._ensure_connected()  # fails: port 1
+            assert client.consecutive_failures == 1
+            client.port = server.port
+            client._last_connect_attempt = 0.0
+            assert client._ensure_connected() is True
+            assert client.consecutive_failures == 0
+            assert client._reconnect_delay_s == 0.0
+            client.close()
+        finally:
+            server.stop()
+
+
+class TestRlsFailureMode:
+    class _BoomService:
+        def request_batch(self, requests):
+            raise RuntimeError("device fault")
+
+    class _ShortService:
+        def request_batch(self, requests):
+            return []  # length mismatch
+
+    class _FailService:
+        def request_batch(self, requests):
+            return [TokenResult(TokenStatus.FAIL) for _ in requests]
+
+    class _Rules:
+        def lookup(self, fid):
+            from sentinel_tpu.cluster.envoy_rls import RlsDescriptor
+
+            return ("d", RlsDescriptor((("k", "v"),), 10.0))
+
+    def test_error_mid_batch_fails_open_by_default(self):
+        from sentinel_tpu.cluster.envoy_rls import CODE_OK, RlsService
+
+        rls = RlsService(self._BoomService(), self._Rules())
+        verdict = rls.should_rate_limit("d", [[("k", "v")], [("k", "w")]])
+        assert verdict.overall_code == CODE_OK
+        assert [st.code for st in verdict.statuses] == [CODE_OK, CODE_OK]
+        assert ha_metrics().fallback_totals()["rls_allow"] == 2
+
+    def test_error_mid_batch_deny_mode(self):
+        from sentinel_tpu.cluster.envoy_rls import (
+            CODE_OVER_LIMIT,
+            RlsService,
+        )
+
+        rls = RlsService(
+            self._BoomService(), self._Rules(), failure_mode="deny"
+        )
+        verdict = rls.should_rate_limit("d", [[("k", "v")]])
+        assert verdict.overall_code == CODE_OVER_LIMIT
+        assert ha_metrics().fallback_totals()["rls_deny"] == 1
+
+    def test_result_length_mismatch_uses_failure_mode(self):
+        from sentinel_tpu.cluster.envoy_rls import CODE_OK, RlsService
+
+        rls = RlsService(self._ShortService(), self._Rules())
+        verdict = rls.should_rate_limit("d", [[("k", "v")]])
+        assert verdict.overall_code == CODE_OK
+
+    def test_per_descriptor_fail_status_uses_failure_mode(self):
+        from sentinel_tpu.cluster.envoy_rls import (
+            CODE_OK,
+            CODE_OVER_LIMIT,
+            RlsService,
+        )
+
+        allow = RlsService(self._FailService(), self._Rules())
+        assert allow.should_rate_limit("d", [[("k", "v")]]).overall_code == CODE_OK
+        deny = RlsService(
+            self._FailService(), self._Rules(), failure_mode="deny"
+        )
+        assert (
+            deny.should_rate_limit("d", [[("k", "v")]]).overall_code
+            == CODE_OVER_LIMIT
+        )
+
+    def test_invalid_mode_rejected(self):
+        from sentinel_tpu.cluster.envoy_rls import RlsService
+
+        with pytest.raises(ValueError):
+            RlsService(self._BoomService(), self._Rules(),
+                       failure_mode="maybe")
+
+
+class TestClusterStateManager:
+    @pytest.fixture(autouse=True)
+    def _clean_cluster_state(self):
+        yield
+        from sentinel_tpu.transport.handlers import apply_cluster_mode
+
+        apply_cluster_mode(-1)
+        cluster_api.reset_for_tests()
+
+    def test_to_client_installs_failover_client(self):
+        manager = ClusterStateManager()
+        client = manager.to_client(
+            [("a", 1), ("b", 2)], client_factory=StubClient
+        )
+        assert cluster_api.get_mode() == cluster_api.ClusterMode.CLIENT
+        assert cluster_api._pick_service() is client
+        # the slot chain's per-request service pick sees it immediately
+        assert client.request_token(1).ok
+
+    def test_to_server_then_to_client_rewires_live(self):
+        manager = ClusterStateManager()
+        service = manager.to_server(token_port=0)
+        assert cluster_api.get_mode() == cluster_api.ClusterMode.SERVER
+        assert cluster_api.get_embedded_server() is service
+        client = manager.to_client([("a", 1)], client_factory=StubClient)
+        assert cluster_api.get_mode() == cluster_api.ClusterMode.CLIENT
+        assert cluster_api.get_embedded_server() is None
+        assert cluster_api._pick_service() is client
+
+    def test_to_off_drops_client(self):
+        manager = ClusterStateManager()
+        client = manager.to_client([("a", 1)], client_factory=StubClient)
+        manager.to_off()
+        assert manager.current_mode() == cluster_api.ClusterMode.NOT_STARTED
+        assert cluster_api._pick_service() is None
+        assert all(m.client.closed for m in client._members)
+
+    def test_server_restores_snapshot_on_promotion(self, tmp_path):
+        from sentinel_tpu.ha.snapshot import save_snapshot
+
+        donor = DefaultTokenService(EngineConfig())
+        donor.load_rules([ClusterFlowRule(55, 20.0, G)])
+        donor.request_token(55)
+        save_snapshot(donor, str(tmp_path))
+        manager = ClusterStateManager()
+        service = manager.to_server(
+            token_port=0, snapshot_dir=str(tmp_path)
+        )
+        assert [r.flow_id for r in service.current_rules()] == [55]
+
+    def test_status_shape(self):
+        manager = ClusterStateManager()
+        manager.to_client([("a", 1)], client_factory=StubClient)
+        status = manager.status()
+        assert status["mode"] == "CLIENT"
+        assert status["endpoints"][0]["endpoint"] == "a:1"
+
+
+class TestKillPrimaryDrill:
+    """ISSUE acceptance: with two servers up and the primary killed
+    mid-load, the client converges on the standby within the deadline; with
+    all servers down every request resolves via local fallback."""
+
+    def _start_server(self):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(42, 10_000.0, G)])
+        server = TokenServer(svc, port=0)
+        server.start()
+        return server
+
+    def test_failover_within_deadline_then_fallback(self):
+        primary = self._start_server()
+        standby = self._start_server()
+        deadline_ms = 500.0
+        fc = FailoverTokenClient(
+            [("127.0.0.1", primary.port), ("127.0.0.1", standby.port)],
+            timeout_ms=200,
+            failure_threshold=1,
+            deadline_ms=deadline_ms,
+            fallback=LocalFallbackPolicy(
+                [FallbackRule(42, FallbackAction.BLOCK)]
+            ),
+        )
+        try:
+            assert fc.request_token(42).ok
+            assert str(fc.active_endpoint) == f"127.0.0.1:{primary.port}"
+            primary.stop()  # the kill
+            t0 = time.monotonic()
+            converged_ms = None
+            while time.monotonic() - t0 < 5.0:
+                r = fc.request_token(42)  # must never raise
+                if (
+                    r.ok
+                    and str(fc.active_endpoint)
+                    == f"127.0.0.1:{standby.port}"
+                ):
+                    converged_ms = (time.monotonic() - t0) * 1e3
+                    break
+            assert converged_ms is not None, "never converged on the standby"
+            assert converged_ms <= deadline_ms, converged_ms
+            assert fc.request_token(42).ok  # standby keeps serving
+            standby.stop()  # now EVERYTHING is down
+            saw_block = False
+            for _ in range(20):
+                r = fc.request_token(42)  # still never raises
+                saw_block = saw_block or r.status == TokenStatus.BLOCKED
+            assert saw_block, "fallback policy never engaged"
+        finally:
+            fc.close()
+            primary.stop()
+            standby.stop()

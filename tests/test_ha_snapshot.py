@@ -1,0 +1,285 @@
+"""Token-server state snapshot/restore: codec round trips, slot remapping,
+artifact directory management, the periodic writer, and the
+``cluster/server/snapshot`` transport command."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster import api as cluster_api
+from sentinel_tpu.cluster.token_service import (
+    ClusterParamFlowRule,
+    DefaultTokenService,
+)
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.ha.snapshot import (
+    SNAPSHOT_VERSION,
+    SnapshotManager,
+    decode_snapshot,
+    load_latest,
+    restore_from_doc,
+    restore_latest,
+    save_snapshot,
+    snapshot_to_doc,
+)
+from sentinel_tpu.metrics.ha import ha_metrics, reset_ha_metrics_for_tests
+
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+G = ThresholdMode.GLOBAL
+
+RULE_A = ClusterFlowRule(101, 50.0, G)
+RULE_B = ClusterFlowRule(202, 50.0, G)
+PARAM_RULE = ClusterParamFlowRule(301, 10.0, None, "default")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ha_metrics():
+    reset_ha_metrics_for_tests()
+    yield
+    reset_ha_metrics_for_tests()
+
+
+def _warm_service(manual_clock):
+    """A service with traffic on two flow rules and one param rule."""
+    svc = DefaultTokenService(CFG)
+    svc.load_rules([RULE_A, RULE_B])
+    svc.load_param_rules([PARAM_RULE])
+    for _ in range(4):
+        assert svc.request_token(101).ok
+    for _ in range(2):
+        assert svc.request_token(202).ok
+    assert svc.request_params_token(301, 1, [7, 8]).ok
+    return svc
+
+
+class TestStateRoundTrip:
+    def test_counters_preserved_across_export_import(self, manual_clock):
+        donor = _warm_service(manual_clock)
+        heir = DefaultTokenService(CFG)
+        heir.import_state(donor.export_state())
+        donor_m = donor.metrics_snapshot()
+        heir_m = heir.metrics_snapshot()
+        assert heir_m[101]["pass_qps"] == donor_m[101]["pass_qps"] > 0
+        assert heir_m[202]["pass_qps"] == donor_m[202]["pass_qps"] > 0
+        assert [r.flow_id for r in heir.current_rules()] == [101, 202]
+        # the restored service keeps COUNTING from where the donor stopped:
+        # 4 of 50 passed already, so exactly 46 remain this window
+        passed = 0
+        while heir.request_token(101).ok:
+            passed += 1
+        assert passed == 46
+
+    def test_slot_remap_when_standby_loaded_rules_in_other_order(
+        self, manual_clock
+    ):
+        donor = _warm_service(manual_clock)
+        heir = DefaultTokenService(CFG)
+        # the standby discovered the same rules in REVERSE order → its
+        # RuleIndex assigns different slots; import must remap counter rows
+        heir.load_rules([RULE_B, RULE_A])
+        heir.load_param_rules([PARAM_RULE])
+        donor_state = donor.export_state()
+        heir.import_state(donor_state)
+        donor_m = donor.metrics_snapshot()
+        heir_m = heir.metrics_snapshot()
+        assert heir_m[101]["pass_qps"] == donor_m[101]["pass_qps"]
+        assert heir_m[202]["pass_qps"] == donor_m[202]["pass_qps"]
+        # the CMS param sketch rows followed their rule too
+        heir_state = heir.export_state()
+        donor_row = donor_state["param"]["counts"][
+            donor_state["param_slot_of"][301]
+        ]
+        heir_row = heir_state["param"]["counts"][
+            heir_state["param_slot_of"][301]
+        ]
+        assert np.array_equal(donor_row, heir_row)
+
+    def test_restored_counters_expire_after_one_window(self, manual_clock):
+        donor = _warm_service(manual_clock)
+        heir = DefaultTokenService(CFG)
+        heir.import_state(donor.export_state())
+        manual_clock.advance(10_000)  # well past the sliding window
+        assert heir.metrics_snapshot()[101]["pass_qps"] == 0.0
+
+    def test_json_document_round_trip(self, manual_clock):
+        donor = _warm_service(manual_clock)
+        doc = snapshot_to_doc(donor)
+        assert doc["version"] == SNAPSHOT_VERSION
+        wire = json.dumps(doc)  # the transport command's fetch/restore path
+        heir = DefaultTokenService(CFG)
+        restore_from_doc(heir, json.loads(wire))
+        assert (
+            heir.metrics_snapshot()[101]["pass_qps"]
+            == donor.metrics_snapshot()[101]["pass_qps"]
+        )
+        assert ha_metrics().snapshot()["snapshots"].get("restore") == 1
+
+    def test_unknown_version_rejected(self, manual_clock):
+        doc = snapshot_to_doc(_warm_service(manual_clock))
+        doc["version"] = 99
+        with pytest.raises(ValueError):
+            decode_snapshot(doc)
+
+    def test_geometry_mismatch_rejected_before_mutation(self, manual_clock):
+        donor = _warm_service(manual_clock)
+        smaller = DefaultTokenService(
+            EngineConfig(max_flows=8, max_namespaces=4, batch_size=64)
+        )
+        with pytest.raises(ValueError, match="geometry"):
+            smaller.import_state(donor.export_state())
+        assert smaller.current_rules() == []  # start cold rather than corrupt
+
+
+class TestArtifactDirectory:
+    def test_save_restore_round_trip(self, tmp_path, manual_clock):
+        donor = _warm_service(manual_clock)
+        path = save_snapshot(donor, str(tmp_path))
+        assert os.path.exists(path)
+        heir = DefaultTokenService(CFG)
+        assert restore_latest(heir, str(tmp_path)) is True
+        assert (
+            heir.metrics_snapshot()[101]["pass_qps"]
+            == donor.metrics_snapshot()[101]["pass_qps"]
+        )
+        ops = ha_metrics().snapshot()["snapshots"]
+        assert ops == {"save": 1, "restore": 1}
+
+    def test_retain_prunes_oldest(self, tmp_path, manual_clock):
+        donor = _warm_service(manual_clock)
+        paths = []
+        for _ in range(5):
+            paths.append(save_snapshot(donor, str(tmp_path), retain=3))
+            manual_clock.advance(1000)  # distinct saved_at_ms per artifact
+        kept = sorted(os.listdir(tmp_path))
+        assert len(kept) == 3
+        assert os.path.basename(paths[-1]) in kept
+        assert os.path.basename(paths[0]) not in kept
+
+    def test_corrupt_newest_falls_back_to_previous(
+        self, tmp_path, manual_clock
+    ):
+        donor = _warm_service(manual_clock)
+        save_snapshot(donor, str(tmp_path))
+        manual_clock.advance(1000)
+        good = load_latest(str(tmp_path))
+        torn = tmp_path / f"sentinel-snapshot-{manual_clock.now_ms()}.json"
+        torn.write_text('{"version": 1, "truncated')
+        assert load_latest(str(tmp_path)) == good
+        heir = DefaultTokenService(CFG)
+        assert restore_latest(heir, str(tmp_path)) is True
+
+    def test_empty_or_missing_dir_is_a_cold_start(self, tmp_path):
+        svc = DefaultTokenService(CFG)
+        assert restore_latest(svc, str(tmp_path)) is False
+        assert restore_latest(svc, str(tmp_path / "nowhere")) is False
+
+    def test_geometry_mismatch_restores_cold(self, tmp_path, manual_clock):
+        donor = _warm_service(manual_clock)
+        save_snapshot(donor, str(tmp_path))
+        smaller = DefaultTokenService(
+            EngineConfig(max_flows=8, max_namespaces=4, batch_size=64)
+        )
+        assert restore_latest(smaller, str(tmp_path)) is False
+
+
+class TestSnapshotManager:
+    def test_save_now_and_final_save(self, tmp_path, manual_clock):
+        svc = _warm_service(manual_clock)
+        manager = SnapshotManager(svc, str(tmp_path), period_s=3600.0)
+        manager.start()
+        try:
+            first = manager.save_now()
+            assert first is not None and os.path.exists(first)
+            assert manager.last_path == first
+        finally:
+            manual_clock.advance(1000)
+            manager.stop(final_save=True)
+        assert manager.last_path != first  # stop wrote one more artifact
+        assert ha_metrics().snapshot()["snapshots"]["save"] == 2
+
+    def test_failed_save_is_swallowed(self, tmp_path, manual_clock):
+        svc = _warm_service(manual_clock)
+        manager = SnapshotManager(svc, str(tmp_path / "f" / "\0bad"),
+                                  period_s=3600.0)
+        assert manager.save_now() is None  # logged, not raised
+        assert manager.last_path is None
+
+
+class TestSnapshotTransportCommand:
+    @pytest.fixture(autouse=True)
+    def _clean_cluster_state(self):
+        yield
+        cluster_api.reset_for_tests()
+
+    def test_not_a_server_error(self):
+        from sentinel_tpu.transport.handlers import (
+            cmd_cluster_server_snapshot,
+        )
+
+        out = cmd_cluster_server_snapshot({}, "")
+        assert "error" in out
+
+    def test_fetch_then_restore_via_body(self, manual_clock):
+        from sentinel_tpu.transport.handlers import (
+            cmd_cluster_server_snapshot,
+        )
+
+        donor = _warm_service(manual_clock)
+        cluster_api.set_embedded_server(donor)
+        doc = cmd_cluster_server_snapshot({"action": "fetch"}, "")
+        assert doc["version"] == SNAPSHOT_VERSION
+        # a warm standby pulls the doc and restores it into ITS service
+        heir = DefaultTokenService(CFG)
+        cluster_api.set_embedded_server(heir)
+        out = cmd_cluster_server_snapshot(
+            {"action": "restore"}, json.dumps(doc)
+        )
+        assert out == "success"
+        assert (
+            heir.metrics_snapshot()[101]["pass_qps"]
+            == donor.metrics_snapshot()[101]["pass_qps"]
+        )
+
+    def test_save_and_restore_via_dir(self, tmp_path, manual_clock):
+        from sentinel_tpu.transport.handlers import (
+            cmd_cluster_server_snapshot,
+        )
+
+        donor = _warm_service(manual_clock)
+        cluster_api.set_embedded_server(donor)
+        out = cmd_cluster_server_snapshot(
+            {"action": "save", "dir": str(tmp_path)}, ""
+        )
+        assert os.path.exists(out["path"])
+        heir = DefaultTokenService(CFG)
+        cluster_api.set_embedded_server(heir)
+        assert (
+            cmd_cluster_server_snapshot(
+                {"action": "restore", "dir": str(tmp_path)}, ""
+            )
+            == "success"
+        )
+        assert [r.flow_id for r in heir.current_rules()] == [101, 202]
+
+    def test_save_without_dir_errors(self, manual_clock):
+        from sentinel_tpu.transport.handlers import (
+            cmd_cluster_server_snapshot,
+        )
+
+        cluster_api.set_embedded_server(_warm_service(manual_clock))
+        out = cmd_cluster_server_snapshot({"action": "save"}, "")
+        assert "error" in out
+
+    def test_bad_doc_reports_error(self, manual_clock):
+        from sentinel_tpu.transport.handlers import (
+            cmd_cluster_server_snapshot,
+        )
+
+        cluster_api.set_embedded_server(_warm_service(manual_clock))
+        out = cmd_cluster_server_snapshot(
+            {"action": "restore"}, json.dumps({"version": 99})
+        )
+        assert "error" in out

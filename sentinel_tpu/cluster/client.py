@@ -319,10 +319,20 @@ class TokenClient(TokenService):
         """Handshake/keepalive; declares a namespace this client serves
         (``TokenServerHandler.handlePingRequest``). One connection may
         declare several namespaces — each ping adds one group membership."""
-        return (
-            self._roundtrip(P.Ping(next(self._xid), namespace or self.namespace))
-            is not None
+        return self.ping_ex(namespace) is True
+
+    def ping_ex(self, namespace: Optional[str] = None) -> Optional[bool]:
+        """Ping that separates transport failure from the server's answer:
+        ``None`` when no response arrived (dead host, timeout, send
+        failure), else the server's verdict — status 0 means the namespace
+        group accepted this connection. Failover health accounting charges
+        an endpoint's breaker only for the ``None`` case."""
+        rsp = self._roundtrip(
+            P.Ping(next(self._xid), namespace or self.namespace)
         )
+        if rsp is None:
+            return None
+        return rsp.status == 0
 
     def _roundtrip(self, req) -> Optional[P.FlowResponse]:
         """Correlated request/response: register pending, send, wait, pop."""

@@ -9,7 +9,13 @@ request here?" This module answers it with a three-state circuit breaker:
   jittered backoff (``base_ms · 2^(k-1)``, capped at ``max_ms``).
 - **HALF_OPEN**: the backoff elapsed; exactly ONE probe request is let
   through. Success closes the breaker, failure re-opens it with a longer
-  backoff.
+  backoff. Because only ``record_success``/``record_failure`` leave this
+  state, callers must treat an admitted probe as a commitment: consult
+  ``allows_request()`` immediately before dispatching to the endpoint,
+  never speculatively for endpoints that might not be tried. As a backstop
+  against a prober that dies without reporting, a probe that hasn't been
+  answered within a backoff-length grace window forfeits its slot and the
+  next ``allows_request()`` admits a fresh probe.
 
 Time comes from the injectable ``core.clock`` so tests drive the state
 machine with a ``ManualClock``; jitter comes from an injectable uniform
@@ -88,12 +94,18 @@ class EndpointHealth:
         self.consecutive_failures = 0
         self.retry_at_ms = 0
         self._opened = 0  # open cycles since last success → backoff exponent
+        self._probe_deadline_ms = 0.0  # HALF_OPEN: when the probe forfeits
 
     # -- queries ------------------------------------------------------------
     def allows_request(self) -> bool:
         """May the next request go to this endpoint? An OPEN breaker whose
         backoff elapsed transitions to HALF_OPEN and admits exactly one
-        probe (subsequent calls are refused until that probe reports)."""
+        probe (subsequent calls are refused until that probe reports).
+
+        A ``True`` answer in non-CLOSED states hands out the probe slot, so
+        call this only when the request WILL be dispatched to the endpoint —
+        an admitted-but-never-sent probe would otherwise pin the breaker in
+        HALF_OPEN until the grace window below reclaims it."""
         now = _clock.now_ms()
         with self._lock:
             if self.state == HealthState.CLOSED:
@@ -101,9 +113,16 @@ class EndpointHealth:
             if self.state == HealthState.OPEN:
                 if now >= self.retry_at_ms:
                     self.state = HealthState.HALF_OPEN
+                    self._probe_deadline_ms = now + self.backoff_ms()
                     return True
                 return False
-            return False  # HALF_OPEN: one probe already in flight
+            # HALF_OPEN: one probe in flight — unless it was admitted a full
+            # backoff ago and never reported (the dispatcher died before
+            # calling record_*); then it forfeits and a fresh probe goes out
+            if now >= self._probe_deadline_ms:
+                self._probe_deadline_ms = now + self.backoff_ms()
+                return True
+            return False
 
     @property
     def healthy(self) -> bool:

@@ -24,7 +24,7 @@ decides *where* a request goes and *what* happens when nowhere is healthy.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -100,12 +100,6 @@ class FailoverTokenClient(TokenService):
         self._active = 0  # index of the member that served last (telemetry)
 
     # -- endpoint walk -------------------------------------------------------
-    def _available(self) -> List[Tuple[int, _Member]]:
-        return [
-            (i, m) for i, m in enumerate(self._members)
-            if m.health.allows_request()
-        ]
-
     def _note_served(self, index: int) -> None:
         with self._lock:
             if index != self._active:
@@ -137,7 +131,15 @@ class FailoverTokenClient(TokenService):
                     and r.status == TokenStatus.FAIL)
             )
         deadline = _clock.now_ms() + self.deadline_ms
-        for i, member in self._available():
+        for i, member in enumerate(self._members):
+            # health is consulted immediately before dispatch, never up
+            # front for the whole list: allows_request() may flip an OPEN
+            # breaker to HALF_OPEN and hand this call its one probe slot,
+            # which MUST be followed by record_success/record_failure below
+            # — a member the walk never reaches (earlier endpoint served,
+            # deadline broke the loop) must not be flipped speculatively
+            if not member.health.allows_request():
+                continue
             try:
                 result = op(member)
             except Exception:
@@ -229,11 +231,46 @@ class FailoverTokenClient(TokenService):
         ]
 
     def ping(self, namespace: Optional[str] = None) -> bool:
-        result = self._call(
-            lambda m: m.client.ping(namespace) or None,
-            failed=lambda r: r is None,
-        )
-        return bool(result)
+        """True when some endpoint's server answers the ping affirmatively.
+
+        Only transport-level failure — no reply at all, or a raised
+        exception — charges an endpoint's breaker. A live server that
+        answers the ping negatively (e.g. an unknown namespace) is
+        reachable, and repeated health pings must not evict it from
+        rotation; its answer closes the breaker and is returned as-is."""
+        answered_no = False
+        deadline = _clock.now_ms() + self.deadline_ms
+        for i, member in enumerate(self._members):
+            if not member.health.allows_request():
+                continue
+            try:
+                ping_ex = getattr(member.client, "ping_ex", None)
+                if ping_ex is not None:
+                    # None = transport failure, bool = the server's answer
+                    reply = ping_ex(namespace)
+                else:
+                    # bool-only ping (TokenClient-compatible stubs): False
+                    # means no response arrived at all
+                    reply = True if member.client.ping(namespace) else None
+            except Exception:
+                record_log.exception(
+                    "token endpoint %s raised on ping; treating as failure",
+                    member.endpoint,
+                )
+                reply = None
+            if reply is None:
+                member.health.record_failure()
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
+            member.health.record_success()  # reachable: the probe is answered
+            if reply:
+                self._note_served(i)
+                return True
+            answered_no = True
+        if not answered_no:
+            self._note_exhausted()
+        return False
 
     # -- lifecycle / introspection ------------------------------------------
     def close(self) -> None:

@@ -72,14 +72,22 @@ class _Throttle:
 class LocalFallbackPolicy:
     """flow_id → FallbackRule table with a default action for unlisted ids.
 
+    A THROTTLE default throttles unlisted ids against ``default_count`` /
+    ``default_max_queueing_time_ms`` (the zero default admits nothing —
+    still a resolved BLOCKED verdict, never an exception).
+
     Thread-safe; shared by every request the failover client degrades."""
 
     def __init__(
         self,
         rules: Iterable[FallbackRule] = (),
         default_action: FallbackAction = FallbackAction.PASS,
+        default_count: float = 0.0,
+        default_max_queueing_time_ms: int = 0,
     ):
         self.default_action = FallbackAction(default_action)
+        self.default_count = float(default_count)
+        self.default_max_queueing_time_ms = int(default_max_queueing_time_ms)
         self._lock = threading.Lock()
         self._rules: Dict[int, FallbackRule] = {}
         self._throttles: Dict[int, _Throttle] = {}
@@ -111,6 +119,16 @@ class LocalFallbackPolicy:
         if action == FallbackAction.BLOCK:
             self._count("block", passed=False)
             return TokenResult(TokenStatus.BLOCKED)
+        if rule is None:
+            # unlisted id under a THROTTLE default: synthesize a rule so the
+            # degraded hot path still resolves (against the default budget)
+            # instead of dereferencing None
+            rule = FallbackRule(
+                int(flow_id),
+                FallbackAction.THROTTLE,
+                count=self.default_count,
+                max_queueing_time_ms=self.default_max_queueing_time_ms,
+            )
         throttle = self._throttle_for(rule)
         now = _clock.now_ms()
         try:

@@ -198,17 +198,28 @@ def save_snapshot(service, directory: str, retain: int = 3) -> str:
     return path
 
 
+def _artifact_key(name: str):
+    """Numeric value of the embedded save timestamp. Lexical order would
+    misplace artifacts across a digit rollover (999 vs 1000 — real under an
+    injected ManualClock); names whose timestamp doesn't parse sort oldest
+    so they are pruned first and restored last."""
+    try:
+        return (0, int(name[len(_PREFIX):-len(_SUFFIX)]), name)
+    except ValueError:
+        return (-1, 0, name)
+
+
 def _artifacts(directory: str) -> list:
-    """Snapshot filenames in the directory, oldest → newest (the embedded
-    save timestamp orders them; same-ms ties break lexically, which is the
-    same order)."""
+    """Snapshot filenames in the directory, oldest → newest (ordered by the
+    embedded save timestamp, numerically; same-ms ties break lexically)."""
     try:
         names = os.listdir(directory)
     except OSError:
         return []
     return sorted(
-        n for n in names
-        if n.startswith(_PREFIX) and n.endswith(_SUFFIX)
+        (n for n in names
+         if n.startswith(_PREFIX) and n.endswith(_SUFFIX)),
+        key=_artifact_key,
     )
 
 

@@ -155,6 +155,24 @@ class TestEndpointHealth:
         assert snap["state"] == "CLOSED"
         assert snap["consecutiveFailures"] == 0
 
+    def test_forfeited_half_open_probe_readmits_after_grace(
+        self, manual_clock
+    ):
+        h = EndpointHealth(failure_threshold=1, backoff_base_ms=100,
+                           jitter=0.0, rand=lambda: 0.0)
+        h.record_failure()
+        manual_clock.advance(100)
+        assert h.allows_request()  # probe slot handed out
+        assert h.state == HealthState.HALF_OPEN
+        assert not h.allows_request()
+        # the probe's dispatcher died without reporting: after a
+        # backoff-length grace the slot forfeits and a fresh probe goes out
+        # instead of the breaker refusing forever
+        manual_clock.advance(100)
+        assert h.allows_request()
+        h.record_success()
+        assert h.state == HealthState.CLOSED
+
 
 class TestFailoverClient:
     def _client(self, fallback=None, **kw):
@@ -232,6 +250,27 @@ class TestFailoverClient:
         assert str(fc.active_endpoint) == "primary:1"
         assert fc._members[0].health.state == HealthState.CLOSED
 
+    def test_unprobed_standby_not_stuck_half_open(self, manual_clock):
+        # full outage opens both breakers; after recovery the first request
+        # must flip only the endpoint it actually dispatches to — a standby
+        # the walk never reaches must not be parked in HALF_OPEN (a state
+        # only record_success/record_failure can leave)
+        fc = self._client()  # threshold 2, backoff 50ms
+        for m in fc._members:
+            m.client.alive = False
+        fc.request_token(1)
+        fc.request_token(1)
+        assert all(m.health.state == HealthState.OPEN for m in fc._members)
+        for m in fc._members:
+            m.client.alive = True
+        manual_clock.advance(60_000)  # both backoffs elapsed
+        assert fc.request_token(1).remaining == 1  # primary probe serves
+        assert fc._members[1].health.state == HealthState.OPEN
+        # and the standby still takes over the moment the primary dies again
+        fc._members[0].client.alive = False
+        r = fc.request_token(1)
+        assert r.ok and r.remaining == 2
+
     def test_raising_client_treated_as_failure(self):
         class Raising(StubClient):
             def request_token(self, *a, **k):
@@ -258,6 +297,31 @@ class TestFailoverClient:
         snap = fc.health_snapshot()
         assert [e["endpoint"] for e in snap] == ["primary:1", "standby:2"]
         assert all(e["state"] == "OPEN" for e in snap)
+
+    def test_ping_false_answer_does_not_charge_breaker(self):
+        class NsRejecting(StubClient):
+            def ping_ex(self, namespace=None):
+                self.calls += 1
+                if not self.alive:
+                    return None
+                return False  # reachable, but rejects the namespace
+
+        fc = FailoverTokenClient(
+            [("p", 1)], client_factory=NsRejecting, failure_threshold=1
+        )
+        for _ in range(5):
+            assert fc.ping("unknown") is False
+        health = fc._members[0].health
+        assert health.state == HealthState.CLOSED
+        assert health.consecutive_failures == 0
+
+    def test_ping_transport_failure_still_charges_breaker(self):
+        fc = self._client()  # threshold 2
+        for m in fc._members:
+            m.client.alive = False
+        assert fc.ping() is False
+        assert fc.ping() is False
+        assert all(m.health.state == HealthState.OPEN for m in fc._members)
 
     def test_close_closes_every_member(self):
         fc = self._client()
@@ -319,6 +383,22 @@ class TestLocalFallbackPolicy:
         totals = ha_metrics().fallback_totals()
         assert totals["pass"] == 1 and totals["block"] == 1
 
+    def test_default_throttle_unlisted_id_uses_default_budget(
+        self, manual_clock
+    ):
+        policy = LocalFallbackPolicy(
+            default_action=FallbackAction.THROTTLE, default_count=2.0
+        )
+        verdicts = [policy.decide(77).status for _ in range(4)]
+        assert verdicts.count(TokenStatus.OK) == 2
+        assert verdicts.count(TokenStatus.BLOCKED) == 2
+
+    def test_default_throttle_zero_budget_blocks_never_raises(self):
+        policy = LocalFallbackPolicy(default_action=FallbackAction.THROTTLE)
+        assert policy.decide(5).status == TokenStatus.BLOCKED
+        status, _, _ = policy.decide_batch_arrays(np.array([5, 6], np.int64))
+        assert status.tolist() == [int(TokenStatus.BLOCKED)] * 2
+
     def test_reload_resets_throttle_state(self, manual_clock):
         rule = FallbackRule(3, FallbackAction.THROTTLE, count=2.0)
         policy = LocalFallbackPolicy([rule])
@@ -370,6 +450,22 @@ class TestClientReconnectBackoff:
             assert client.consecutive_failures == 0
             assert client._reconnect_delay_s == 0.0
             client.close()
+        finally:
+            server.stop()
+
+    def test_ping_ex_separates_transport_failure_from_answer(self):
+        dead = TokenClient("127.0.0.1", 1)  # nothing listens on port 1
+        assert dead.ping_ex() is None
+        assert dead.ping() is False
+        dead.close()
+        svc = DefaultTokenService(CFG)
+        server = TokenServer(svc, port=0)
+        server.start()
+        try:
+            live = TokenClient("127.0.0.1", server.port, timeout_ms=2000)
+            assert live.ping_ex() is True
+            assert live.ping() is True
+            live.close()
         finally:
             server.stop()
 

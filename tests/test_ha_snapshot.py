@@ -158,6 +158,25 @@ class TestArtifactDirectory:
         assert os.path.basename(paths[-1]) in kept
         assert os.path.basename(paths[0]) not in kept
 
+    def test_artifact_order_is_numeric_across_digit_rollover(
+        self, tmp_path, manual_clock
+    ):
+        # lexically "sentinel-snapshot-999.json" sorts AFTER "...-1000.json";
+        # ordering must follow the numeric timestamp so load_latest restores
+        # the newest artifact and pruning drops the oldest
+        donor = _warm_service(manual_clock)
+        manual_clock.set_ms(999)
+        save_snapshot(donor, str(tmp_path))
+        manual_clock.set_ms(1000)
+        save_snapshot(donor, str(tmp_path))
+        assert load_latest(str(tmp_path))["saved_at_ms"] == 1000
+        manual_clock.set_ms(1001)
+        save_snapshot(donor, str(tmp_path), retain=2)
+        kept = sorted(os.listdir(tmp_path))
+        assert "sentinel-snapshot-999.json" not in kept
+        assert "sentinel-snapshot-1000.json" in kept
+        assert "sentinel-snapshot-1001.json" in kept
+
     def test_corrupt_newest_falls_back_to_previous(
         self, tmp_path, manual_clock
     ):

@@ -83,7 +83,8 @@ def _pcts(rtt_ms: np.ndarray) -> dict:
 
 def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                  serve_buckets=(4096, 16384), native: bool = True,
-                 port: int = 0, n_dispatchers: int = 2):
+                 port: int = 0, n_dispatchers: int = 2,
+                 fuse_depth: int = 4):
     """Service (100k rules — the headline's problem size) + front door."""
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
@@ -127,6 +128,7 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                 server = NativeTokenServer(
                     service, host="127.0.0.1", port=port,
                     max_batch=max_batch, n_dispatchers=n_dispatchers,
+                    fuse_depth=fuse_depth,
                 )
                 front_door = "native-epoll"
         except Exception:
@@ -162,6 +164,7 @@ def run_closed(port: int, clients: int = 4, batch: int = 2048,
     client_wall = max((d["wall_s"] for d in docs), default=wall)
     return {
         "verdicts_per_sec": round(ok / client_wall) if docs else 0,
+        "wall_s": round(client_wall, 3),
         "verdicts_ok": ok,
         "errors": err,
         "clients": len(docs),
@@ -349,6 +352,32 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
             stage_metrics.reset()
             c = run_closed(server.port, n_flows=n_flows, **kw)
             c["stage_latency_ms"] = stage_metrics.stage_snapshot()
+            # frame-fusion evidence + per-lane occupancy: what fraction of
+            # the measurement window each lane spent busy (sum of its stage
+            # times over wall time; reply occupancy averages over the
+            # n_dispatchers reply threads). Occupancy ≈ 1.0 marks the
+            # pipeline's bottleneck lane.
+            wall_ms = max(c.get("wall_s") or 0.0, 1e-9) * 1e3
+            stages = c["stage_latency_ms"]
+
+            def _busy(*names, lanes=1):
+                total = sum(
+                    (stages.get(nm) or {}).get("sum") or 0.0
+                    for nm in names
+                )
+                return round(min(total / (wall_ms * lanes), 1.0), 4)
+
+            c["fusion"] = {
+                "fused_frames_total": stage_metrics.fused_frames_total,
+                "fused_depth": stage_metrics.fused_depth.snapshot(),
+                "lane_occupancy": {
+                    "intake": _busy("intake_ms"),
+                    "device": _busy("dispatch_ms"),
+                    "reply": _busy(
+                        "decide_ms", "write_ms", lanes=n_dispatchers
+                    ),
+                },
+            }
             if closed is None or c["verdicts_per_sec"] > \
                     closed["verdicts_per_sec"]:
                 if closed is not None:
@@ -391,6 +420,10 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
         "n_dispatchers": (
             n_dispatchers if front_door == "native-epoll" else None
         ),
+        # configured device-lane fusion budget (pulls per dispatch); the
+        # per-candidate closed_loop.fusion block records the depths the
+        # token service's ladder ACTUALLY fused under that load
+        "fusion_depth": getattr(server, "fuse_depth", None),
         "front_door": front_door,
         "verdicts_per_sec": closed["verdicts_per_sec"],
         "p50_ms": closed["p50_ms"],

@@ -196,6 +196,44 @@ def encode_batch_response(xid: int, status, remaining, wait_ms) -> bytes:
     )
 
 
+def encode_batch_responses(xids, counts, status, remaining, wait_ms) -> bytes:
+    """F BATCH_FLOW response frames in ONE buffer — the vectorized reply
+    path. ``counts[f]`` rows belong to frame f (``sum(counts)`` must equal
+    ``len(status)``); the verdict arrays are concatenated in frame order.
+    The row conversion is a single numpy pass over ALL frames' verdicts;
+    only the 9-byte frame headers are packed in a small F-loop, so the
+    per-row Python cost no longer scales with frame count."""
+    xids = np.asarray(xids)
+    counts = np.asarray(counts, dtype=np.int64)
+    status = np.asarray(status, dtype=np.int8)
+    total = int(counts.sum())
+    if total != status.shape[0]:
+        raise ValueError(
+            f"frame counts sum to {total}, got {status.shape[0]} verdicts"
+        )
+    rows = np.empty(total, dtype=BATCH_RSP_DTYPE)
+    rows["status"] = status
+    rows["remaining"] = np.asarray(remaining, dtype=np.int32)
+    rows["wait_ms"] = np.asarray(wait_ms, dtype=np.int32)
+    blob = rows.tobytes()
+    isz = BATCH_RSP_DTYPE.itemsize
+    head = _HEAD.size + _BATCH_N.size
+    out = bytearray(xids.shape[0] * (_LEN.size + head) + total * isz)
+    mv = memoryview(out)
+    off = 0
+    row0 = 0
+    for f in range(xids.shape[0]):
+        n = int(counts[f])
+        _LEN.pack_into(out, off, head + n * isz)
+        _HEAD.pack_into(out, off + _LEN.size, int(xids[f]), MsgType.BATCH_FLOW)
+        _BATCH_N.pack_into(out, off + _LEN.size + _HEAD.size, n)
+        start = off + _LEN.size + head
+        mv[start : start + n * isz] = blob[row0 * isz : (row0 + n) * isz]
+        off = start + n * isz
+        row0 += n
+    return bytes(out)
+
+
 def decode_batch_response(payload: bytes):
     """BATCH_FLOW response payload → (xid, status int8[N], remaining int32[N],
     wait_ms int32[N])."""

@@ -420,6 +420,10 @@ class _LoopWorker:
         async def write_out(indices) -> None:
             t_write = time.perf_counter()
             writers_to_drain = set()
+            # batch frames group per writer: ONE vectorized multi-frame
+            # encode (encode_batch_responses) and one socket write per
+            # client instead of one of each per frame
+            grouped: dict = {}  # writer → (xids, counts, verdict slices)
             for i in indices:
                 item, writer, _t_enq = batch[i]
                 try:
@@ -432,12 +436,10 @@ class _LoopWorker:
                                 np.zeros(k, np.int32),
                                 np.zeros(k, np.int32),
                             )
-                        status, remaining, wait = sliced
-                        writer.write(
-                            P.encode_batch_response(
-                                item.xid, status, remaining, wait
-                            )
-                        )
+                        g = grouped.setdefault(writer, ([], [], []))
+                        g[0].append(item.xid)
+                        g[1].append(len(sliced[0]))
+                        g[2].append(sliced)
                     else:
                         st, remaining, wait, token_id = results.get(
                             i, (int(TokenStatus.FAIL), 0, 0, 0)
@@ -450,6 +452,19 @@ class _LoopWorker:
                                 )
                             )
                         )
+                        writers_to_drain.add(writer)
+                except Exception:
+                    pass
+            for writer, (xids, counts, slices) in grouped.items():
+                try:
+                    writer.write(
+                        P.encode_batch_responses(
+                            xids, counts,
+                            np.concatenate([s[0] for s in slices]),
+                            np.concatenate([s[1] for s in slices]),
+                            np.concatenate([s[2] for s in slices]),
+                        )
+                    )
                     writers_to_drain.add(writer)
                 except Exception:
                     pass

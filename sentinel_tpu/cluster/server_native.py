@@ -17,15 +17,26 @@ as in the asyncio server. API-compatible with ``TokenServer`` (start/stop/
 port/connections/tuning_kwargs) so ``apply_cluster_mode`` and the benches
 can switch via ``native=True``.
 
-Dispatcher concurrency: ``n_dispatchers`` threads run the wait→step→submit
-cycle. The service lock serializes only device dispatch, so with 2 threads
-one batch's host prep and verdict materialization overlap the other's
-device step (the same overlap the asyncio server got from ``to_thread``).
+Serving pipeline: three decoupled lanes with bounded handoff queues,
+instead of one thread doing wait→step→submit in series. The **intake
+lane** pulls decoded frames from the C++ door and hands copies to the
+**device lane**, which drains everything queued (bounded by
+``fuse_depth`` pulls of host prep), concatenates it, and issues ONE
+dispatch — the token service's fusion ladder then folds full engine
+frames into a single chained ``lax.scan`` device step, so the fixed
+per-dispatch overhead (20–50ms/bucket in BENCH_r05) is paid once per
+fused group. ``n_dispatchers`` **reply lanes** block on the async
+verdicts, slice them back per pull, and submit — so host-side prep and
+reply encoding overlap device time instead of serializing behind it.
+Fusion depth adapts to load by construction: an idle queue yields
+single-frame dispatches (no added latency), a backed-up queue yields
+deep fused steps (max amortization).
 """
 
 from __future__ import annotations
 
 import os
+import queue
 import threading
 import time
 from typing import List, Optional
@@ -60,6 +71,8 @@ class NativeTokenServer:
         port: int = 18730,
         max_batch: int = 16384,
         n_dispatchers: int = 2,
+        fuse_depth: int = 4,
+        intake_timeout_ms: int = 20,
         idle_ttl_s: Optional[float] = 600.0,
         arena_cap: int = 65536,
         profile_dir: Optional[str] = None,
@@ -75,11 +88,23 @@ class NativeTokenServer:
         self.port = port
         self.max_batch = max_batch
         self.n_dispatchers = max(1, int(n_dispatchers))
+        # fuse_depth bounds how many queued intake pulls the device lane
+        # folds into one dispatch (each pull is itself up to max_batch
+        # rows) — the host-prep budget of the adaptive frame fusion
+        self.fuse_depth = max(1, int(fuse_depth))
+        # intake poll granularity only — the C++ door wakes the waiter the
+        # moment the first frame queues, so this never delays a ready frame
+        self.intake_timeout_ms = max(1, int(intake_timeout_ms))
         self.idle_ttl_s = idle_ttl_s
         self.arena_cap = arena_cap
         self._door = None
         self._threads: List[threading.Thread] = []
+        self._lane_threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        self._intake_stop = threading.Event()
+        self._abandon = threading.Event()  # give up lane drain (dead lane)
+        self._dispatch_q: Optional[queue.Queue] = None
+        self._reply_q: Optional[queue.Queue] = None
         notify = getattr(service, "connected_count_changed", None)
         self.connections = ConnectionManager(on_count_changed=notify)
         self._addr_by_conn = {}  # (fd, gen) → address
@@ -102,6 +127,8 @@ class NativeTokenServer:
         return dict(
             max_batch=self.max_batch,
             n_dispatchers=self.n_dispatchers,
+            fuse_depth=self.fuse_depth,
+            intake_timeout_ms=self.intake_timeout_ms,
             idle_ttl_s=self.idle_ttl_s,
             arena_cap=self.arena_cap,
             profile_dir=self.profile_dir,
@@ -126,19 +153,39 @@ class NativeTokenServer:
         if reopen is not None:
             reopen()
         self._stop.clear()
+        self._intake_stop.clear()
+        self._abandon.clear()
+        # bounded handoffs: dispatch queue depth caps how far intake runs
+        # ahead of the device (its size IS the fusion opportunity); reply
+        # queue depth caps device-step in-flight count
+        self._dispatch_q = queue.Queue(maxsize=max(2, 2 * self.fuse_depth))
+        self._reply_q = queue.Queue(maxsize=max(2, 2 * self.n_dispatchers))
         self._door = self._Frontdoor(
             self.host, self.port, arena_cap=self.arena_cap
         )
         self.port = self._door.port
         if self.idle_ttl_s:
             self._door.set_idle_ttl(int(self.idle_ttl_s * 1000))
-        for i in range(self.n_dispatchers):
-            t = threading.Thread(
-                target=self._dispatch_loop,
-                name=f"sentinel-native-dispatch-{i}", daemon=True,
+        lanes = [
+            threading.Thread(
+                target=self._intake_loop, name="sentinel-native-intake",
+                daemon=True,
+            ),
+            threading.Thread(
+                target=self._device_loop, name="sentinel-native-device",
+                daemon=True,
+            ),
+        ]
+        lanes.extend(
+            threading.Thread(
+                target=self._reply_loop,
+                name=f"sentinel-native-reply-{i}", daemon=True,
             )
+            for i in range(self.n_dispatchers)
+        )
+        for t in lanes:
             t.start()
-            self._threads.append(t)
+        self._lane_threads = lanes
         t = threading.Thread(
             target=self._control_loop, name="sentinel-native-control",
             daemon=True,
@@ -157,6 +204,12 @@ class NativeTokenServer:
         self._gauge_fns = {
             "queue_depth": lambda: float(
                 (self.stats() or {}).get("pending_frames", 0)
+            ),
+            "dispatch_lane_depth": lambda: float(
+                self._dispatch_q.qsize() if self._dispatch_q else 0
+            ),
+            "reply_lane_depth": lambda: float(
+                self._reply_q.qsize() if self._reply_q else 0
             ),
             "connections": lambda: sum(
                 len(addrs) for addrs in self.connections.snapshot().values()
@@ -197,11 +250,25 @@ class NativeTokenServer:
         for name, fn in self._gauge_fns.items():
             _SM.unregister_gauge(name, fn)
         self._gauge_fns = {}
+        # drain shutdown, in lane order: stop intake first so every frame
+        # already pulled still gets answered, then let the sentinel flow
+        # intake → device → reply before the door closes. A wedged lane
+        # can't deadlock stop(): after the join timeout we flip _abandon,
+        # which turns every blocking lane handoff into a drop.
+        self._intake_stop.set()
+        for t in self._lane_threads:
+            t.join(timeout=10)
+            if t.is_alive():
+                self._abandon.set()
+                t.join(timeout=2)
+        self._lane_threads = []
         self._stop.set()
         self._door.stop()
         for t in self._threads:
             t.join(timeout=5)
         self._threads = []
+        self._dispatch_q = None
+        self._reply_q = None
         self._door = None
         # the door closed every socket without emitting CTRL_CLOSE (the
         # control thread is already down), so deregister the clients here —
@@ -216,47 +283,156 @@ class NativeTokenServer:
             close()
 
     # -- data plane ---------------------------------------------------------
-    def _dispatch_loop(self) -> None:
+    _SENTINEL = object()  # lane shutdown marker, flows intake→device→reply
+
+    def _lane_put(self, q: queue.Queue, item) -> bool:
+        """Blocking bounded-queue handoff (the lanes' backpressure). Never
+        deadlocks shutdown: once ``_abandon`` is set (a lane died and its
+        join timed out) the put gives up and drops instead."""
+        while True:
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                if self._abandon.is_set():
+                    return False
+
+    def _intake_loop(self) -> None:
+        """Lane 1: pull decoded frames from the C++ door, hand copies to the
+        device lane. The door wakes ``wait_batch`` the moment the first
+        frame queues — ``intake_timeout_ms`` is only the shutdown-poll
+        granularity, never a batching stall."""
         door = self._door
-        service = self.service
-        while not self._stop.is_set():
+        q = self._dispatch_q
+        while not self._intake_stop.is_set():
             try:
                 # max_batch bounds one pull (clamped to >= one max frame);
-                # the remainder stays queued for the other dispatchers
-                got = door.wait_batch(timeout_ms=100, max_n=self.max_batch)
-            except Exception:
-                if self._stop.is_set():
-                    return
-                raise
-            if got is None:
-                continue
-            ids, counts, prios, frames = got
-            _SM.batch_size.record(len(ids))
-            t_decide = time.perf_counter()
-            try:
-                # pulls larger than the engine batch size pipeline
-                # internally: request_batch_arrays dispatches ALL chunk
-                # steps before blocking on the first verdict (the
-                # dispatch/materialize split in DefaultTokenService);
-                # across threads, another dispatcher's step overlaps this
-                # one's materialization (the service lock covers dispatch
-                # only)
-                status, remaining, wait = service.request_batch_arrays(
-                    ids, counts, prios
+                # the remainder stays queued for the next cycle
+                got = door.wait_batch(
+                    timeout_ms=self.intake_timeout_ms, max_n=self.max_batch
                 )
             except Exception:
-                record_log.exception("device step failed; failing batch")
-                n = len(ids)
+                if self._stop.is_set() or self._intake_stop.is_set():
+                    break
+                record_log.exception("native wait_batch failed; intake down")
+                break
+            if got is None:
+                continue
+            t0 = time.perf_counter()
+            ids, counts, prios, frames = got
+            # wait_batch returns views into this thread's reused buffers —
+            # valid only until OUR next call — so the lane handoff copies
+            pull = (
+                np.array(ids), np.array(counts), np.array(prios),
+                tuple(np.array(f) for f in frames),
+            )
+            _SM.batch_size.record(len(ids))
+            self._lane_put(q, pull)
+            _SM.intake_ms.record((time.perf_counter() - t0) * 1e3)
+        self._lane_put(q, self._SENTINEL)
+
+    def _device_loop(self) -> None:
+        """Lane 2: the only thread issuing device work — dispatch order IS
+        state-chain order. Drains every queued pull (bounded by
+        ``fuse_depth``), concatenates, and issues ONE dispatch; the token
+        service's fusion ladder folds the full engine frames inside into a
+        single chained scan step. Dispatch returns before the device
+        finishes (async), so this lane loops back to prep the next group
+        while the reply lanes block on the verdicts."""
+        q = self._dispatch_q
+        service = self.service
+        dispatch = getattr(service, "dispatch_batch_arrays", None)
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    break
+                pulls = [item]
+                # adaptive frame fusion: everything already queued joins
+                # this dispatch. Idle queue → depth 1 (no added latency);
+                # backlog → deep fused step (max amortization).
+                stop_after = False
+                while len(pulls) < self.fuse_depth:
+                    try:
+                        nxt = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is self._SENTINEL:
+                        stop_after = True  # intake is done; finish group
+                        break
+                    pulls.append(nxt)
+                if len(pulls) == 1:
+                    ids, counts, prios = item[0], item[1], item[2]
+                else:
+                    ids = np.concatenate([p[0] for p in pulls])
+                    counts = np.concatenate([p[1] for p in pulls])
+                    prios = np.concatenate([p[2] for p in pulls])
+                lengths = [len(p[0]) for p in pulls]
+                t0 = time.perf_counter()
+                try:
+                    if dispatch is not None:
+                        mat = dispatch(ids, counts, prios)
+                    else:
+                        # SPI implementations without the dispatch/
+                        # materialize split run synchronously here
+                        res = service.request_batch_arrays(
+                            ids, counts, prios
+                        )
+                        mat = lambda res=res: res  # noqa: E731
+                except Exception:
+                    record_log.exception("device step failed; failing batch")
+                    n = len(ids)
+                    mat = lambda n=n: (  # noqa: E731
+                        np.full(n, int(TokenStatus.FAIL), np.int8),
+                        np.zeros(n, np.int32),
+                        np.zeros(n, np.int32),
+                    )
+                _SM.dispatch_ms.record((time.perf_counter() - t0) * 1e3)
+                self._lane_put(self._reply_q, (pulls, lengths, mat))
+                if stop_after:
+                    break
+        finally:
+            # always propagate shutdown, even if this lane died — the
+            # reply lanes must not block forever on an empty queue
+            self._lane_put(self._reply_q, self._SENTINEL)
+
+    def _reply_loop(self) -> None:
+        """Lane 3 (×``n_dispatchers``): block on the async verdicts, slice
+        them back per intake pull, submit to the door. While one reply
+        thread waits on device results the device lane keeps dispatching,
+        and a second reply thread overlaps the next group's encode."""
+        door = self._door
+        rq = self._reply_q
+        while True:
+            item = rq.get()
+            if item is self._SENTINEL:
+                rq.put(item)  # release sibling reply lanes
+                return
+            pulls, lengths, mat = item
+            t0 = time.perf_counter()
+            try:
+                status, remaining, wait = mat()
+            except Exception:
+                record_log.exception("materialize failed; failing batch")
+                n = sum(lengths)
                 status = np.full(n, int(TokenStatus.FAIL), np.int8)
                 remaining = np.zeros(n, np.int32)
                 wait = np.zeros(n, np.int32)
             t_write = time.perf_counter()
-            _SM.decide_ms.record((t_write - t_decide) * 1e3)
-            try:
-                door.submit(frames, status, remaining, wait)
-            except Exception:
-                if not self._stop.is_set():
-                    record_log.exception("native submit failed")
+            _SM.decide_ms.record((t_write - t0) * 1e3)
+            off = 0
+            for pull, ln in zip(pulls, lengths):
+                try:
+                    door.submit(
+                        pull[3],
+                        status[off : off + ln],
+                        remaining[off : off + ln],
+                        wait[off : off + ln],
+                    )
+                except Exception:
+                    if not self._stop.is_set():
+                        record_log.exception("native submit failed")
+                off += ln
             _SM.write_ms.record((time.perf_counter() - t_write) * 1e3)
 
     # -- control plane ------------------------------------------------------

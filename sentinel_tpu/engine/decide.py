@@ -477,3 +477,39 @@ def decide_donating(config: EngineConfig, grouped: bool = False,
         ),
         donate_argnums=(0,),
     )
+
+
+def decide_fused_donating(config: EngineConfig, depth: int,
+                          grouped: bool = False, uniform: bool = False):
+    """A chained multi-frame step: ``lax.scan`` of :func:`_decide_core`
+    over ``depth`` stacked request frames, donating the state buffers like
+    :func:`decide_donating`.
+
+    Returns ``step(state, rules, batches, now) -> (state', verdicts)``
+    where every ``batches`` leaf is ``[depth, batch_size]``-shaped (the
+    per-frame :class:`RequestBatch` leaves stacked along a new leading
+    axis) and the ``verdicts`` leaves come back ``[depth, batch_size]``
+    in the same frame order. Frame ``k`` sees exactly the state frame
+    ``k-1`` produced — the on-device equivalent of ``depth`` consecutive
+    :func:`decide_donating` calls at one shared ``now``, with the
+    per-dispatch host/RTT overhead paid once for the whole chain.
+
+    The scanned batch VARIES per iteration, so XLA cannot hoist the
+    request-dependent chains out of the loop body (the failure mode
+    ``benchmarks/step_ablation.py`` documents for loop-constant operands).
+    """
+    if depth < 1:
+        raise ValueError(f"fused depth must be >= 1, got {depth}")
+    core = partial(
+        _decide_core, config, axis_name=None, grouped=grouped,
+        uniform=uniform,
+    )
+
+    def fused(state, rules, batches, now):
+        def body(st, batch):
+            st, verdicts = core(st, rules, batch, now)
+            return st, verdicts
+
+        return jax.lax.scan(body, state, batches, length=depth)
+
+    return jax.jit(fused, donate_argnums=(0,))

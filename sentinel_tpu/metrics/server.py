@@ -75,7 +75,10 @@ class ServerMetrics:
     """All ``sentinel_server_*`` state for this process's token server(s)."""
 
     # gauges every scrape shows even before a server registers a live reader
-    _GAUGE_NAMES = ("queue_depth", "inflight_batches", "connections")
+    _GAUGE_NAMES = (
+        "queue_depth", "inflight_batches", "connections",
+        "dispatch_lane_depth", "reply_lane_depth",
+    )
 
     def __init__(self):
         # stage histograms, all in milliseconds except batch_size (requests).
@@ -86,11 +89,38 @@ class ServerMetrics:
         self.batch_size = LatencyHistogram(
             bounds=[float(1 << i) for i in range(17)]  # 1..65536, ×2 ladder
         )
+        # per-lane stage histograms for the staged native pipeline:
+        # intake_ms = wait_batch pull → handoff enqueue (decode copy + prep);
+        # dispatch_ms = drain of the handoff queue → device dispatch issued
+        # (host prep + async enqueue; the device step itself is decide_ms).
+        self.intake_ms = LatencyHistogram(lo=0.001, hi=10_000.0)
+        self.dispatch_ms = LatencyHistogram(lo=0.001, hi=10_000.0)
+        # fused multi-frame dispatch: how many engine-batch frames each
+        # chained device step folded together (depth 1 = unfused)
+        self.fused_depth = LatencyHistogram(
+            bounds=[float(1 << i) for i in range(7)]  # 1..64, ×2 ladder
+        )
+        self._fused_frames = 0
+        self._fused_lock = threading.Lock()
         self._verdicts: Dict[Tuple[str, str], int] = {}
         self._verdict_lock = threading.Lock()
         self._rate = _RateWindow()
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._gauge_lock = threading.Lock()
+
+    # -- fused dispatch counters --------------------------------------------
+    def record_fused(self, depth: int) -> None:
+        """One fused device dispatch folding ``depth`` engine-batch frames
+        into a single chained step (records the amortization the serving
+        path achieved; depth 1 would mean no fusion and is not recorded)."""
+        with self._fused_lock:
+            self._fused_frames += int(depth)
+        self.fused_depth.record(float(depth))
+
+    @property
+    def fused_frames_total(self) -> int:
+        with self._fused_lock:
+            return self._fused_frames
 
     # -- verdict counters ---------------------------------------------------
     def count_verdict(self, verdict: str, namespace: str, n: int = 1) -> None:
@@ -183,11 +213,15 @@ class ServerMetrics:
         return {
             "verdicts": verdicts,
             "verdictsPerSec": self._rate.rate(),
+            "fusedFramesTotal": self.fused_frames_total,
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
                 "write_ms": self.write_ms.snapshot(),
                 "batch_size": self.batch_size.snapshot(),
+                "intake_ms": self.intake_ms.snapshot(),
+                "dispatch_ms": self.dispatch_ms.snapshot(),
+                "fused_depth": self.fused_depth.snapshot(),
             },
             "gauges": self._gauge_values(),
         }
@@ -200,12 +234,19 @@ class ServerMetrics:
             ("decide_ms", self.decide_ms),
             ("write_ms", self.write_ms),
             ("batch_size", self.batch_size),
+            ("intake_ms", self.intake_ms),
+            ("dispatch_ms", self.dispatch_ms),
+            ("fused_depth", self.fused_depth),
         ):
             snap = hist.snapshot()
             out[name] = {
                 "p50": snap["p50"], "p99": snap["p99"],
                 "count": snap["count"],
+                # per-lane busy time over the snapshot window — the serve
+                # bench derives lane occupancy from sum/wall
+                "sum": round(snap["sum"], 3),
             }
+        out["fused_frames_total"] = self.fused_frames_total
         return out
 
     def render(self) -> str:
@@ -238,11 +279,23 @@ class ServerMetrics:
         )
         lines.append("# TYPE sentinel_server_verdicts_per_sec gauge")
         lines.append(f"sentinel_server_verdicts_per_sec {self._rate.rate():g}")
+        lines.append(
+            "# HELP sentinel_server_fused_frames_total Engine-batch frames "
+            "folded into chained multi-frame device dispatches (cumulative)."
+        )
+        lines.append("# TYPE sentinel_server_fused_frames_total counter")
+        lines.append(
+            f"sentinel_server_fused_frames_total {self.fused_frames_total}"
+        )
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
             ("inflight_batches", "Batches currently in the device pipeline."),
             ("connections", "Open client connections."),
+            ("dispatch_lane_depth",
+             "Decoded pulls queued between the intake and device lanes."),
+            ("reply_lane_depth",
+             "Dispatched batches queued between the device and reply lanes."),
         ):
             lines.append(f"# HELP sentinel_server_{name} {help_text}")
             lines.append(f"# TYPE sentinel_server_{name} gauge")
@@ -260,6 +313,15 @@ class ServerMetrics:
             ("sentinel_server_batch_size",
              "Requests per device batch.",
              self.batch_size),
+            ("sentinel_server_intake_ms",
+             "Intake lane: front-door pull to handoff enqueue (ms).",
+             self.intake_ms),
+            ("sentinel_server_dispatch_ms",
+             "Device lane: handoff drain to device dispatch issued (ms).",
+             self.dispatch_ms),
+            ("sentinel_server_fused_depth",
+             "Engine-batch frames per fused device dispatch.",
+             self.fused_depth),
         ):
             lines.append(hist.render_prometheus(name, help_text))
         return "\n".join(lines)
@@ -272,6 +334,11 @@ class ServerMetrics:
         self.decide_ms.reset()
         self.write_ms.reset()
         self.batch_size.reset()
+        self.intake_ms.reset()
+        self.dispatch_ms.reset()
+        self.fused_depth.reset()
+        with self._fused_lock:
+            self._fused_frames = 0
         with self._verdict_lock:
             self._verdicts.clear()
         self._rate.reset()

@@ -22,6 +22,13 @@ Kernel design (vs. the pure-XLA fallback):
 - The in-batch admission refinement is the same odd-iteration-count prefix
   loop as the fallback (subset-of-greedy guarantee, ``engine/decide.py``),
   with the [N, N] same-key mask built in VMEM (N is capped so it fits).
+
+Backend selection: off-TPU this kernel runs in interpret mode and BENCH_r05
+measured it ~50× slower than the XLA path (76.7ms vs 1.54ms per step), so
+``ParamConfig(impl="auto")`` (the default) never picks it there; on TPU the
+two are micro-probed once per process and the faster wins. See
+``engine.param.resolve_param_impl`` — pin explicitly with ``impl=`` or the
+``SENTINEL_PARAM_IMPL`` env var.
 """
 
 from __future__ import annotations

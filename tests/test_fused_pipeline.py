@@ -1,0 +1,270 @@
+"""Fused multi-frame dispatch + three-lane native pipeline (PR 3).
+
+Service layer: fused ``lax.scan`` dispatch must be bit-identical to the
+per-frame path, the fusion ladder must adapt its depth to burst size, and
+the prep cache must serve repeated hot vectors. Transport layer: the
+three-lane native server must answer every xid exactly once through a
+drain shutdown, and a lone frame must never sleep out the intake timeout.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.metrics.server import server_metrics
+
+G = ThresholdMode.GLOBAL
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+CAP = CFG.batch_size
+_SM = server_metrics()
+
+
+def _rules(n=8, count=50.0):
+    return [
+        ClusterFlowRule(flow_id=i, count=count, mode=G)
+        for i in range(1, n + 1)
+    ]
+
+
+def _traffic(n, seed=0, mixed=False):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, 10, size=n).astype(np.int64)  # id 9 has no rule
+    acq = (
+        rng.integers(1, 3, size=n).astype(np.int32)
+        if mixed else np.ones(n, np.int32)
+    )
+    pr = np.zeros(n, bool)
+    return ids, acq, pr
+
+
+class TestFusedDispatch:
+    """Fused-frame results must be indistinguishable from per-frame."""
+
+    @pytest.mark.parametrize("mixed", [False, True])
+    def test_fused_bit_identical_to_per_frame(self, manual_clock, mixed):
+        svc_f = DefaultTokenService(CFG)  # default ladder (8, 4, 2)
+        svc_p = DefaultTokenService(CFG, fuse_depths=())  # per-frame
+        for svc in (svc_f, svc_p):
+            svc.load_rules(_rules())
+        # 6 full frames (fused as scan(4) + scan(2)) + a partial tail,
+        # repeated so later windows carry accumulated state
+        n = 6 * CAP + 37
+        for seed in range(3):
+            ids, acq, pr = _traffic(n, seed=seed, mixed=mixed)
+            out_f = svc_f.request_batch_arrays(ids, acq, pr)
+            out_p = svc_p.request_batch_arrays(ids, acq, pr)
+            for a, b, name in zip(out_f, out_p, ("status", "rem", "wait")):
+                np.testing.assert_array_equal(a, b, err_msg=name)
+            manual_clock.sleep(300)
+
+    def test_fused_depth_adapts_to_burst_size(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules(_rules())
+        _SM.reset()
+        # sub-cap burst: no full frames, nothing to fuse
+        svc.request_batch_arrays(*_traffic(CAP))
+        assert _SM.fused_frames_total == 0
+        # 3 full frames: ladder (8, 4, 2) takes scan(2) + 1 plain frame
+        svc.request_batch_arrays(*_traffic(3 * CAP))
+        assert _SM.fused_frames_total == 2
+        # 13 full frames: greedy largest-fit → scan(8) + scan(4) + 1 plain
+        svc.request_batch_arrays(*_traffic(13 * CAP))
+        assert _SM.fused_frames_total == 2 + 8 + 4
+        depths = _SM.fused_depth.snapshot()
+        assert depths["count"] == 3  # three fused groups issued
+        assert depths["max"] == 8.0
+        assert _SM.render().count("sentinel_server_fused_frames_total") >= 1
+
+    def test_fusion_disabled_ladder_empty(self, manual_clock):
+        svc = DefaultTokenService(CFG, fuse_depths=())
+        svc.load_rules(_rules())
+        _SM.reset()
+        out = svc.request_batch_arrays(*_traffic(8 * CAP))
+        assert out[0].shape == (8 * CAP,)
+        assert _SM.fused_frames_total == 0
+
+    def test_prep_cache_hits_on_repeated_vector(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules(_rules())
+        ids, acq, pr = _traffic(CAP)
+        first = svc.request_batch_arrays(ids, acq, pr)
+        hits0 = svc._prep_cache.hits
+        again = svc.request_batch_arrays(ids, acq, pr)
+        assert svc._prep_cache.hits > hits0
+        # cached prep must not leak one call's verdicts into the next: the
+        # second pass consumes window budget the first pass left behind
+        assert int((first[0] == int(TokenStatus.OK)).sum()) >= int(
+            (again[0] == int(TokenStatus.OK)).sum()
+        )
+
+    def test_prep_cache_invalidated_by_rule_reload(self, manual_clock):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules(_rules(count=5.0))
+        ids = np.full(CAP, 1, np.int64)
+        out1 = svc.request_batch_arrays(ids)
+        assert int((out1[0] == int(TokenStatus.OK)).sum()) == 5
+        manual_clock.sleep(1100)
+        svc.load_rules(_rules(count=7.0))  # new lookup snapshot → new keys
+        out2 = svc.request_batch_arrays(ids)
+        assert int((out2[0] == int(TokenStatus.OK)).sum()) == 7
+
+
+# -- transport layer ---------------------------------------------------------
+
+from sentinel_tpu.cluster.server_native import (  # noqa: E402
+    NativeTokenServer,
+    native_available,
+)
+
+native_only = pytest.mark.skipif(
+    not native_available(), reason="native library not built"
+)
+
+SRV_CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=256)
+
+
+def _read_frames(sock, k, timeout=15.0):
+    """Read exactly k length-prefixed response frames."""
+    sock.settimeout(timeout)
+    buf = b""
+    frames = []
+    while len(frames) < k:
+        need = 2 if len(buf) < 2 else 2 + struct.unpack(">H", buf[:2])[0]
+        while len(buf) < need:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise AssertionError(
+                    f"connection closed after {len(frames)}/{k} frames"
+                )
+            buf += chunk
+            if len(buf) >= 2:
+                need = 2 + struct.unpack(">H", buf[:2])[0]
+        frames.append(buf[2:need])
+        buf = buf[need:]
+    return frames, buf
+
+
+@native_only
+class TestThreeLanePipeline:
+    def _server(self, **kw):
+        svc = DefaultTokenService(SRV_CFG)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=2, count=1e9, mode=G)]
+        )
+        server = NativeTokenServer(svc, port=0, idle_ttl_s=None, **kw)
+        server.start()
+        return server
+
+    def test_no_lost_or_double_answered_xids(self):
+        """Bursty pipelined enqueue: every xid answered exactly once, in
+        per-row request order, through lanes and fused dispatch alike."""
+        server = self._server(fuse_depth=4)
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            ) as s:
+                k, rows = 40, 512
+                ids = np.full(rows, 2, np.int64)
+                # one blast of K frames so lanes see a deep backlog
+                s.sendall(
+                    b"".join(
+                        P.encode_batch_request(xid, ids)
+                        for xid in range(1, k + 1)
+                    )
+                )
+                frames, rest = _read_frames(s, k)
+                assert rest == b""
+                seen = {}
+                for raw in frames:
+                    xid, status, _rem, _wait = P.decode_batch_response(raw)
+                    seen[xid] = seen.get(xid, 0) + 1
+                    assert status.shape == (rows,)
+                    assert (status == int(TokenStatus.OK)).all()
+                assert seen == {xid: 1 for xid in range(1, k + 1)}
+        finally:
+            server.stop()
+
+    def test_drain_shutdown_answers_inflight(self):
+        """stop() must drain the lanes: frames accepted before the stop
+        are answered before the door closes (no lost xids)."""
+        server = self._server(fuse_depth=4)
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            ) as s:
+                k, rows = 24, 1024
+                ids = np.full(rows, 2, np.int64)
+                s.sendall(
+                    b"".join(
+                        P.encode_batch_request(xid, ids)
+                        for xid in range(1, k + 1)
+                    )
+                )
+                # give intake a moment to pull the backlog, then stop mid-
+                # flight: the lanes drain in order before the door closes
+                time.sleep(0.15)
+                stopper = threading.Thread(target=server.stop)
+                stopper.start()
+                frames, _ = _read_frames(s, k)
+                stopper.join(timeout=30)
+                assert not stopper.is_alive()
+                xids = sorted(
+                    P.decode_batch_response(raw)[0] for raw in frames
+                )
+                assert xids == list(range(1, k + 1))
+        finally:
+            server.stop()  # idempotent
+
+    def test_single_frame_never_sleeps_out_timeout(self):
+        """The wait_batch stall regression: the door wakes the intake lane
+        the moment one frame queues, so a lone request's RTT stays far
+        below the intake timeout even when that timeout is huge."""
+        server = self._server(intake_timeout_ms=500)
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            ) as s:
+                # warm the path (first hit may pay compile/cache misses)
+                s.sendall(P.encode_batch_request(1, np.full(8, 2, np.int64)))
+                _read_frames(s, 1)
+                t0 = time.perf_counter()
+                s.sendall(P.encode_batch_request(2, np.full(8, 2, np.int64)))
+                _read_frames(s, 1)
+                rtt = time.perf_counter() - t0
+                assert rtt < 0.4, f"single-frame RTT {rtt*1e3:.1f}ms"
+        finally:
+            server.stop()
+
+    def test_fused_frames_flow_through_native_server(self):
+        """Bursty enqueue through the real socket path reaches the fusion
+        ladder (fused_frames_total advances) and still answers correctly."""
+        _SM.reset()
+        server = self._server(fuse_depth=8, n_dispatchers=2)
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            ) as s:
+                k = 24
+                rows = P.MAX_BATCH_PER_FRAME
+                ids = np.full(rows, 2, np.int64)
+                s.sendall(
+                    b"".join(
+                        P.encode_batch_request(xid, ids)
+                        for xid in range(1, k + 1)
+                    )
+                )
+                frames, _ = _read_frames(s, k)
+                assert len(frames) == k
+            # k frames × MAX_BATCH rows ≫ batch_size: the device lane's
+            # concatenated pulls must have fused full engine frames
+            assert _SM.fused_frames_total >= 4
+        finally:
+            server.stop()

@@ -188,11 +188,150 @@ def run_drill(deadline_ms: float = None, request_timeout_ms: int = 200):
     }
 
 
+def run_overload_drill(seconds: float = 2.5, probe_timeout_ms: int = 500):
+    """Saturation drill: drive an IN-PROCESS token server at 2× its
+    measured closed-loop capacity and verify the overload contract:
+
+    - ≥99% of offered frames are ANSWERED (a verdict or an explicit
+      OVERLOAD refusal — silence only for deliberately deadline-shed
+      frames, which this drill doesn't send),
+    - ``sentinel_server_shed_total`` moved (the server really shed),
+    - a concurrent ``FailoverTokenClient`` health probe NEVER evicts the
+      overloaded-but-alive server (OVERLOAD is proof of life).
+
+    Returns the artifact dict with a ``failures`` list (empty = passed).
+    """
+    import numpy as np
+
+    from benchmarks.serve_client import run_closed, run_open
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+    from sentinel_tpu.ha import FailoverTokenClient
+    from sentinel_tpu.metrics.server import server_metrics
+
+    failures = []
+    svc = DefaultTokenService(
+        EngineConfig(max_flows=64, max_namespaces=4, batch_size=256)
+    )
+    svc.load_rules(
+        [ClusterFlowRule(f, 1e9, ThresholdMode.GLOBAL) for f in range(8)]
+    )
+    # a small bounded queue + capped fusion make saturation honest: the
+    # batcher can't amortize an arbitrary backlog into one device step,
+    # and the front door answers OVERLOAD the moment the queue fills
+    server = TokenServer(
+        svc, port=0, max_queue=32, max_batch=128, max_inflight=1,
+        inline_below=0,
+    )
+    server.start()
+    sm = server_metrics()
+    probe_stats = {"probes": 0, "resolved": 0, "evicted": False}
+    stop_probe = None
+    try:
+        closed = run_closed(
+            server.port, batch=64, pipeline=4, seconds=1.0, n_flows=8,
+            seed=7,
+        )
+        capacity = closed["verdicts_ok"] / closed["wall_s"]
+        if capacity <= 0:
+            failures.append("capacity measurement produced zero verdicts")
+            capacity = 10_000.0
+
+        import threading
+
+        stop_probe = threading.Event()
+        fc = FailoverTokenClient(
+            [("127.0.0.1", server.port)], timeout_ms=probe_timeout_ms,
+            failure_threshold=3,
+        )
+
+        def probe():
+            while not stop_probe.is_set():
+                probe_stats["probes"] += 1
+                try:
+                    fc.request_token(0)
+                    probe_stats["resolved"] += 1
+                except Exception:
+                    pass
+                if fc.health_snapshot()[0]["state"] != "CLOSED":
+                    probe_stats["evicted"] = True
+                time.sleep(0.02)
+
+        pt = threading.Thread(target=probe)
+        pt.start()
+
+        # open-loop flood at 2× capacity; escalate (double) until the
+        # server demonstrably shed — a too-fast server is not a pass
+        open_doc = None
+        shed_delta = {}
+        rate = 2.0 * capacity
+        shed0 = sm.shed_totals()
+        for _attempt in range(3):
+            open_doc = run_open(
+                server.port, batch=64, rate=rate, seconds=seconds,
+                n_flows=8, seed=11, window=100_000,
+            )
+            shed1 = sm.shed_totals()
+            shed_delta = {
+                k: shed1.get(k, 0) - shed0.get(k, 0)
+                for k in set(shed0) | set(shed1)
+                if shed1.get(k, 0) - shed0.get(k, 0) > 0
+            }
+            if sum(shed_delta.values()) > 0:
+                break
+            rate *= 2.0
+        stop_probe.set()
+        pt.join(timeout=5)
+        fc.close()
+
+        sent = open_doc["frames_sent"]
+        lost = open_doc["frames_lost"]
+        answered_frac = (sent - lost) / sent if sent else 0.0
+        rtt = open_doc["rtt_ms"]
+        p99_ms = float(np.percentile(np.asarray(rtt), 99)) if rtt else None
+
+        if answered_frac < 0.99:
+            failures.append(
+                f"only {answered_frac:.4f} of offered frames answered "
+                "(contract: >= 0.99 at 2x saturation)"
+            )
+        if sum(shed_delta.values()) == 0:
+            failures.append(
+                "sentinel_server_shed_total never moved under saturation"
+            )
+        if probe_stats["evicted"]:
+            failures.append(
+                "failover probe evicted the overloaded-but-alive server"
+            )
+        if probe_stats["probes"] and not probe_stats["resolved"]:
+            failures.append("no health probe resolved during the flood")
+    finally:
+        if stop_probe is not None:
+            stop_probe.set()
+        server.stop()
+    return {
+        "capacity_vps": round(capacity),
+        "offered_rate_vps": round(rate),
+        "frames_sent": sent,
+        "frames_answered": sent - lost,
+        "answered_frac": round(answered_frac, 4),
+        "p99_ms": round(p99_ms, 2) if p99_ms is not None else None,
+        "shed_by_reason": shed_delta,
+        "admission": server.overload.snapshot(),
+        "probe": probe_stats,
+        "failures": failures,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true",
                     help="internal: run one server child")
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--skip-overload", action="store_true",
+                    help="run only the kill/failover phases")
     args = ap.parse_args()
     if args.serve:
         _serve_forever()
@@ -202,6 +341,9 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
     t0 = time.time()
     doc = run_drill(deadline_ms=args.deadline_ms)
+    if not args.skip_overload:
+        doc["overload"] = run_overload_drill()
+        doc["failures"] = doc["failures"] + doc["overload"]["failures"]
     doc["wall_s"] = round(time.time() - t0, 1)
     print(json.dumps(doc, indent=2))
     if doc["failures"]:
@@ -213,6 +355,16 @@ def main() -> None:
         f"{doc['fallback_requests']} all-down requests resolved "
         f"(blocked rate {doc['fallback_blocked_rate']:.2f})"
     )
+    if "overload" in doc:
+        ovl = doc["overload"]
+        print(
+            f"overload drill ok: {ovl['answered_frac']:.4f} answered at "
+            f"{ovl['offered_rate_vps']} vps offered "
+            f"({ovl['capacity_vps']} vps capacity), "
+            f"shed {sum(ovl['shed_by_reason'].values())} rows "
+            f"{ovl['shed_by_reason']}, p99 {ovl['p99_ms']}ms, "
+            f"probe evicted={ovl['probe']['evicted']}"
+        )
 
 
 if __name__ == "__main__":

@@ -17,10 +17,12 @@ from __future__ import annotations
 import itertools
 import random
 import socket
+import struct
 import threading
 import time
 from typing import Dict, Optional
 
+from sentinel_tpu import chaos
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
 from sentinel_tpu.core.config import SentinelConfig
@@ -184,6 +186,12 @@ class TokenClient(TokenService):
                         pending.event.set()
         except OSError:
             pass
+        except (ValueError, struct.error):
+            # corrupt/truncated server bytes (runt frame, short response):
+            # drop the connection gracefully — in-flight requests resolve
+            # via _drop_connection below, and the reader thread must never
+            # die with a traceback on hostile input
+            record_log.warning("malformed frame from server; dropping connection")
         finally:
             self._drop_connection(sock)
 
@@ -263,6 +271,10 @@ class TokenClient(TokenService):
                     xid, flow_ids[lo:hi],
                     None if counts is None else counts[lo:hi],
                     None if prios is None else prios[lo:hi],
+                    # declare the whole budget as the frame's deadline: a
+                    # deadline-aware server sheds the frame instead of
+                    # serving a verdict this client stopped waiting for
+                    deadline_ms=max(1, int(budget * 1000)),
                 )
                 if not self._send(frame):
                     return None
@@ -353,6 +365,11 @@ class TokenClient(TokenService):
         sock = self._sock
         if sock is None:
             return False
+        if chaos.ARMED:
+            if chaos.should("conn_reset"):  # RST mid-request
+                self._drop_connection(sock)
+                return False
+            data = chaos.mangle("frame_corrupt", data)  # outbound bit rot
         try:
             with self._send_lock:
                 sock.sendall(data)

@@ -44,6 +44,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from sentinel_tpu import chaos as _chaos
+
 # 2-byte big-endian length prefix caps a frame at 65535 bytes; single-request
 # messages keep the reference's 1024-byte budget, BATCH_FLOW frames use the
 # full range (~5000 requests/frame at 13 B each).
@@ -54,6 +56,14 @@ _FLOW_REQ = struct.Struct(">qib")  # flow_id, count, priority
 _FLOW_RSP = struct.Struct(">bii")  # status, remaining, wait_ms
 _LEN = struct.Struct(">H")
 _BATCH_N = struct.Struct(">H")
+# codec rev 2: an OPTIONAL uint32 deadline (relative ms budget) trailing a
+# BATCH_FLOW request's rows. Back-compatible both ways: old frames simply
+# lack the trailer (deadline 0 = none), and every decoder in the fleet —
+# numpy (count=n), the native Python codec (sn_batch_decode_req) and the C++
+# front door (parse_frames) — validates `len >= needed` and skips the whole
+# frame by its length prefix, so trailing bytes pass through old servers
+# untouched. Relative-not-absolute keeps clock skew out of the contract.
+_DEADLINE = struct.Struct(">I")
 
 # vectorized batch codecs: packed big-endian structured rows
 BATCH_REQ_DTYPE = np.dtype([("flow_id", ">i8"), ("count", ">i4"), ("prio", "u1")])
@@ -139,8 +149,16 @@ def encode_request(req) -> bytes:
     return _LEN.pack(len(payload)) + payload
 
 
-def encode_batch_request(xid: int, flow_ids, counts=None, prios=None) -> bytes:
-    """One BATCH_FLOW frame carrying N flow requests (numpy-vectorized)."""
+def encode_batch_request(
+    xid: int, flow_ids, counts=None, prios=None, deadline_ms=None
+) -> bytes:
+    """One BATCH_FLOW frame carrying N flow requests (numpy-vectorized).
+
+    ``deadline_ms`` (> 0) appends the rev-2 relative-deadline trailer: the
+    sender's remaining budget in ms. A deadline-aware server drops the frame
+    once the budget is blown (the client has already timed out); old servers
+    ignore the trailer entirely.
+    """
     flow_ids = np.asarray(flow_ids, dtype=np.int64)
     n = flow_ids.shape[0]
     if n > MAX_BATCH_PER_FRAME:
@@ -149,12 +167,18 @@ def encode_batch_request(xid: int, flow_ids, counts=None, prios=None) -> bytes:
     rows["flow_id"] = flow_ids
     rows["count"] = 1 if counts is None else np.asarray(counts, dtype=np.int32)
     rows["prio"] = 0 if prios is None else np.asarray(prios, dtype=np.uint8)
-    payload_len = _HEAD.size + _BATCH_N.size + n * BATCH_REQ_DTYPE.itemsize
+    tail = b""
+    if deadline_ms:
+        tail = _DEADLINE.pack(min(int(deadline_ms), 0xFFFFFFFF))
+    payload_len = (
+        _HEAD.size + _BATCH_N.size + n * BATCH_REQ_DTYPE.itemsize + len(tail)
+    )
     return (
         _LEN.pack(payload_len)
         + _HEAD.pack(xid, MsgType.BATCH_FLOW)
         + _BATCH_N.pack(n)
         + rows.tobytes()
+        + tail
     )
 
 
@@ -175,6 +199,20 @@ def decode_batch_request(payload: bytes):
         rows["count"].astype(np.int32),
         rows["prio"].astype(bool),
     )
+
+
+def decode_batch_deadline(payload: bytes) -> int:
+    """The rev-2 relative deadline (ms) trailing a BATCH_FLOW request, or 0
+    when absent (rev-1 frame / no budget declared). Tolerant of malformed
+    payloads — the full decode is where validity is judged."""
+    try:
+        (n,) = _BATCH_N.unpack_from(payload, _HEAD.size)
+    except struct.error:
+        return 0
+    tail = _HEAD.size + _BATCH_N.size + n * BATCH_REQ_DTYPE.itemsize
+    if len(payload) >= tail + _DEADLINE.size:
+        return _DEADLINE.unpack_from(payload, tail)[0]
+    return 0
 
 
 def encode_batch_response(xid: int, status, remaining, wait_ms) -> bytes:
@@ -303,6 +341,8 @@ class FrameReader:
         self._buf = bytearray()
 
     def feed(self, data: bytes) -> List[bytes]:
+        if _chaos.ARMED:  # inbound bit-rot injection (frame_corrupt)
+            data = _chaos.mangle("frame_corrupt", data)
         self._buf.extend(data)
         frames = []
         while True:

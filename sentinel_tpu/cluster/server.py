@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sentinel_tpu import chaos
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.connection import ConnectionManager
 from sentinel_tpu.cluster.token_service import TokenService
@@ -44,19 +45,23 @@ from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
 from sentinel_tpu.metrics.profiler import ProfilerHook
 from sentinel_tpu.metrics.server import server_metrics
+from sentinel_tpu.overload import AdmissionController, BrownoutLevel
 
 _SM = server_metrics()
+_OVERLOAD = int(TokenStatus.OVERLOAD)
 
 
 class _BatchFrame:
     """A decoded BATCH_FLOW request frame awaiting its verdict slice."""
 
-    __slots__ = ("xid", "flow_ids", "counts", "prios")
+    __slots__ = ("xid", "flow_ids", "counts", "prios", "deadline_ms")
 
     def __init__(self, payload: bytes):
         self.xid, self.flow_ids, self.counts, self.prios = (
             P.decode_batch_request(payload)
         )
+        # rev-2 relative deadline trailer (0 = none declared)
+        self.deadline_ms = P.decode_batch_deadline(payload)
 
 
 class _LoopWorker:
@@ -164,6 +169,11 @@ class _LoopWorker:
                     record_log.warning("oversized frame from client; closing")
                     return
                 for payload in payloads:
+                    if chaos.ARMED and chaos.should("frame_drop"):
+                        # the frame vanishes pre-decode; only the client's
+                        # timeout resolves it (the invariant under test)
+                        _SM.count_shed("chaos_drop", 1)
+                        continue
                     mtype = P.peek_type(payload)
                     if mtype == P.MsgType.BATCH_FLOW:
                         # vectorized decode; no per-request Python objects
@@ -173,7 +183,38 @@ class _LoopWorker:
                             record_log.warning("bad batch frame; closing")
                             return
                         srv.connections.touch(address)
-                        await self.queue.put((item, writer, loop.time()))
+                        k = len(item.flow_ids)
+                        if (
+                            srv.max_queue
+                            and self.queue.qsize() >= srv.max_queue
+                        ):
+                            # queue full: an explicit OVERLOAD answer NOW
+                            # beats silently queueing past the client's
+                            # budget (the old failure mode: timeout + a
+                            # mis-charged failover breaker)
+                            _SM.count_shed("queue_full", k)
+                            writer.write(
+                                P.encode_batch_response(
+                                    item.xid,
+                                    np.full(k, _OVERLOAD, np.int8),
+                                    np.zeros(k, np.int32),
+                                    np.full(
+                                        k, srv.overload.retry_hint_ms,
+                                        np.int32,
+                                    ),
+                                )
+                            )
+                            await writer.drain()
+                            continue
+                        deadline = (
+                            loop.time() + item.deadline_ms / 1000.0
+                            if item.deadline_ms
+                            else None
+                        )
+                        srv.overload.note_enqueued(k)
+                        await self.queue.put(
+                            (item, writer, loop.time(), deadline)
+                        )
                         continue
                     try:
                         req = P.decode_request(payload)
@@ -198,7 +239,25 @@ class _LoopWorker:
                         await writer.drain()
                     else:
                         srv.connections.touch(address)
-                        await self.queue.put((req, writer, loop.time()))
+                        if (
+                            srv.max_queue
+                            and self.queue.qsize() >= srv.max_queue
+                        ):
+                            _SM.count_shed("queue_full", 1)
+                            writer.write(
+                                P.encode_response(
+                                    P.FlowResponse(
+                                        req.xid, req.msg_type, _OVERLOAD,
+                                        0, srv.overload.retry_hint_ms,
+                                    )
+                                )
+                            )
+                            await writer.drain()
+                            continue
+                        srv.overload.note_enqueued(1)
+                        await self.queue.put(
+                            (req, writer, loop.time(), None)
+                        )
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -231,7 +290,14 @@ class _LoopWorker:
         loop = asyncio.get_running_loop()
         while True:
             first = await self.queue.get()
-            batch: List[Tuple[object, asyncio.StreamWriter, float]] = [first]
+            if chaos.ARMED:  # lane_delay: a descheduled batcher
+                d = chaos.delay_s("lane_delay")
+                if d:
+                    await asyncio.sleep(d)
+            # item = (request, writer, t_enqueued, abs_deadline | None)
+            batch: List[Tuple[object, asyncio.StreamWriter, float, object]] = [
+                first
+            ]
             total = self._n_requests(first[0])
             while total < srv.max_batch:
                 try:
@@ -284,14 +350,37 @@ class _LoopWorker:
         return 1
 
     async def _process(self, batch, total: int) -> None:
+        try:
+            await self._process_inner(batch)
+        finally:
+            # inflight accounting covers enqueue → answered/shed; the BBR
+            # gate reads it as the pipeline's concurrency
+            self.server.overload.note_done(total)
+
+    async def _process_inner(self, batch) -> None:
         srv = self.server
         service = srv.service
+        # deadline shed: a frame whose client budget is already blown gets
+        # DROPPED, not served — the client stopped waiting, so a verdict
+        # would only burn a device slot (and an OVERLOAD answer would race
+        # a closed socket). Counted so the drop is never invisible.
+        now = asyncio.get_running_loop().time()
+        live = []
+        for entry in batch:
+            deadline = entry[3]
+            if deadline is not None and now > deadline:
+                _SM.count_shed("deadline", self._n_requests(entry[0]))
+                continue
+            live.append(entry)
+        batch = live
+        if not batch:
+            return
         # split by kind: FLOW singles + BATCH_FLOW frames share one device
         # step; param requests go to the param sketch path; concurrent
         # acquire/release to the host-side semaphore path
         flow_singles: List[Tuple[int, P.FlowRequest]] = []
         batch_frames: List[Tuple[int, _BatchFrame]] = []
-        for i, (item, _w, _t) in enumerate(batch):
+        for i, (item, _w, _t, _dl) in enumerate(batch):
             if isinstance(item, _BatchFrame):
                 batch_frames.append((i, item))
             elif item.msg_type == P.MsgType.FLOW:
@@ -330,44 +419,85 @@ class _LoopWorker:
             flow_ids = ids_parts[0] if len(ids_parts) == 1 else np.concatenate(ids_parts)
             counts = cnt_parts[0] if len(cnt_parts) == 1 else np.concatenate(cnt_parts)
             prios = prio_parts[0] if len(prio_parts) == 1 else np.concatenate(prio_parts)
-            t_decide = time.perf_counter()
-            try:
-                dispatch = getattr(service, "dispatch_batch_arrays", None)
-                if dispatch is not None:
-                    # dispatch INLINE on the loop thread: host prep + async
-                    # enqueue only (sub-100µs), so device steps start in
-                    # batch order even when several _process tasks are in
-                    # flight. Materialization (blocks on the device) hops to
-                    # a worker thread for large steps so the loop keeps
-                    # pumping frames and the next batch's dispatch overlaps
-                    # this step's execution.
-                    materialize = dispatch(flow_ids, counts, prios)
-                    if n_flow <= srv.inline_below and self.inflight == 1:
-                        # small LONE step: the two executor hops of
-                        # to_thread cost more than the step blocks the loop
-                        # for. Only when nothing else is in flight — device
-                        # state chains serially, so an inline materialize
-                        # behind another task's large step would block the
-                        # loop for the predecessor's duration too.
-                        status, remaining, wait = materialize()
+            # brownout gate (BBR admission, overload/admission.py): SHED_LOW
+            # refuses the non-prioritized rows with OVERLOAD and serves the
+            # rest; DEGRADE skips the device entirely and answers locally
+            # (probabilistic pass / OVERLOAD). Shed rows are still ANSWERED
+            # — one response frame per request frame, always.
+            level = srv.overload.level()
+            if level >= BrownoutLevel.DEGRADE:
+                shed = srv.overload.shed_mask(prios, level)
+                status, remaining, wait = srv.overload.degrade_verdicts(shed)
+                _SM.count_shed("degrade", int(shed.sum()))
+                _SM.record_verdict_batch(status, None, ())
+                keep = None
+            else:
+                keep = None
+                if level >= BrownoutLevel.SHED_LOW:
+                    m = srv.overload.shed_mask(prios, level)
+                    if m.any():
+                        keep = np.nonzero(~m)[0]
+                        _SM.count_shed("brownout", n_flow - keep.size)
+                d_ids, d_cnts, d_prios = (
+                    (flow_ids, counts, prios)
+                    if keep is None
+                    else (flow_ids[keep], counts[keep], prios[keep])
+                )
+                d_n = len(d_ids)
+                t_decide = time.perf_counter()
+                try:
+                    dispatch = getattr(service, "dispatch_batch_arrays", None)
+                    if d_n == 0:
+                        status = np.empty(0, np.int8)
+                        remaining = np.empty(0, np.int32)
+                        wait = np.empty(0, np.int32)
+                    elif dispatch is not None:
+                        # dispatch INLINE on the loop thread: host prep + async
+                        # enqueue only (sub-100µs), so device steps start in
+                        # batch order even when several _process tasks are in
+                        # flight. Materialization (blocks on the device) hops to
+                        # a worker thread for large steps so the loop keeps
+                        # pumping frames and the next batch's dispatch overlaps
+                        # this step's execution.
+                        materialize = dispatch(d_ids, d_cnts, d_prios)
+                        if d_n <= srv.inline_below and self.inflight == 1:
+                            # small LONE step: the two executor hops of
+                            # to_thread cost more than the step blocks the loop
+                            # for. Only when nothing else is in flight — device
+                            # state chains serially, so an inline materialize
+                            # behind another task's large step would block the
+                            # loop for the predecessor's duration too.
+                            status, remaining, wait = materialize()
+                        else:
+                            status, remaining, wait = await asyncio.to_thread(
+                                materialize
+                            )
+                    elif d_n <= srv.inline_below:
+                        status, remaining, wait = service.request_batch_arrays(
+                            d_ids, d_cnts, d_prios
+                        )
                     else:
                         status, remaining, wait = await asyncio.to_thread(
-                            materialize
+                            service.request_batch_arrays, d_ids, d_cnts, d_prios
                         )
-                elif n_flow <= srv.inline_below:
-                    status, remaining, wait = service.request_batch_arrays(
-                        flow_ids, counts, prios
+                except Exception:
+                    record_log.exception("device step failed; failing batch")
+                    status = np.full(d_n, int(TokenStatus.FAIL), np.int8)
+                    remaining = np.zeros(d_n, np.int32)
+                    wait = np.zeros(d_n, np.int32)
+                _SM.decide_ms.record((time.perf_counter() - t_decide) * 1e3)
+                if keep is not None:
+                    # scatter the served subset back; shed rows answer
+                    # OVERLOAD with the retry hint
+                    st = np.full(n_flow, _OVERLOAD, np.int8)
+                    rm = np.zeros(n_flow, np.int32)
+                    wt = np.full(
+                        n_flow, srv.overload.retry_hint_ms, np.int32
                     )
-                else:
-                    status, remaining, wait = await asyncio.to_thread(
-                        service.request_batch_arrays, flow_ids, counts, prios
-                    )
-            except Exception:
-                record_log.exception("device step failed; failing batch")
-                status = np.full(n_flow, int(TokenStatus.FAIL), np.int8)
-                remaining = np.zeros(n_flow, np.int32)
-                wait = np.zeros(n_flow, np.int32)
-            _SM.decide_ms.record((time.perf_counter() - t_decide) * 1e3)
+                    st[keep] = status
+                    rm[keep] = remaining
+                    wt[keep] = wait
+                    status, remaining, wait = st, rm, wt
             off = 0
             for i, f in batch_frames:
                 k = len(f.flow_ids)
@@ -411,7 +541,7 @@ class _LoopWorker:
 
         host_side = [
             (i, req)
-            for i, (req, _w, _t) in enumerate(batch)
+            for i, (req, _w, _t, _dl) in enumerate(batch)
             if not isinstance(req, _BatchFrame)
             and req.msg_type != P.MsgType.FLOW
         ]
@@ -425,7 +555,7 @@ class _LoopWorker:
             # client instead of one of each per frame
             grouped: dict = {}  # writer → (xids, counts, verdict slices)
             for i in indices:
-                item, writer, _t_enq = batch[i]
+                item, writer, _t_enq, _dl = batch[i]
                 try:
                     if isinstance(item, _BatchFrame):
                         sliced = frame_slices.get(i)
@@ -509,12 +639,24 @@ class TokenServer:
         metrics_port: Optional[int] = None,
         snapshot_dir: Optional[str] = None,
         snapshot_period_s: Optional[float] = None,
+        max_queue: int = 8192,
+        overload: Optional[AdmissionController] = None,
     ):
         self.service = service
         self.host = host
         self.port = port
         self.batch_window_ms = batch_window_ms
         self.max_batch = max_batch
+        # per-loop bound on queued frames: at capacity the front door
+        # answers OVERLOAD immediately instead of queueing past every
+        # client's budget (0 disables the bound)
+        self.max_queue = max(0, int(max_queue))
+        # BBR-style admission gate + brownout ladder (overload/admission.py);
+        # pass a configured controller to tune headroom, or one with
+        # enabled=False to opt out
+        self.overload = (
+            overload if overload is not None else AdmissionController()
+        )
         # flow batches at or under this size dispatch inline on the loop
         # thread (sub-ms step; executor hops would dominate); larger ones go
         # through to_thread so the IO loop keeps pumping during the step
@@ -572,6 +714,8 @@ class TokenServer:
             metrics_port=self.metrics_port,
             snapshot_dir=self.snapshot_dir,
             snapshot_period_s=self.snapshot_period_s,
+            max_queue=self.max_queue,
+            overload=self.overload,
         )
 
     # -- lifecycle ----------------------------------------------------------

@@ -43,6 +43,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from sentinel_tpu import chaos
 from sentinel_tpu.cluster import protocol as P
 from sentinel_tpu.cluster.connection import ConnectionManager
 from sentinel_tpu.cluster.token_service import TokenService
@@ -50,8 +51,10 @@ from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
 from sentinel_tpu.metrics.profiler import ProfilerHook
 from sentinel_tpu.metrics.server import server_metrics
+from sentinel_tpu.overload import AdmissionController, BrownoutLevel
 
 _SM = server_metrics()
+_OVERLOAD = int(TokenStatus.OVERLOAD)
 
 
 def native_available() -> bool:
@@ -79,6 +82,9 @@ class NativeTokenServer:
         metrics_port: Optional[int] = None,
         snapshot_dir: Optional[str] = None,
         snapshot_period_s: Optional[float] = None,
+        shed_age_ms: Optional[float] = 1000.0,
+        drain_timeout_s: float = 10.0,
+        overload: Optional[AdmissionController] = None,
     ):
         from sentinel_tpu.native.lib import Frontdoor  # raises if unbuilt
 
@@ -97,6 +103,20 @@ class NativeTokenServer:
         self.intake_timeout_ms = max(1, int(intake_timeout_ms))
         self.idle_ttl_s = idle_ttl_s
         self.arena_cap = arena_cap
+        # the C++ door strips the wire deadline before Python sees a pull,
+        # so the native lanes shed by AGE instead: a pull older than this
+        # when the device lane picks it up is answered OVERLOAD without a
+        # dispatch (every client budget is long gone at 1s; None disables).
+        # Also the bounded-wait budget for the intake→device handoff — a
+        # full dispatch queue refuses (answers OVERLOAD) after this long
+        # instead of blocking the intake lane forever.
+        self.shed_age_ms = shed_age_ms
+        # lane join budget in stop() before _abandon flips drops on
+        self.drain_timeout_s = max(0.1, float(drain_timeout_s))
+        # BBR-style admission gate + brownout ladder (overload/admission.py)
+        self.overload = (
+            overload if overload is not None else AdmissionController()
+        )
         self._door = None
         self._threads: List[threading.Thread] = []
         self._lane_threads: List[threading.Thread] = []
@@ -135,6 +155,9 @@ class NativeTokenServer:
             metrics_port=self.metrics_port,
             snapshot_dir=self.snapshot_dir,
             snapshot_period_s=self.snapshot_period_s,
+            shed_age_ms=self.shed_age_ms,
+            drain_timeout_s=self.drain_timeout_s,
+            overload=self.overload,
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -257,7 +280,7 @@ class NativeTokenServer:
         # which turns every blocking lane handoff into a drop.
         self._intake_stop.set()
         for t in self._lane_threads:
-            t.join(timeout=10)
+            t.join(timeout=self.drain_timeout_s)
             if t.is_alive():
                 self._abandon.set()
                 t.join(timeout=2)
@@ -285,16 +308,28 @@ class NativeTokenServer:
     # -- data plane ---------------------------------------------------------
     _SENTINEL = object()  # lane shutdown marker, flows intake→device→reply
 
-    def _lane_put(self, q: queue.Queue, item) -> bool:
+    def _lane_put(
+        self, q: queue.Queue, item, give_up_after_s: Optional[float] = None
+    ) -> bool:
         """Blocking bounded-queue handoff (the lanes' backpressure). Never
         deadlocks shutdown: once ``_abandon`` is set (a lane died and its
-        join timed out) the put gives up and drops instead."""
+        join timed out) the put gives up and drops instead. With
+        ``give_up_after_s`` the put also refuses after that long against a
+        full queue — the caller then answers OVERLOAD instead of wedging
+        its lane (sentinel handoffs pass None and keep the forever
+        semantics: a dropped sentinel would strand the downstream lane)."""
+        deadline = (
+            None if give_up_after_s is None
+            else time.monotonic() + give_up_after_s
+        )
         while True:
             try:
                 q.put(item, timeout=0.1)
                 return True
             except queue.Full:
                 if self._abandon.is_set():
+                    return False
+                if deadline is not None and time.monotonic() >= deadline:
                     return False
 
     def _intake_loop(self) -> None:
@@ -318,17 +353,50 @@ class NativeTokenServer:
                 break
             if got is None:
                 continue
+            if chaos.ARMED:
+                chaos.maybe_sleep("lane_delay")
+                if chaos.should("frame_drop"):
+                    _SM.count_shed("chaos_drop", len(got[0]))
+                    continue
             t0 = time.perf_counter()
             ids, counts, prios, frames = got
             # wait_batch returns views into this thread's reused buffers —
-            # valid only until OUR next call — so the lane handoff copies
+            # valid only until OUR next call — so the lane handoff copies.
+            # The trailing monotonic stamp is the pull's arrival time: the
+            # device lane sheds by it (the C++ door strips the wire
+            # deadline, so age is the native deadline proxy).
             pull = (
                 np.array(ids), np.array(counts), np.array(prios),
                 tuple(np.array(f) for f in frames),
+                time.monotonic(),
             )
-            _SM.batch_size.record(len(ids))
-            self._lane_put(q, pull)
-            _SM.intake_ms.record((time.perf_counter() - t0) * 1e3)
+            n = len(ids)
+            _SM.batch_size.record(n)
+            self.overload.note_enqueued(n)
+            give_up = (
+                None if self.shed_age_ms is None
+                else self.shed_age_ms / 1000.0
+            )
+            if self._lane_put(q, pull, give_up_after_s=give_up):
+                _SM.intake_ms.record((time.perf_counter() - t0) * 1e3)
+            else:
+                # dispatch lane saturated past the age budget: refuse the
+                # whole pull explicitly rather than queue frames that will
+                # only expire — the clients get an immediate retry hint
+                self.overload.note_done(n)
+                _SM.count_shed("queue_full", n)
+                status = np.full(n, _OVERLOAD, np.int8)
+                wait = np.full(
+                    n, self.overload.retry_hint_ms, np.int32
+                )
+                _SM.record_verdict_batch(status, None, ())
+                try:
+                    door.submit(
+                        pull[3], status, np.zeros(n, np.int32), wait
+                    )
+                except Exception:
+                    if not self._stop.is_set():
+                        record_log.exception("native overload submit failed")
         self._lane_put(q, self._SENTINEL)
 
     def _device_loop(self) -> None:
@@ -368,27 +436,115 @@ class NativeTokenServer:
                     counts = np.concatenate([p[1] for p in pulls])
                     prios = np.concatenate([p[2] for p in pulls])
                 lengths = [len(p[0]) for p in pulls]
+                n_rows = len(ids)
+                # deadline proxy: pulls older than shed_age_ms are answered
+                # OVERLOAD without touching the device (row mask via repeat)
+                shed = None
+                n_deadline = 0
+                if self.shed_age_ms is not None:
+                    cutoff = time.monotonic() - self.shed_age_ms / 1000.0
+                    expired = np.array(
+                        [p[4] < cutoff for p in pulls], bool
+                    )
+                    if expired.any():
+                        shed = np.repeat(expired, lengths)
+                        n_deadline = int(shed.sum())
+                level = self.overload.level()
                 t0 = time.perf_counter()
                 try:
-                    if dispatch is not None:
-                        mat = dispatch(ids, counts, prios)
-                    else:
-                        # SPI implementations without the dispatch/
-                        # materialize split run synchronously here
-                        res = service.request_batch_arrays(
-                            ids, counts, prios
+                    if level >= BrownoutLevel.DEGRADE:
+                        # brownout floor: no device dispatch at all; a BDP
+                        # slice gets probabilistic local answers, the rest
+                        # (and every expired row) OVERLOAD
+                        deg = self.overload.shed_mask(prios, level)
+                        if shed is not None:
+                            deg = deg | shed
+                        status, remaining, wait = (
+                            self.overload.degrade_verdicts(deg)
                         )
-                        mat = lambda res=res: res  # noqa: E731
+                        if n_deadline:
+                            _SM.count_shed("deadline", n_deadline)
+                        _SM.count_shed(
+                            "degrade", int(deg.sum()) - n_deadline
+                        )
+                        _SM.record_verdict_batch(status, None, ())
+                        mat = (  # noqa: E731
+                            lambda r=(status, remaining, wait): r
+                        )
+                    else:
+                        mask = shed
+                        if level >= BrownoutLevel.SHED_LOW:
+                            m = self.overload.shed_mask(prios, level)
+                            mask = m if mask is None else (mask | m)
+                            if not mask.any():
+                                mask = None
+                        if mask is None:
+                            if dispatch is not None:
+                                mat = dispatch(ids, counts, prios)
+                            else:
+                                # SPI implementations without the dispatch/
+                                # materialize split run synchronously here
+                                res = service.request_batch_arrays(
+                                    ids, counts, prios
+                                )
+                                mat = lambda res=res: res  # noqa: E731
+                        else:
+                            if n_deadline:
+                                _SM.count_shed("deadline", n_deadline)
+                            n_brown = int(mask.sum()) - n_deadline
+                            if n_brown > 0:
+                                _SM.count_shed("brownout", n_brown)
+                            keep = np.nonzero(~mask)[0]
+                            if keep.size:
+                                if dispatch is not None:
+                                    inner = dispatch(
+                                        ids[keep], counts[keep], prios[keep]
+                                    )
+                                else:
+                                    res = service.request_batch_arrays(
+                                        ids[keep], counts[keep], prios[keep]
+                                    )
+                                    inner = lambda res=res: res  # noqa: E731
+                            else:
+                                inner = None
+                            hint = self.overload.retry_hint_ms
+                            n_shed = n_rows - int(keep.size)
+                            _SM.record_verdict_batch(
+                                np.full(n_shed, _OVERLOAD, np.int8),
+                                None, (),
+                            )
+
+                            # scatter the dispatched slice back into full-
+                            # width arrays so the reply lane's per-pull
+                            # offsets stay valid
+                            def mat(
+                                inner=inner, keep=keep, n=n_rows, hint=hint
+                            ):
+                                status = np.full(n, _OVERLOAD, np.int8)
+                                remaining = np.zeros(n, np.int32)
+                                wait = np.full(n, hint, np.int32)
+                                if inner is not None:
+                                    st, rm, wt = inner()
+                                    status[keep] = st
+                                    remaining[keep] = rm
+                                    wait[keep] = wt
+                                return status, remaining, wait
                 except Exception:
                     record_log.exception("device step failed; failing batch")
-                    n = len(ids)
+                    n = n_rows
                     mat = lambda n=n: (  # noqa: E731
                         np.full(n, int(TokenStatus.FAIL), np.int8),
                         np.zeros(n, np.int32),
                         np.zeros(n, np.int32),
                     )
                 _SM.dispatch_ms.record((time.perf_counter() - t0) * 1e3)
-                self._lane_put(self._reply_q, (pulls, lengths, mat))
+                if not self._lane_put(
+                    self._reply_q, (pulls, lengths, mat)
+                ):
+                    # abandoned shutdown drop: nobody will materialize or
+                    # answer these rows — account for them
+                    self.overload.note_done(n_rows)
+                    _SM.count_shed("lane_abandon", n_rows)
                 if stop_after:
                     break
         finally:
@@ -433,6 +589,7 @@ class NativeTokenServer:
                     if not self._stop.is_set():
                         record_log.exception("native submit failed")
                 off += ln
+            self.overload.note_done(off)
             _SM.write_ms.record((time.perf_counter() - t_write) * 1e3)
 
     # -- control plane ------------------------------------------------------

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from sentinel_tpu import chaos as _chaos
 from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.engine import (
     ClusterFlowRule,
@@ -653,6 +654,8 @@ class DefaultTokenService(TokenService):
         :meth:`_dispatch_oversized`) so the fixed per-dispatch overhead is
         paid once per fused group instead of once per frame.
         """
+        if _chaos.ARMED:  # device_stall injection: a slow/preempted step
+            _chaos.maybe_sleep("device_stall")
         flow_ids = np.asarray(flow_ids, np.int64)
         n = flow_ids.shape[0]
         if n == 0:

@@ -16,6 +16,8 @@ from __future__ import annotations
 import threading
 import time
 
+from sentinel_tpu import chaos as _chaos
+
 
 class Clock:
     """Source of wall-clock milliseconds. Subclass to virtualize time."""
@@ -87,4 +89,6 @@ def set_clock(clock: Clock) -> Clock:
 
 
 def now_ms() -> int:
+    if _chaos.ARMED:  # clock_skew injection (constant offset while armed)
+        return _clock.now_ms() + int(_chaos.skew_ms())
     return _clock.now_ms()

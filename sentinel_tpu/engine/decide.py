@@ -55,6 +55,12 @@ class TokenStatus(enum.IntEnum):
     # concurrent (cluster-semaphore) mode only:
     RELEASE_OK = 6
     ALREADY_RELEASE = 7
+    # server-side admission refusal (no reference analog): the token server
+    # answered instead of deciding — queue full, deadline blown, or brownout
+    # shed. Distinct from FAIL (broken) and BLOCKED (a rule's verdict): the
+    # server is alive and asks the caller to back off (wait_ms carries a
+    # retry hint). Never produced by the device kernels.
+    OVERLOAD = 8
 
 
 class RequestBatch(NamedTuple):

@@ -120,10 +120,31 @@ class FailoverTokenClient(TokenService):
             prev = self._members[self._active].endpoint
         ha_metrics().count_failover(str(prev), "", now_ms=_clock.now_ms())
 
+    @staticmethod
+    def _overloaded(result) -> bool:
+        """An explicit server-side admission refusal (OVERLOAD). A batch
+        counts only when EVERY row was refused — a partially-admitted batch
+        is an answer and returns to the caller as-is."""
+        if isinstance(result, TokenResult):
+            return result.status == TokenStatus.OVERLOAD
+        if isinstance(result, tuple) and len(result) == 3:
+            status = np.asarray(result[0])
+            return status.size > 0 and bool(
+                (status == int(TokenStatus.OVERLOAD)).all()
+            )
+        return False
+
     def _call(self, op: Callable, failed=None):
         """Walk available endpoints inside the deadline; ``op(member)``
         returns the raw result and ``failed(result)`` judges it. Returns the
-        first healthy result or None when the list is exhausted."""
+        first healthy result or None when the list is exhausted.
+
+        OVERLOAD replies are proof of life, not failure: the server is up
+        and explicitly refusing admission, so the breaker records SUCCESS
+        (evicting an overloaded-but-alive server would dogpile the
+        standbys) and the walk tries the next endpoint. When every
+        reachable endpoint is overloaded the first OVERLOAD reply — with
+        its retry hint — is returned rather than degrading to fallback."""
         if failed is None:
             failed = lambda r: (
                 r is None
@@ -131,6 +152,7 @@ class FailoverTokenClient(TokenService):
                     and r.status == TokenStatus.FAIL)
             )
         deadline = _clock.now_ms() + self.deadline_ms
+        overload_result = None
         for i, member in enumerate(self._members):
             # health is consulted immediately before dispatch, never up
             # front for the whole list: allows_request() may flip an OPEN
@@ -154,8 +176,17 @@ class FailoverTokenClient(TokenService):
                     break
                 continue
             member.health.record_success()
+            if self._overloaded(result):
+                ha_metrics().count_fallback("overload_backoff")
+                if overload_result is None:
+                    overload_result = result
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
             self._note_served(i)
             return result
+        if overload_result is not None:
+            return overload_result
         self._note_exhausted()
         return None
 

@@ -34,7 +34,19 @@ VERDICT_NAMES: Dict[int, str] = {
     3: "no_rule",         # NO_RULE_EXISTS
     4: "too_many_request",  # namespace guard tripped
     5: "fail",            # device step failed / degraded
+    8: "overload",        # admission refused: queue full / deadline / brownout
 }
+
+# reasons on the sentinel_server_shed_total counter: every dropped or
+# refused frame lands in exactly one of these
+SHED_REASONS = (
+    "queue_full",    # front-door queue at capacity → answered OVERLOAD
+    "deadline",      # client deadline already blown → dropped (no answer)
+    "brownout",      # SHED_LOW: non-prioritized rows answered OVERLOAD
+    "degrade",       # DEGRADE: rows refused by the probabilistic local gate
+    "lane_abandon",  # shutdown abandoned a wedged lane handoff
+    "chaos_drop",    # a chaos frame_drop injector ate the frame
+)
 
 NO_RULE_NAMESPACE = "(no-rule)"  # requests whose flow_id has no loaded rule
 
@@ -105,6 +117,11 @@ class ServerMetrics:
         self._verdicts: Dict[Tuple[str, str], int] = {}
         self._verdict_lock = threading.Lock()
         self._rate = _RateWindow()
+        # shed accounting: frames the server refused (answered OVERLOAD) or
+        # dropped (deadline blown, abandoned lane), by reason — the number
+        # that used to be invisible when _lane_put gave up silently
+        self._shed: Dict[str, int] = {}
+        self._shed_lock = threading.Lock()
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._gauge_lock = threading.Lock()
 
@@ -121,6 +138,29 @@ class ServerMetrics:
     def fused_frames_total(self) -> int:
         with self._fused_lock:
             return self._fused_frames
+
+    # -- shed counters ------------------------------------------------------
+    def count_shed(self, reason: str, n: int = 1) -> None:
+        """``n`` requests shed for ``reason`` (one of :data:`SHED_REASONS`,
+        free-form tolerated so callers can't lose a count to a typo)."""
+        if n <= 0:
+            return
+        with self._shed_lock:
+            self._shed[reason] = self._shed.get(reason, 0) + int(n)
+
+    def shed_totals(self) -> Dict[str, int]:
+        with self._shed_lock:
+            return dict(self._shed)
+
+    @property
+    def shed_total(self) -> int:
+        with self._shed_lock:
+            return sum(self._shed.values())
+
+    def verdict_rate(self) -> float:
+        """Windowed verdicts/sec — the throughput input of the BBR
+        admission estimator (``overload/admission.py``)."""
+        return self._rate.rate()
 
     # -- verdict counters ---------------------------------------------------
     def count_verdict(self, verdict: str, namespace: str, n: int = 1) -> None:
@@ -214,6 +254,8 @@ class ServerMetrics:
             "verdicts": verdicts,
             "verdictsPerSec": self._rate.rate(),
             "fusedFramesTotal": self.fused_frames_total,
+            "shedTotal": self.shed_total,
+            "shedByReason": self.shed_totals(),
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -247,6 +289,7 @@ class ServerMetrics:
                 "sum": round(snap["sum"], 3),
             }
         out["fused_frames_total"] = self.fused_frames_total
+        out["shed_total"] = self.shed_totals()
         return out
 
     def render(self) -> str:
@@ -287,6 +330,22 @@ class ServerMetrics:
         lines.append(
             f"sentinel_server_fused_frames_total {self.fused_frames_total}"
         )
+        lines.append(
+            "# HELP sentinel_server_shed_total Requests refused (OVERLOAD) "
+            "or dropped by the server, by reason (cumulative)."
+        )
+        lines.append("# TYPE sentinel_server_shed_total counter")
+        shed = self.shed_totals()
+        if shed:
+            for reason, count in sorted(shed.items()):
+                lines.append(
+                    "sentinel_server_shed_total"
+                    f'{{reason="{_escape(reason)}"}} {count}'
+                )
+        else:
+            # zero-sample so the series exists before the first shed and
+            # rate() queries don't gap when overload begins
+            lines.append('sentinel_server_shed_total{reason="queue_full"} 0')
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -341,6 +400,8 @@ class ServerMetrics:
             self._fused_frames = 0
         with self._verdict_lock:
             self._verdicts.clear()
+        with self._shed_lock:
+            self._shed.clear()
         self._rate.reset()
 
 

@@ -1,0 +1,13 @@
+"""Server-side overload protection: deadline-aware admission + shedding.
+
+See :mod:`sentinel_tpu.overload.admission` for the BBR-style controller and
+the brownout ladder, and ``docs/ROBUSTNESS.md`` for the operational model.
+"""
+
+from sentinel_tpu.overload.admission import (
+    AdmissionController,
+    BrownoutLevel,
+    OverloadConfig,
+)
+
+__all__ = ["AdmissionController", "BrownoutLevel", "OverloadConfig"]

@@ -1,0 +1,246 @@
+"""BBR-style admission control with a brownout ladder for the token server.
+
+The reference protects a node with ``SystemSlot``'s BBR gate
+(``SystemRuleManager.java:334-340``, mirrored in
+``local/system_adaptive.py:_check_bbr``): under pressure, keep admitting
+while ``concurrency <= maxSuccessQps * minRt``. That inequality is Little's
+law — the left side is work in the system, the right side is the
+bandwidth-delay product (BDP) the pipeline can actually hold. Anything
+beyond the BDP only sits in queues, inflating every request's latency
+without adding throughput, which is precisely the state an overloaded
+token server must refuse instead of absorb.
+
+This module applies the same estimator to the *serving pipeline* using the
+signals :mod:`sentinel_tpu.metrics.server` already collects:
+
+- **throughput** — the windowed verdicts/sec rate (``verdict_rate``),
+- **minRt** — the decide-stage p50 (``decide_ms`` histogram), floored so a
+  sub-100µs CPU step can't collapse the BDP to zero,
+- **concurrency** — requests admitted by the front door and not yet
+  answered, counted by the server via ``note_enqueued``/``note_done``.
+
+The verdict is a **brownout level**, re-evaluated at most every
+``recheck_ms`` so the hot path never pays for the histogramming:
+
+``NORMAL``
+    inflight within ``headroom_shed × BDP`` — admit everything.
+``SHED_LOW``
+    inflight beyond it — shed the lowest-priority rows first (answered
+    with ``OVERLOAD`` + a retry hint), prioritized rows still reach the
+    device. The reference's priority semantics, applied to survival.
+``DEGRADE``
+    inflight beyond ``headroom_degrade × BDP`` — the device is no longer
+    consulted at all; the server answers locally, admitting a probabilistic
+    fraction (``BDP / inflight``) with ``OK`` and refusing the rest with
+    ``OVERLOAD``. Cheap, bounded, and it keeps the answer rate pinned to
+    what the pipeline can actually sustain until the backlog drains.
+
+Every decision is an *answer*, never silence — the client-side failover
+breaker treats ``OVERLOAD`` as "alive, back off" (``ha/failover.py``), so a
+browning-out server is not evicted from rotation.
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from sentinel_tpu.core.config import SentinelConfig
+from sentinel_tpu.metrics.server import ServerMetrics, server_metrics
+
+KEY_ENABLED = "sentinel.tpu.overload.enabled"
+KEY_HEADROOM_SHED = "sentinel.tpu.overload.headroom.shed"
+KEY_HEADROOM_DEGRADE = "sentinel.tpu.overload.headroom.degrade"
+KEY_MIN_BDP = "sentinel.tpu.overload.min.bdp"
+KEY_RECHECK_MS = "sentinel.tpu.overload.recheck.ms"
+KEY_SUSTAIN_MS = "sentinel.tpu.overload.sustain.ms"
+
+
+class BrownoutLevel(enum.IntEnum):
+    NORMAL = 0
+    SHED_LOW = 1  # shed non-prioritized rows, serve the rest
+    DEGRADE = 2  # probabilistic local answers, no device dispatch
+
+
+@dataclass
+class OverloadConfig:
+    """Knobs for the admission controller (all config-overridable).
+
+    The defaults are deliberately conservative: a closed-loop client fleet
+    in steady state sits at inflight ≈ 1–4 × BDP (pipelining), so the shed
+    ladder only engages on a genuine open-loop backlog.
+    """
+
+    enabled: bool = True
+    headroom_shed: float = 8.0
+    headroom_degrade: float = 32.0
+    # BDP floor in requests: below this the estimator has too little signal
+    # (cold server, idle rate window) to justify shedding anything
+    min_bdp: float = 1024.0
+    # decide-p50 floor: a sub-50µs CPU step must not zero the BDP
+    min_rt_floor_ms: float = 0.05
+    recheck_ms: float = 25.0
+    # the over-threshold condition must hold THIS long before the ladder
+    # escalates: a healthy pipeline absorbing a burst spikes past the BDP
+    # headroom for tens of ms while draining fine — only a backlog that
+    # *stays* means the pipeline is genuinely behind
+    sustain_ms: float = 500.0
+    # wait_ms hint carried on OVERLOAD verdicts (client backoff guidance)
+    retry_hint_ms: int = 5
+
+    @classmethod
+    def from_config(cls) -> "OverloadConfig":
+        return cls(
+            enabled=SentinelConfig.get_bool(KEY_ENABLED, True),
+            headroom_shed=SentinelConfig.get_float(KEY_HEADROOM_SHED, 8.0),
+            headroom_degrade=SentinelConfig.get_float(
+                KEY_HEADROOM_DEGRADE, 32.0
+            ),
+            min_bdp=SentinelConfig.get_float(KEY_MIN_BDP, 1024.0),
+            recheck_ms=SentinelConfig.get_float(KEY_RECHECK_MS, 25.0),
+            sustain_ms=SentinelConfig.get_float(KEY_SUSTAIN_MS, 500.0),
+        )
+
+
+class AdmissionController:
+    """BBR admission gate shared by a server's front-door lanes.
+
+    Thread-safe; one instance per server (both front doors construct a
+    default one). The level read is a cached attribute outside the
+    re-evaluation window, so per-batch cost is O(1).
+    """
+
+    def __init__(
+        self,
+        config: Optional[OverloadConfig] = None,
+        metrics: Optional[ServerMetrics] = None,
+        seed: Optional[int] = None,
+    ):
+        self.config = config or OverloadConfig.from_config()
+        self._m = metrics if metrics is not None else server_metrics()
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._level = BrownoutLevel.NORMAL
+        self._admit_frac = 1.0
+        self._next_eval = 0.0
+        self._over_since: Optional[float] = None
+        self._rng = random.Random(seed)
+
+    # -- inflight accounting (front doors call these) -----------------------
+    def note_enqueued(self, n: int) -> None:
+        with self._lock:
+            self._inflight += int(n)
+
+    def note_done(self, n: int) -> None:
+        with self._lock:
+            self._inflight -= int(n)
+            if self._inflight < 0:  # lost accounting must not wedge shedding
+                self._inflight = 0
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    @property
+    def retry_hint_ms(self) -> int:
+        return self.config.retry_hint_ms
+
+    # -- the gate -----------------------------------------------------------
+    def level(self, now: Optional[float] = None) -> BrownoutLevel:
+        if not self.config.enabled:
+            return BrownoutLevel.NORMAL
+        if now is None:
+            now = time.monotonic()
+        if now >= self._next_eval:  # racy read is fine; eval is idempotent
+            self._evaluate(now)
+        return self._level
+
+    def _evaluate(self, now: float) -> None:
+        cfg = self.config
+        with self._lock:
+            self._next_eval = now + cfg.recheck_ms / 1000.0
+            inflight = self._inflight
+        bdp = self.estimated_bdp()
+        if inflight > bdp * cfg.headroom_degrade:
+            level = BrownoutLevel.DEGRADE
+        elif inflight > bdp * cfg.headroom_shed:
+            level = BrownoutLevel.SHED_LOW
+        else:
+            level = BrownoutLevel.NORMAL
+        # escalation needs SUSTAINED pressure (a draining burst recovers
+        # before the window elapses); recovery is immediate
+        if level is BrownoutLevel.NORMAL:
+            self._over_since = None
+        else:
+            if self._over_since is None:
+                self._over_since = now
+            if (now - self._over_since) * 1000.0 < cfg.sustain_ms:
+                level = BrownoutLevel.NORMAL
+        with self._lock:
+            self._level = level
+            self._admit_frac = (
+                min(1.0, bdp / inflight) if inflight > 0 else 1.0
+            )
+
+    def estimated_bdp(self) -> float:
+        """max(rate × minRt, floor) — requests the pipeline can hold."""
+        cfg = self.config
+        rate = self._m.verdict_rate()
+        min_rt = max(
+            self._m.decide_ms.snapshot()["p50"] or 0.0, cfg.min_rt_floor_ms
+        )
+        return max(rate * min_rt / 1000.0, cfg.min_bdp)
+
+    # -- brownout verdict helpers ------------------------------------------
+    def shed_mask(self, prios, level: BrownoutLevel) -> np.ndarray:
+        """bool[N] — True rows are refused with OVERLOAD at this level.
+
+        ``SHED_LOW`` sheds exactly the non-prioritized rows. ``DEGRADE``
+        sheds a random ``1 - admit_frac`` of ALL rows; the survivors get a
+        local (device-free) answer from :meth:`degrade_verdicts`.
+        """
+        prios = np.asarray(prios, dtype=bool)
+        if level == BrownoutLevel.SHED_LOW:
+            return ~prios
+        if level == BrownoutLevel.DEGRADE:
+            with self._lock:
+                frac = self._admit_frac
+                if frac >= 1.0:
+                    return np.zeros(prios.shape[0], dtype=bool)
+                draws = np.array(
+                    [self._rng.random() for _ in range(prios.shape[0])]
+                )
+            return draws >= frac
+        return np.zeros(prios.shape[0], dtype=bool)
+
+    def degrade_verdicts(self, shed: np.ndarray):
+        """(status, remaining, wait_ms) for a fully-local DEGRADE answer:
+        admitted rows pass, shed rows get OVERLOAD + the retry hint."""
+        from sentinel_tpu.engine import TokenStatus
+
+        n = shed.shape[0]
+        status = np.where(
+            shed, np.int8(int(TokenStatus.OVERLOAD)), np.int8(int(TokenStatus.OK))
+        ).astype(np.int8)
+        remaining = np.zeros(n, np.int32)
+        wait = np.where(shed, np.int32(self.config.retry_hint_ms), np.int32(0)).astype(
+            np.int32
+        )
+        return status, remaining, wait
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "level": int(self._level),
+                "levelName": self._level.name,
+                "inflight": self._inflight,
+                "admitFrac": round(self._admit_frac, 4),
+                "estimatedBdp": round(self.estimated_bdp(), 1),
+                "enabled": self.config.enabled,
+            }

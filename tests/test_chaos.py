@@ -1,0 +1,227 @@
+"""Chaos fault-injection harness: registry semantics + serving invariants.
+
+The invariant suite drives a real server/client pair under each injector
+and asserts the robustness contract: every request RESOLVES (a verdict,
+an OVERLOAD refusal, or a client-side timeout — never a hang), no serving
+thread dies, and stop() drains cleanly afterwards. Fixed seeds make a
+failing run reproducible.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sentinel_tpu import chaos
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.server import TokenServer
+from sentinel_tpu.cluster.server_native import (
+    NativeTokenServer,
+    native_available,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+from sentinel_tpu.engine.rules import ThresholdMode
+
+G = ThresholdMode.GLOBAL
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=256)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    chaos.disarm()
+
+
+def _service():
+    svc = DefaultTokenService(CFG)
+    svc.load_rules([ClusterFlowRule(flow_id=1, count=1e9, mode=G)])
+    return svc
+
+
+@pytest.fixture(scope="module")
+def svc():
+    # one service (= one decide-kernel compile) for the whole module; the
+    # shared-server invariant tests below are sequential users of it
+    return _service()
+
+
+@pytest.fixture(scope="module")
+def asyncio_server(svc):
+    server = TokenServer(svc, port=0)
+    server.start()
+    yield server
+    chaos.disarm()
+    t0 = time.monotonic()
+    server.stop()
+    assert time.monotonic() - t0 < 10, "stop() hung after chaos"
+
+
+@pytest.fixture(scope="module")
+def native_server(svc):
+    if not native_available():
+        pytest.skip("native library not built")
+    server = NativeTokenServer(svc, port=0, idle_ttl_s=None, drain_timeout_s=3.0)
+    server.start()
+    yield server
+    chaos.disarm()
+    t0 = time.monotonic()
+    server.stop()
+    assert time.monotonic() - t0 < 20, "stop() hung after chaos"
+
+
+# -- registry ---------------------------------------------------------------
+class TestRegistry:
+    def test_parse_spec_grammar(self):
+        inj = chaos.parse_spec("lane_delay:p=0.2,ms=5;frame_drop;clock_skew:ms=100,n=3")
+        assert inj["lane_delay"].p == 0.2 and inj["lane_delay"].ms == 5.0
+        assert inj["frame_drop"].p == 1.0
+        assert inj["clock_skew"].n == 3
+
+    def test_parse_rejects_unknown_point_and_arg(self):
+        with pytest.raises(ValueError):
+            chaos.parse_spec("warp_core_breach")
+        with pytest.raises(ValueError):
+            chaos.parse_spec("lane_delay:q=1")
+
+    def test_armed_flag_is_zero_overhead_gate(self):
+        assert chaos.ARMED is False
+        chaos.arm("frame_drop:p=0.5", seed=1)
+        assert chaos.ARMED is True
+        chaos.disarm()
+        assert chaos.ARMED is False
+
+    def test_seeded_decisions_are_reproducible(self):
+        decisions = []
+        for _ in range(2):
+            chaos.arm("frame_drop:p=0.5", seed=1234)
+            decisions.append([chaos.should("frame_drop") for _ in range(50)])
+            chaos.disarm()
+        assert decisions[0] == decisions[1]
+        assert any(decisions[0]) and not all(decisions[0])
+
+    def test_firing_budget_n(self):
+        chaos.arm("frame_drop:n=3", seed=7)
+        fires = sum(chaos.should("frame_drop") for _ in range(10))
+        assert fires == 3
+        assert chaos.fired()["frame_drop"] == 3
+
+    def test_unarmed_point_never_fires(self):
+        chaos.arm("frame_drop", seed=7)
+        assert not chaos.should("device_stall")
+        assert chaos.delay_s("lane_delay") == 0.0
+
+    def test_mangle_flips_exactly_one_byte(self):
+        chaos.arm("frame_corrupt", seed=7)
+        data = bytes(range(32))
+        out = chaos.mangle("frame_corrupt", data)
+        diff = [i for i in range(32) if out[i] != data[i]]
+        assert len(diff) == 1
+        assert out[diff[0]] == data[diff[0]] ^ 0xFF
+
+    def test_skew_is_constant_not_probabilistic(self):
+        chaos.arm("clock_skew:ms=250,p=0.0", seed=7)
+        assert chaos.skew_ms() == 250.0 == chaos.skew_ms()
+
+    def test_arm_from_env(self):
+        reg = chaos.ChaosRegistry()
+        assert not reg.arm_from_env({})
+        assert reg.arm_from_env(
+            {chaos.ENV_SPEC: "frame_drop:p=0.1", chaos.ENV_SEED: "9"}
+        )
+        assert reg.injectors()["frame_drop"].p == 0.1
+        chaos.disarm()  # arm() flipped the module flag
+
+    def test_clock_skew_shifts_now_ms(self):
+        from sentinel_tpu.core import clock
+
+        base = clock.now_ms()
+        chaos.arm("clock_skew:ms=60000", seed=1)
+        skewed = clock.now_ms()
+        chaos.disarm()
+        assert skewed - base >= 60000 - 5
+
+
+# -- serving invariants under injection -------------------------------------
+SPECS = [
+    pytest.param("lane_delay:ms=10", id="lane_delay"),
+    pytest.param("frame_drop:p=0.3", id="frame_drop"),
+    pytest.param("frame_corrupt:p=0.1", id="frame_corrupt"),
+    pytest.param("device_stall:ms=40,p=0.5", id="device_stall"),
+    pytest.param("clock_skew:ms=5000", id="clock_skew"),
+    pytest.param("conn_reset:p=0.2", id="conn_reset"),
+]
+
+
+def _run_fleet(port, n_threads=4, n_requests=6, timeout_ms=300):
+    """Closed-loop client fleet; returns per-call outcomes. TokenClient
+    never raises — a timeout/degrade surfaces as None/FAIL — so a missing
+    outcome means a HANG, the invariant violation under test."""
+    outcomes = [[] for _ in range(n_threads)]
+
+    def worker(i):
+        c = TokenClient("127.0.0.1", port, timeout_ms=timeout_ms)
+        try:
+            for _ in range(n_requests):
+                outcomes[i].append(
+                    c.request_batch_arrays(np.full(4, 1, np.int64))
+                )
+        finally:
+            c.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        # generous bound: n_requests × timeout + reconnect slack
+        t.join(timeout=n_requests * (timeout_ms / 1000.0) + 10)
+    hung = [t for t in threads if t.is_alive()]
+    return outcomes, hung
+
+
+class TestInvariantsAsyncio:
+    # the server fixture is module-scoped ON PURPOSE: surviving every
+    # injector in sequence (and the shared stop() at teardown) IS the
+    # invariant; each test re-proves clean service after its own disarm
+    @pytest.mark.parametrize("spec", SPECS)
+    def test_every_request_resolves_and_server_survives(self, asyncio_server, spec):
+        chaos.arm(spec, seed=20260804)
+        outcomes, hung = _run_fleet(asyncio_server.port)
+        assert not hung, "client threads hung — a request never resolved"
+        assert all(len(o) == 6 for o in outcomes)
+        point = spec.split(":")[0]
+        if point != "clock_skew":  # skew is passive, not a firing probe
+            assert chaos.fired().get(point, 0) > 0, "fault never fired"
+        chaos.disarm()
+        # the server survived: a fresh client gets clean verdicts
+        c = TokenClient("127.0.0.1", asyncio_server.port, timeout_ms=3000)
+        out = c.request_batch_arrays(np.full(4, 1, np.int64))
+        c.close()
+        assert out is not None and (out[0] == 0).all()
+
+
+class TestInvariantsNative:
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            pytest.param("lane_delay:ms=10;frame_drop:p=0.2", id="lanes"),
+            pytest.param(
+                "device_stall:ms=40,p=0.5;frame_corrupt:p=0.1",
+                id="device+corrupt",
+            ),
+        ],
+    )
+    def test_every_request_resolves_and_server_survives(self, native_server, spec):
+        chaos.arm(spec, seed=20260804)
+        outcomes, hung = _run_fleet(native_server.port)
+        assert not hung, "client threads hung — a request never resolved"
+        assert all(len(o) == 6 for o in outcomes)
+        assert sum(chaos.fired().values()) > 0
+        chaos.disarm()
+        c = TokenClient("127.0.0.1", native_server.port, timeout_ms=3000)
+        out = c.request_batch_arrays(np.full(4, 1, np.int64))
+        c.close()
+        assert out is not None and (out[0] == 0).all()

@@ -1,0 +1,195 @@
+"""Front-door fuzz: arbitrary bytes must never kill a serving thread.
+
+Satellite of the overload/chaos PR: both front doors (asyncio and native)
+and the client's reader thread receive seeded garbage — truncated frames,
+runt frames, bogus lengths, random blobs — and the invariant is graceful
+connection drop + continued service, never a dead lane or a wedged loop.
+"""
+
+import random
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.client import TokenClient
+from sentinel_tpu.cluster.server import TokenServer
+from sentinel_tpu.cluster.server_native import (
+    NativeTokenServer,
+    native_available,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+from sentinel_tpu.engine.rules import ThresholdMode
+
+G = ThresholdMode.GLOBAL
+CFG = EngineConfig(max_flows=64, max_namespaces=4, batch_size=256)
+
+SEED = 0xC0FFEE
+
+
+def _service():
+    svc = DefaultTokenService(CFG)
+    svc.load_rules([ClusterFlowRule(flow_id=1, count=1e9, mode=G)])
+    return svc
+
+
+@pytest.fixture(scope="module")
+def svc():
+    # one service (= one decide-kernel compile) shared by both front doors
+    return _service()
+
+
+@pytest.fixture(scope="module")
+def asyncio_server(svc):
+    server = TokenServer(svc, port=0)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _garbage_corpus(seed=SEED, n=40):
+    """Seeded adversarial byte blobs: random, runt, truncated, bogus-type,
+    bogus-length, zero-length — every framing failure class."""
+    rng = random.Random(seed)
+    corpus = [
+        b"\x00\x00",  # zero-length frame
+        b"\x00\x02xx",  # runt: payload below header size
+        b"\x00\x01\x00",  # one-byte payload
+        b"\xff\xff" + b"A" * 10,  # declared 65535, delivered 10 (truncate)
+        struct.pack(">H", 9) + struct.pack(">ib", 1, 99) + b"????",  # bad type
+        P.encode_request(P.Ping(1))[:-2],  # truncated valid frame
+    ]
+    for _ in range(n):
+        corpus.append(bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200))))
+    # a structurally-valid BATCH_FLOW header with a lying row count
+    lying = struct.pack(">H", 7) + struct.pack(">ib", 5, int(P.MsgType.BATCH_FLOW)) + struct.pack(">H", 500)
+    corpus.append(lying)
+    return corpus
+
+
+def _throw_garbage(port, corpus):
+    """One connection per blob; sender ignores resets (that IS the graceful
+    drop under test)."""
+    for blob in corpus:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), 2)
+            s.sendall(blob)
+            s.settimeout(0.02)
+            try:
+                s.recv(1024)
+            except (socket.timeout, OSError):
+                pass
+            s.close()
+        except OSError:
+            pass
+
+
+def _assert_still_serving(port):
+    c = TokenClient("127.0.0.1", port, timeout_ms=3000)
+    try:
+        assert c.ping()
+        out = c.request_batch_arrays(np.full(4, 1, np.int64))
+        assert out is not None and (out[0] == 0).all()
+        assert c.request_token(1).ok
+    finally:
+        c.close()
+
+
+class TestAsyncioFuzz:
+    def test_garbage_never_kills_the_loop(self, asyncio_server):
+        _throw_garbage(asyncio_server.port, _garbage_corpus())
+        _assert_still_serving(asyncio_server.port)
+
+    def test_garbage_interleaved_with_live_traffic(self, asyncio_server):
+        stop = threading.Event()
+
+        def attacker():
+            while not stop.is_set():
+                _throw_garbage(asyncio_server.port, _garbage_corpus(n=5))
+
+        t = threading.Thread(target=attacker)
+        t.start()
+        try:
+            for _ in range(3):
+                _assert_still_serving(asyncio_server.port)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+
+@pytest.mark.skipif(not native_available(), reason="native library not built")
+class TestNativeFuzz:
+    def test_garbage_never_kills_a_lane(self, svc):
+        server = NativeTokenServer(svc, port=0, idle_ttl_s=None)
+        server.start()
+        try:
+            _throw_garbage(server.port, _garbage_corpus(seed=SEED + 1))
+            _assert_still_serving(server.port)
+        finally:
+            server.stop()
+
+
+class TestClientReaderFuzz:
+    def _fake_server(self, reply_blobs):
+        """Accepts one connection, streams the scripted blobs back at it."""
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        port = lsock.getsockname()[1]
+
+        def serve():
+            try:
+                lsock.settimeout(5)
+                conn, _ = lsock.accept()
+                conn.settimeout(5)
+                try:
+                    conn.recv(65536)  # whatever the client sent
+                except OSError:
+                    pass
+                for blob in reply_blobs:
+                    try:
+                        conn.sendall(blob)
+                    except OSError:
+                        break
+                conn.close()
+            except OSError:
+                pass
+            finally:
+                lsock.close()
+
+        t = threading.Thread(target=serve)
+        t.start()
+        return port, t
+
+    def test_reader_survives_malformed_reply(self):
+        # a runt frame raises in FrameReader.feed — the reader must drop
+        # the connection, never die with an unhandled exception
+        port, t = self._fake_server([b"\x00\x02xx"])
+        c = TokenClient("127.0.0.1", port, timeout_ms=300)
+        try:
+            r = c.request_token(1)
+            assert not r.ok  # degraded, not raised
+            # the client object stays usable (reconnect path)
+            r2 = c.request_token(1)
+            assert r2 is not None
+        finally:
+            c.close()
+            t.join(timeout=5)
+
+    def test_reader_survives_random_garbage(self):
+        rng = random.Random(SEED)
+        blobs = [
+            bytes(rng.randrange(256) for _ in range(64)) for _ in range(8)
+        ]
+        port, t = self._fake_server(blobs)
+        c = TokenClient("127.0.0.1", port, timeout_ms=300)
+        try:
+            r = c.request_token(1)
+            assert r is not None and not r.ok
+        finally:
+            c.close()
+            t.join(timeout=5)

@@ -39,9 +39,12 @@ DRILL_FLOW = 42
 N_FALLBACK_PROBES = 400
 
 
-def _serve_forever() -> None:
+def _serve_forever(args) -> None:
     """Child mode: one token server on an ephemeral port, announced as a
-    JSON line on stdout; runs until killed (that's the point)."""
+    JSON line on stdout; runs until killed (that's the point). The
+    replication drill reuses this child with role flags (``--standby-of``
+    / ``--replicate-to``) and a finite ``--count`` so over-admission is
+    measurable."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -51,27 +54,49 @@ def _serve_forever() -> None:
     from sentinel_tpu.engine.rules import ThresholdMode
 
     svc = DefaultTokenService(
-        EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+        EngineConfig(
+            max_flows=64, max_namespaces=4, batch_size=64,
+            bucket_ms=args.bucket_ms,
+        )
     )
     svc.load_rules(
-        [ClusterFlowRule(DRILL_FLOW, 1e9, ThresholdMode.GLOBAL)]
+        [ClusterFlowRule(DRILL_FLOW, args.count, ThresholdMode.GLOBAL)]
     )
-    server = TokenServer(svc, port=0)
+    server = TokenServer(
+        svc, port=0, metrics_port=0,
+        standby_of=args.standby_of,
+        promote_after_ms=args.promote_after_ms,
+        replicate_to=(
+            [args.replicate_to] if args.replicate_to else None
+        ),
+        repl_interval_ms=args.repl_interval_ms,
+    )
     server.start()
-    print(json.dumps({"port": server.port}), flush=True)
+    print(
+        json.dumps({"port": server.port, "metrics_port": server.metrics_port}),
+        flush=True,
+    )
     while True:
         time.sleep(3600)
 
 
-def _spawn_server(timeout_s: float = 120.0) -> tuple:
-    """Start one server child; returns (Popen, port)."""
+def _spawn_server(timeout_s: float = 120.0, extra=None) -> tuple:
+    """Start one server child; returns (Popen, port, metrics_port)."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""  # never register against a TPU tunnel
+    log_dir = os.environ.get("SENTINEL_DRILL_CHILD_LOGS")
+    if log_dir:
+        stderr = open(
+            os.path.join(log_dir, f"child-{time.monotonic_ns()}.err"), "w"
+        )
+    else:
+        stderr = subprocess.DEVNULL
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--serve"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        [sys.executable, os.path.abspath(__file__), "--serve"]
+        + list(extra or ()),
+        stdout=subprocess.PIPE, stderr=stderr, text=True,
         env=env,
     )
     deadline = time.monotonic() + timeout_s
@@ -79,11 +104,21 @@ def _spawn_server(timeout_s: float = 120.0) -> tuple:
     while time.monotonic() < deadline:
         line = proc.stdout.readline()
         if line.startswith("{"):
-            return proc, json.loads(line)["port"]
+            doc = json.loads(line)
+            return proc, doc["port"], doc.get("metrics_port")
         if proc.poll() is not None:
             break
     proc.kill()
     raise RuntimeError(f"server child never became ready (last: {line!r})")
+
+
+def _scrape(metrics_port: int) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{metrics_port}/metrics", timeout=3
+    ) as rsp:
+        return rsp.read().decode()
 
 
 def run_drill(deadline_ms: float = None, request_timeout_ms: int = 200):
@@ -103,8 +138,8 @@ def run_drill(deadline_ms: float = None, request_timeout_ms: int = 200):
 
         deadline_ms = SentinelConfig.get_float(KEY_FAILOVER_DEADLINE_MS, 500.0)
     failures = []
-    primary_proc, primary_port = _spawn_server()
-    standby_proc, standby_port = _spawn_server()
+    primary_proc, primary_port, _ = _spawn_server()
+    standby_proc, standby_port, _ = _spawn_server()
     # the fallback rule throttles to a local window so the all-down phase
     # measures a real blocked-rate, not a constant verdict
     policy = LocalFallbackPolicy(
@@ -325,6 +360,234 @@ def run_overload_drill(seconds: float = 2.5, probe_timeout_ms: int = 500):
     }
 
 
+def run_replication_drill(
+    count: float = 300.0,
+    repl_interval_ms: float = 100.0,
+    promote_after_ms: float = 1000.0,
+    bucket_ms: int = 500,
+    drive_rate: float = 200.0,
+):
+    """Warm-standby lossless-failover drill: SIGKILL the primary MID-WINDOW
+    and verify the promoted standby keeps enforcing the window the primary
+    already half-spent.
+
+    Topology: primary streams deltas every ``repl_interval_ms`` to a
+    standby whose watchdog self-promotes after ``promote_after_ms`` of
+    silence. A paced client admits against a finite window of ``count``
+    tokens. Rule counts are per-SECOND rates (the engine scales the
+    threshold by the window length), so the children get
+    ``count / window_s`` as their rule count; with ``bucket_ms=500`` the
+    window is 5s — wide enough to hold the whole drill, and the drill
+    stays under the earliest possible bucket-rotation point (~4.5s) so
+    expiring buckets can't silently refill the window. Invariants:
+
+    - every request RESOLVES throughout (verdict / STANDBY walk-on /
+      fallback block — never an exception);
+    - total admissions across both servers stay within ``count`` plus the
+      staleness budget — one delta-ship interval's worth of tokens at the
+      measured admission rate (the only state a SIGKILL can lose);
+    - the promoted standby actually BLOCKS (proof it inherited the
+      half-spent window rather than starting fresh);
+    - ``sentinel_repl_lag_ms`` and the delta counters are live on both
+      metrics surfaces.
+    """
+    from sentinel_tpu.engine import TokenStatus
+    from sentinel_tpu.ha import (
+        FailoverTokenClient,
+        FallbackAction,
+        FallbackRule,
+        LocalFallbackPolicy,
+    )
+
+    failures = []
+    # EngineConfig default n_buckets=10: window = bucket_ms * 10
+    window_s = bucket_ms * 10 / 1000.0
+    rule_qps = count / window_s
+    common = [
+        "--count", str(rule_qps), "--bucket-ms", str(bucket_ms),
+        "--repl-interval-ms", str(repl_interval_ms),
+    ]
+    standby_proc, standby_port, standby_mport = _spawn_server(
+        extra=common + [
+            "--standby-of", "primary",
+            "--promote-after-ms", str(promote_after_ms),
+        ]
+    )
+    primary_proc, primary_port, primary_mport = _spawn_server(
+        extra=common + ["--replicate-to", f"127.0.0.1:{standby_port}"]
+    )
+    # fallback BLOCKS: the promotion gap must not admit locally, or the
+    # over-admission measure would be polluted by client-side passes
+    policy = LocalFallbackPolicy(
+        [FallbackRule(DRILL_FLOW, FallbackAction.BLOCK)]
+    )
+    client = FailoverTokenClient(
+        [("127.0.0.1", primary_port), ("127.0.0.1", standby_port)],
+        timeout_ms=200, failure_threshold=1, fallback=policy,
+    )
+    period = 1.0 / drive_rate
+    admitted_fill = admitted_post = resolved = standby_blocks = 0
+    fill_rate = None
+    repl_lag_live = False
+    converge_ms = None
+    over_admission = budget = 0
+    standby_metrics = {}
+    try:
+        # warm until the primary serves, then scrape its sender-side
+        # replication gauges while it is still alive
+        warm_deadline = time.monotonic() + 30.0
+        while time.monotonic() < warm_deadline:
+            if client.request_token(DRILL_FLOW).ok:
+                admitted_fill += 1
+                break
+        else:
+            failures.append("primary never served before the kill")
+        # fill phase: paced admissions to the middle of the window
+        t_fill = time.monotonic()
+        next_t = t_fill
+        while admitted_fill < count / 2:
+            next_t += period
+            time.sleep(max(0.0, next_t - time.monotonic()))
+            r = client.request_token(DRILL_FLOW)
+            resolved += 1
+            if r.ok:
+                admitted_fill += 1
+            if time.monotonic() - t_fill > 5.0:
+                failures.append("fill phase never reached count/2")
+                break
+        fill_wall = max(time.monotonic() - t_fill, 1e-6)
+        fill_rate = admitted_fill / fill_wall
+
+        def _shipped(body: str) -> float:
+            needle = 'sentinel_repl_deltas_total{event="shipped"}'
+            for line in body.splitlines():
+                if line.startswith(needle):
+                    return float(line.split()[-1])
+            return 0.0
+
+        # grace: under dispatch load the sender's effective cadence can
+        # stretch well past repl_interval_ms (the delta collector contends
+        # with the dispatch hot path for the service lock), so "kill one
+        # interval after the last request" would measure scheduler noise,
+        # not replication. Instead keep the window live at a low rate and
+        # watch the shipped counter. One increment is not enough: that
+        # delta may have been CAPTURED mid-fill and merely acked late (a
+        # slow ship under load), silently missing the fill's tail. Two
+        # increments past the baseline guarantee coverage — the second
+        # delta is captured after the first one's post-fill ack, so it
+        # includes every fill admission. Kill right after it.
+        base_shipped = cur_shipped = 0.0
+        if primary_mport:
+            try:
+                body = _scrape(primary_mport)
+            except Exception as e:
+                failures.append(f"primary metrics scrape failed: {e!r}")
+                body = ""
+            repl_lag_live = "sentinel_repl_lag_ms" in body
+            base_shipped = cur_shipped = _shipped(body)
+            grace_deadline = time.monotonic() + 2.0
+            while time.monotonic() < grace_deadline:
+                if client.request_token(DRILL_FLOW).ok:
+                    admitted_fill += 1
+                resolved += 1
+                try:
+                    cur_shipped = _shipped(_scrape(primary_mport))
+                except Exception:
+                    pass
+                if cur_shipped >= base_shipped + 2:
+                    break
+                time.sleep(0.05)
+            if cur_shipped <= 0:
+                failures.append("primary never shipped a delta")
+
+        # the kill: right after an acked delta ship
+        primary_proc.kill()
+        primary_proc.wait()
+        t_kill = time.monotonic()
+        # drive through the outage at the same pace; the watchdog promotes
+        # the standby, the client walks over, and the half-spent window
+        # keeps being enforced
+        # bounded so warm+fill+grace+outage stays inside one window: a
+        # token admitted at t leaves the rolling window no sooner than
+        # t+4.5s (bucket rotation), after which capacity would silently
+        # refill and pollute the over-admission measure
+        next_t = time.monotonic()
+        while time.monotonic() - t_kill < promote_after_ms / 1000.0 + 1.5:
+            next_t += period
+            time.sleep(max(0.0, next_t - time.monotonic()))
+            r = client.request_token(DRILL_FLOW)  # must never raise
+            resolved += 1
+            if r is None:
+                failures.append("request returned None")
+                continue
+            on_standby = (
+                str(client.active_endpoint) == f"127.0.0.1:{standby_port}"
+            )
+            if r.ok:
+                admitted_post += 1
+                if on_standby and converge_ms is None:
+                    converge_ms = (time.monotonic() - t_kill) * 1e3
+            elif on_standby and r.status == TokenStatus.BLOCKED:
+                standby_blocks += 1
+        total_admitted = admitted_fill + admitted_post
+        # staleness budget: what one lost ship interval can re-admit, at
+        # the measured fill rate (+1 in-flight batch of slack)
+        budget = int(fill_rate * repl_interval_ms / 1000.0) + 2
+        over_admission = max(0, int(total_admitted - count))
+        if converge_ms is None:
+            failures.append("standby never served after the kill")
+        if over_admission > budget:
+            failures.append(
+                f"over-admitted {over_admission} tokens "
+                f"(budget {budget} = one {repl_interval_ms:.0f}ms ship "
+                f"interval at {fill_rate:.0f}/s)"
+            )
+        if not standby_blocks:
+            failures.append(
+                "promoted standby never blocked — replicated window state "
+                "was not enforced"
+            )
+        if standby_mport:
+            try:
+                body = _scrape(standby_mport)
+            except Exception as e:
+                failures.append(f"standby metrics scrape failed: {e!r}")
+                body = ""
+            prefix = "sentinel_repl_deltas_total{event="
+            for line in body.splitlines():
+                if line.startswith(prefix):
+                    key = line[len(prefix):].split("}")[0].strip('"')
+                    standby_metrics[key] = float(line.split()[-1])
+            if standby_metrics.get("promoted", 0) < 1:
+                failures.append("standby metrics show no promotion event")
+            if standby_metrics.get("applied", 0) < 1:
+                failures.append("standby metrics show no applied delta")
+    finally:
+        client.close()
+        for proc in (primary_proc, standby_proc):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    return {
+        "window_tokens": count,
+        "rule_qps": rule_qps,
+        "repl_interval_ms": repl_interval_ms,
+        "fill_rate_vps": round(fill_rate, 1) if fill_rate else None,
+        "admitted_before_kill": admitted_fill,
+        "admitted_after_kill": admitted_post,
+        "over_admission": over_admission,
+        "staleness_budget": budget,
+        "promote_convergence_ms": (
+            round(converge_ms, 1) if converge_ms is not None else None
+        ),
+        "standby_blocks": standby_blocks,
+        "requests_resolved": resolved,
+        "repl_lag_gauge_live": repl_lag_live,
+        "standby_repl_events": standby_metrics,
+        "failures": failures,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true",
@@ -332,15 +595,27 @@ def main() -> None:
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--skip-overload", action="store_true",
                     help="run only the kill/failover phases")
+    ap.add_argument("--skip-replication", action="store_true",
+                    help="skip the warm-standby replication drill")
+    # child-role flags (used with --serve)
+    ap.add_argument("--standby-of", default=None)
+    ap.add_argument("--promote-after-ms", type=float, default=None)
+    ap.add_argument("--replicate-to", default=None)
+    ap.add_argument("--repl-interval-ms", type=float, default=None)
+    ap.add_argument("--count", type=float, default=1e9)
+    ap.add_argument("--bucket-ms", type=int, default=100)
     args = ap.parse_args()
     if args.serve:
-        _serve_forever()
+        _serve_forever(args)
         return
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     t0 = time.time()
     doc = run_drill(deadline_ms=args.deadline_ms)
+    if not args.skip_replication:
+        doc["replication"] = run_replication_drill()
+        doc["failures"] = doc["failures"] + doc["replication"]["failures"]
     if not args.skip_overload:
         doc["overload"] = run_overload_drill()
         doc["failures"] = doc["failures"] + doc["overload"]["failures"]
@@ -355,6 +630,16 @@ def main() -> None:
         f"{doc['fallback_requests']} all-down requests resolved "
         f"(blocked rate {doc['fallback_blocked_rate']:.2f})"
     )
+    if "replication" in doc:
+        rep = doc["replication"]
+        print(
+            f"replication drill ok: over-admitted {rep['over_admission']} "
+            f"of {rep['window_tokens']:.0f} window tokens "
+            f"(budget {rep['staleness_budget']}), standby promoted and "
+            f"served in {rep['promote_convergence_ms']}ms, "
+            f"{rep['standby_blocks']} post-promotion blocks, "
+            f"repl lag gauge live={rep['repl_lag_gauge_live']}"
+        )
     if "overload" in doc:
         ovl = doc["overload"]
         print(

@@ -33,6 +33,24 @@ n × ``(flow_id:int64, count:int32, priority:uint8)``; response data =
 Verdict order matches request order. Encode/decode are vectorized (numpy
 structured dtypes, or the native C codec when built) — per-request Python
 cost is what capped the round-2 front door at ~5k rps.
+
+Codec rev 3 — replication frames (``sentinel_tpu.ha.replication``): a
+primary token server streams state to warm standbys over the SAME wire as
+the data plane (both front doors route the new type bytes to their control
+planes; the C++ door forwards every non-data-plane type untouched, so no
+native rebuild is needed):
+
+- ``REPL_HELLO``: ``gen:int64, epoch_ms:int64, last_seq:int64`` + a UTF-8
+  sender id — the primary's sync probe; the standby's REPL_ACK answer says
+  whether it can take deltas for this (generation, epoch) or needs a full
+  snapshot first.
+- ``REPL_DELTA`` / ``REPL_SNAPSHOT``: a zlib blob (JSON document) CHUNKED
+  across frames — ``gen:int64, seq:int64, idx:uint16, total:uint16`` +
+  chunk bytes; a full snapshot easily exceeds the 2-byte frame cap, and
+  chunking keeps replication inside MAX_FRAME instead of forking the
+  length prefix. The standby acks once the last chunk lands.
+- ``REPL_ACK``: ``code:uint8, gen:int64, seq:int64`` — OK / NEED_SNAPSHOT
+  (resync) / NOT_STANDBY (promoted or misconfigured peer) / ERROR.
 """
 
 from __future__ import annotations
@@ -78,6 +96,35 @@ class MsgType(enum.IntEnum):
     CONCURRENT_ACQUIRE = 3
     CONCURRENT_RELEASE = 4
     BATCH_FLOW = 5
+    # codec rev 3: primary → standby state replication (control plane)
+    REPL_HELLO = 6
+    REPL_DELTA = 7
+    REPL_ACK = 8
+    REPL_SNAPSHOT = 9
+
+
+# front doors route these type bytes to the replication applier instead of
+# decode_request (which rejects them — they are not request frames)
+REPL_TYPES = frozenset(
+    {MsgType.REPL_HELLO, MsgType.REPL_DELTA, MsgType.REPL_ACK,
+     MsgType.REPL_SNAPSHOT}
+)
+
+
+class ReplAck(enum.IntEnum):
+    """REPL_ACK codes."""
+
+    OK = 0
+    NEED_SNAPSHOT = 1  # gen/epoch mismatch or no sync yet: full resync first
+    NOT_STANDBY = 2  # peer is promoted (or never was a standby)
+    ERROR = 3  # frame understood but apply failed; sender resyncs
+
+
+_REPL_HELLO = struct.Struct(">qqq")  # gen, epoch_ms, last_seq
+_REPL_ACK = struct.Struct(">Bqq")  # code, gen, seq
+_REPL_CHUNK = struct.Struct(">qqHH")  # gen, seq, idx, total
+# room left in one frame for a delta/snapshot chunk's bytes
+REPL_CHUNK_BYTES = MAX_FRAME - _HEAD.size - _REPL_CHUNK.size
 
 
 _NATIVE = None
@@ -290,6 +337,116 @@ def decode_batch_response(payload: bytes):
 def peek_type(payload: bytes) -> int:
     """Message type byte without a full decode (IO-thread fast path)."""
     return payload[4]
+
+
+def peek_xid(payload: bytes) -> int:
+    """Frame xid without a full decode (error-ack paths)."""
+    (xid,) = struct.unpack_from(">i", payload, 0)
+    return xid
+
+
+# -- codec rev 3: replication frames -----------------------------------------
+def encode_repl_hello(
+    xid: int, gen: int, epoch_ms: int, last_seq: int, sender_id: str = ""
+) -> bytes:
+    payload = (
+        _HEAD.pack(xid, MsgType.REPL_HELLO)
+        + _REPL_HELLO.pack(gen, epoch_ms, last_seq)
+        + sender_id.encode("utf-8")[:256]
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_repl_hello(payload: bytes):
+    """REPL_HELLO payload → (xid, gen, epoch_ms, last_seq, sender_id)."""
+    xid, _ = _HEAD.unpack_from(payload, 0)
+    gen, epoch_ms, last_seq = _REPL_HELLO.unpack_from(payload, _HEAD.size)
+    sender = payload[_HEAD.size + _REPL_HELLO.size :].decode(
+        "utf-8", errors="replace"
+    )
+    return xid, gen, epoch_ms, last_seq, sender
+
+
+def encode_repl_ack(xid: int, code: int, gen: int, seq: int) -> bytes:
+    payload = _HEAD.pack(xid, MsgType.REPL_ACK) + _REPL_ACK.pack(
+        int(code), gen, seq
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_repl_ack(payload: bytes):
+    """REPL_ACK payload → (xid, code, gen, seq)."""
+    xid, _ = _HEAD.unpack_from(payload, 0)
+    code, gen, seq = _REPL_ACK.unpack_from(payload, _HEAD.size)
+    return xid, ReplAck(code), gen, seq
+
+
+def encode_repl_blob(
+    xid: int, msg_type: int, gen: int, seq: int, blob: bytes
+) -> List[bytes]:
+    """One replication document (already compressed) → its chunk frames.
+
+    Every chunk carries (gen, seq, idx, total) so the standby can reassemble
+    and DETECT a torn stream: a chunk whose (gen, seq) doesn't extend the
+    in-progress assembly restarts it. An empty blob still emits one chunk
+    (total=1) — an empty delta is the sender's liveness heartbeat."""
+    if msg_type not in (MsgType.REPL_DELTA, MsgType.REPL_SNAPSHOT):
+        raise ValueError(f"not a repl blob type: {msg_type}")
+    total = max(1, -(-len(blob) // REPL_CHUNK_BYTES))
+    if total > 0xFFFF:
+        raise ValueError(f"repl blob needs {total} chunks (cap 65535)")
+    frames = []
+    for idx in range(total):
+        chunk = blob[idx * REPL_CHUNK_BYTES : (idx + 1) * REPL_CHUNK_BYTES]
+        payload = (
+            _HEAD.pack(xid, msg_type)
+            + _REPL_CHUNK.pack(gen, seq, idx, total)
+            + chunk
+        )
+        frames.append(_LEN.pack(len(payload)) + payload)
+    return frames
+
+
+def decode_repl_chunk(payload: bytes):
+    """REPL_DELTA/REPL_SNAPSHOT payload → (xid, gen, seq, idx, total,
+    chunk bytes). Raises ``ValueError`` on a runt payload."""
+    if len(payload) < _HEAD.size + _REPL_CHUNK.size:
+        raise ValueError("runt repl chunk")
+    xid, _ = _HEAD.unpack_from(payload, 0)
+    gen, seq, idx, total = _REPL_CHUNK.unpack_from(payload, _HEAD.size)
+    if total == 0 or idx >= total:
+        raise ValueError(f"bad repl chunk index {idx}/{total}")
+    return xid, gen, seq, idx, total, payload[_HEAD.size + _REPL_CHUNK.size :]
+
+
+class ReplBlobAssembler:
+    """Reassembles chunked replication blobs on the standby side.
+
+    ``feed`` returns ``(msg_type, gen, seq, blob)`` once the last chunk of a
+    document lands, else None. Out-of-order or interleaved chunks restart
+    the assembly (the repl channel is one TCP stream per sender — a gap can
+    only mean the stream was torn and resumed); a malformed chunk raises
+    ``ValueError`` so the server can drop the connection."""
+
+    def __init__(self):
+        self._key = None  # (msg_type, gen, seq, total)
+        self._parts: List[bytes] = []
+
+    def feed(self, msg_type: int, payload: bytes):
+        _xid, gen, seq, idx, total, chunk = decode_repl_chunk(payload)
+        key = (int(msg_type), gen, seq, total)
+        if idx == 0:
+            self._key, self._parts = key, [chunk]
+        elif self._key == key and idx == len(self._parts):
+            self._parts.append(chunk)
+        else:
+            self._key, self._parts = None, []
+            raise ValueError("torn repl chunk stream")
+        if len(self._parts) == total:
+            blob = b"".join(self._parts)
+            self._key, self._parts = None, []
+            return int(msg_type), gen, seq, blob
+        return None
 
 
 def encode_response(rsp: FlowResponse) -> bytes:

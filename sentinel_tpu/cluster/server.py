@@ -33,7 +33,7 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,6 +49,7 @@ from sentinel_tpu.overload import AdmissionController, BrownoutLevel
 
 _SM = server_metrics()
 _OVERLOAD = int(TokenStatus.OVERLOAD)
+_STANDBY = int(TokenStatus.STANDBY)
 
 
 class _BatchFrame:
@@ -154,6 +155,7 @@ class _LoopWorker:
         frames = P.FrameReader()
         peer = writer.get_extra_info("peername")
         address = f"{peer[0]}:{peer[1]}" if peer else repr(writer)
+        repl_session = None  # per-connection rev-3 chunk reassembly, lazy
         loop = asyncio.get_running_loop()
         srv.connections.attach_closer(
             address, lambda: loop.call_soon_threadsafe(writer.close)
@@ -175,6 +177,25 @@ class _LoopWorker:
                         _SM.count_shed("chaos_drop", 1)
                         continue
                     mtype = P.peek_type(payload)
+                    if mtype in P.REPL_TYPES:
+                        # wire rev 3 (replication control plane): the
+                        # primary's sender speaks to this door directly.
+                        # Non-standby servers close — a repl frame here
+                        # means a misconfigured sender.
+                        if srv.applier is None:
+                            record_log.warning(
+                                "repl frame on non-standby server; closing"
+                            )
+                            return
+                        if repl_session is None:
+                            repl_session = srv.applier.connection()
+                        try:
+                            repl_session.handle(payload, writer.write)
+                        except ValueError:
+                            record_log.warning("torn repl stream; closing")
+                            return
+                        await writer.drain()
+                        continue
                     if mtype == P.MsgType.BATCH_FLOW:
                         # vectorized decode; no per-request Python objects
                         try:
@@ -184,6 +205,21 @@ class _LoopWorker:
                             return
                         srv.connections.touch(address)
                         k = len(item.flow_ids)
+                        if srv.is_standby:
+                            # redirect-style refusal: this node replicates
+                            # from a live primary and must not double-count
+                            # — the failover client walks on (STANDBY is
+                            # proof of life, not failure)
+                            writer.write(
+                                P.encode_batch_response(
+                                    item.xid,
+                                    np.full(k, _STANDBY, np.int8),
+                                    np.zeros(k, np.int32),
+                                    np.zeros(k, np.int32),
+                                )
+                            )
+                            await writer.drain()
+                            continue
                         if (
                             srv.max_queue
                             and self.queue.qsize() >= srv.max_queue
@@ -239,6 +275,17 @@ class _LoopWorker:
                         await writer.drain()
                     else:
                         srv.connections.touch(address)
+                        if srv.is_standby:
+                            writer.write(
+                                P.encode_response(
+                                    P.FlowResponse(
+                                        req.xid, req.msg_type, _STANDBY,
+                                        0, 0,
+                                    )
+                                )
+                            )
+                            await writer.drain()
+                            continue
                         if (
                             srv.max_queue
                             and self.queue.qsize() >= srv.max_queue
@@ -641,6 +688,10 @@ class TokenServer:
         snapshot_period_s: Optional[float] = None,
         max_queue: int = 8192,
         overload: Optional[AdmissionController] = None,
+        standby_of: Optional[str] = None,
+        promote_after_ms: Optional[float] = None,
+        replicate_to: Optional[Sequence] = None,
+        repl_interval_ms: Optional[float] = None,
     ):
         self.service = service
         self.host = host
@@ -698,6 +749,20 @@ class TokenServer:
         ) or None
         self.snapshot_period_s = snapshot_period_s
         self._snapshots = None
+        # warm-standby replication roles (ha.replication). standby_of= makes
+        # this a STANDBY: the front door answers data-plane traffic with
+        # TokenStatus.STANDBY until promoted, while rev-3 frames from the
+        # primary named here (informational label) stream state in through
+        # a StandbyApplier. replicate_to= makes this a PRIMARY shipping
+        # deltas to the listed standby addresses. The roles compose — a
+        # promoted standby can itself replicate onward — but a server is
+        # normally one or the other.
+        self.standby_of = standby_of
+        self.promote_after_ms = promote_after_ms
+        self.replicate_to = list(replicate_to) if replicate_to else None
+        self.repl_interval_ms = repl_interval_ms
+        self.applier = None  # StandbyApplier while in standby mode
+        self.replicator = None  # ReplicationSender while primary
 
     def tuning_kwargs(self) -> dict:
         """Operator-tunable constructor kwargs, for rebuilding this server on
@@ -716,7 +781,26 @@ class TokenServer:
             snapshot_period_s=self.snapshot_period_s,
             max_queue=self.max_queue,
             overload=self.overload,
+            standby_of=self.standby_of,
+            promote_after_ms=self.promote_after_ms,
+            replicate_to=self.replicate_to,
+            repl_interval_ms=self.repl_interval_ms,
         )
+
+    # -- warm-standby role ---------------------------------------------------
+    @property
+    def is_standby(self) -> bool:
+        """True while the front door refuses data-plane traffic (standby
+        mode, not yet promoted)."""
+        applier = self.applier
+        return applier is not None and not applier.promoted
+
+    def promote(self, reason: str = "manual") -> bool:
+        """Open the front door of a standby. Returns False when this server
+        is not a standby or is already promoted."""
+        if self.applier is None:
+            return False
+        return self.applier.promote(reason)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -742,6 +826,14 @@ class TokenServer:
         reopen = getattr(self.service, "reopen", None)
         if reopen is not None:
             reopen()  # re-arm background sweeps a prior stop() released
+        if self.standby_of is not None and self.applier is None:
+            from sentinel_tpu.ha.replication import StandbyApplier
+
+            # armed BEFORE the listener: the first frame a standby sees may
+            # be the primary's REPL_HELLO
+            self.applier = StandbyApplier(
+                self.service, promote_after_ms=self.promote_after_ms,
+            ).start()
         if self.profile_dir:
             try:
                 self.profiler.start(self.profile_dir)
@@ -803,8 +895,24 @@ class TokenServer:
                 self.service, self.snapshot_dir,
                 period_s=self.snapshot_period_s,
             ).start()
+        if self.replicate_to and hasattr(self.service, "export_delta"):
+            from sentinel_tpu.ha.replication import ReplicationSender
+
+            self.replicator = ReplicationSender(
+                self.service, self.replicate_to,
+                interval_ms=self.repl_interval_ms,
+                sender_id=f"{self.host}:{self.port}",
+            ).start()
 
     def stop(self) -> None:
+        # replication teardown first: the sender must not race the service
+        # close, and a standby's watchdog must not promote mid-shutdown
+        if self.replicator is not None:
+            self.replicator.stop()
+            self.replicator = None
+        if self.applier is not None:
+            self.applier.stop()
+            self.applier = None
         if self._snapshots is not None:
             # final save: the artifact a restarted primary (or a standby
             # picking up this node's directory) restores from

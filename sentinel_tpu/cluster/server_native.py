@@ -39,7 +39,7 @@ import os
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -55,6 +55,7 @@ from sentinel_tpu.overload import AdmissionController, BrownoutLevel
 
 _SM = server_metrics()
 _OVERLOAD = int(TokenStatus.OVERLOAD)
+_STANDBY = int(TokenStatus.STANDBY)
 
 
 def native_available() -> bool:
@@ -85,6 +86,10 @@ class NativeTokenServer:
         shed_age_ms: Optional[float] = 1000.0,
         drain_timeout_s: float = 10.0,
         overload: Optional[AdmissionController] = None,
+        standby_of: Optional[str] = None,
+        promote_after_ms: Optional[float] = None,
+        replicate_to: Optional[Sequence] = None,
+        repl_interval_ms: Optional[float] = None,
     ):
         from sentinel_tpu.native.lib import Frontdoor  # raises if unbuilt
 
@@ -142,6 +147,17 @@ class NativeTokenServer:
         ) or None
         self.snapshot_period_s = snapshot_period_s
         self._snapshots = None
+        # warm-standby replication roles: same contract as TokenServer —
+        # standby_of= refuses data-plane traffic with TokenStatus.STANDBY
+        # until promoted while rev-3 frames stream state in; replicate_to=
+        # ships deltas out (see cluster/server.py for the full rationale)
+        self.standby_of = standby_of
+        self.promote_after_ms = promote_after_ms
+        self.replicate_to = list(replicate_to) if replicate_to else None
+        self.repl_interval_ms = repl_interval_ms
+        self.applier = None
+        self.replicator = None
+        self._repl_sessions: dict = {}  # (fd, gen) → ReplSession
 
     def tuning_kwargs(self) -> dict:
         return dict(
@@ -158,7 +174,25 @@ class NativeTokenServer:
             shed_age_ms=self.shed_age_ms,
             drain_timeout_s=self.drain_timeout_s,
             overload=self.overload,
+            standby_of=self.standby_of,
+            promote_after_ms=self.promote_after_ms,
+            replicate_to=self.replicate_to,
+            repl_interval_ms=self.repl_interval_ms,
         )
+
+    @property
+    def is_standby(self) -> bool:
+        """True while this server refuses data-plane traffic (unpromoted
+        warm standby)."""
+        return self.applier is not None and not self.applier.promoted
+
+    def promote(self, reason: str = "manual") -> bool:
+        """Promote a standby to serving. Returns True if the server was a
+        standby and is now (or already was) promoted."""
+        if self.applier is None:
+            return False
+        self.applier.promote(reason)
+        return True
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -175,6 +209,14 @@ class NativeTokenServer:
         reopen = getattr(self.service, "reopen", None)
         if reopen is not None:
             reopen()
+        if self.standby_of is not None:
+            # before the listener: the first control frame a standby sees
+            # may be the primary's REPL_HELLO
+            from sentinel_tpu.ha.replication import StandbyApplier
+
+            self.applier = StandbyApplier(
+                self.service, promote_after_ms=self.promote_after_ms,
+            ).start()
         self._stop.clear()
         self._intake_stop.clear()
         self._abandon.clear()
@@ -254,6 +296,14 @@ class NativeTokenServer:
                 self.service, self.snapshot_dir,
                 period_s=self.snapshot_period_s,
             ).start()
+        if self.replicate_to and hasattr(self.service, "export_delta"):
+            from sentinel_tpu.ha.replication import ReplicationSender
+
+            self.replicator = ReplicationSender(
+                self.service, self.replicate_to,
+                interval_ms=self.repl_interval_ms,
+                sender_id=f"{self.host}:{self.port}",
+            ).start()
         record_log.info(
             "native token server listening on %s:%d (%d dispatchers)",
             self.host, self.port, self.n_dispatchers,
@@ -262,6 +312,13 @@ class NativeTokenServer:
     def stop(self) -> None:
         if self._door is None:
             return
+        if self.replicator is not None:
+            self.replicator.stop()
+            self.replicator = None
+        if self.applier is not None:
+            self.applier.stop()
+            self.applier = None
+        self._repl_sessions.clear()
         if self._snapshots is not None:
             self._snapshots.stop(final_save=True)
             self._snapshots = None
@@ -371,6 +428,22 @@ class NativeTokenServer:
                 time.monotonic(),
             )
             n = len(ids)
+            if self.is_standby:
+                # unpromoted warm standby: data plane is closed. Refuse the
+                # whole pull with STANDBY so the failover client walks on to
+                # the live primary (no retry hint — this is not backpressure)
+                _SM.count_shed("standby", n)
+                status = np.full(n, _STANDBY, np.int8)
+                _SM.record_verdict_batch(status, None, ())
+                try:
+                    door.submit(
+                        pull[3], status, np.zeros(n, np.int32),
+                        np.zeros(n, np.int32),
+                    )
+                except Exception:
+                    if not self._stop.is_set():
+                        record_log.exception("native standby submit failed")
+                continue
             _SM.batch_size.record(n)
             self.overload.note_enqueued(n)
             give_up = (
@@ -621,10 +694,38 @@ class NativeTokenServer:
                     address = self._addr_by_conn.pop((fd, gen), None)
                 if address:
                     self.connections.remove_address(address)
+                self._repl_sessions.pop((fd, gen), None)
                 continue
             # kind == CTRL_FRAME: a non-data-plane request
             with self._addr_lock:
                 address = self._addr_by_conn.get((fd, gen), f"fd{fd}")
+            # rev-3 replication frames ride the control lane but are not
+            # requests (decode_request would reject their type bytes) —
+            # route them to the standby applier's per-connection session
+            if len(payload) >= 5 and P.peek_type(payload) in P.REPL_TYPES:
+                if self.applier is None:
+                    record_log.warning(
+                        "repl frame on non-standby server; closing %s",
+                        address,
+                    )
+                    door.close_conn(fd, gen)
+                    continue
+                sess = self._repl_sessions.get((fd, gen))
+                if sess is None:
+                    sess = self.applier.connection()
+                    self._repl_sessions[(fd, gen)] = sess
+                try:
+                    sess.handle(
+                        payload, lambda b, fd=fd, gen=gen: door.send(
+                            fd, gen, b
+                        ),
+                    )
+                except ValueError:
+                    record_log.warning("torn repl stream; closing %s",
+                                       address)
+                    self._repl_sessions.pop((fd, gen), None)
+                    door.close_conn(fd, gen)
+                continue
             try:
                 req = P.decode_request(payload)
             except Exception:
@@ -648,6 +749,10 @@ class NativeTokenServer:
             count = self.connections.add(req.namespace, address)
             return P.FlowResponse(req.xid, P.MsgType.PING, 0, remaining=count)
         self.connections.touch(address)
+        if self.is_standby:
+            # control-lane verdicts get the same closed-door refusal as the
+            # data plane (PING above still answers: standbys stay pingable)
+            return P.FlowResponse(req.xid, req.msg_type, _STANDBY)
         if req.msg_type == P.MsgType.PARAM_FLOW:
             r = service.request_params_token(
                 req.flow_id, req.count, req.param_hashes

@@ -290,6 +290,15 @@ class DefaultTokenService(TokenService):
 
         self.concurrency = ConcurrencyManager()
         self._expiry = None  # background sweep; started on first rule load
+        # warm-standby replication hooks (ha.replication): dirty-slot sets
+        # collected by the dispatch paths since the last export_delta().
+        # None until replication_enable() — the serving hot path pays one
+        # `is not None` check when no standby is attached. _state_gen bumps
+        # on every rule/param-rule reload: slot assignments (the delta's
+        # row keys) are only stable within a generation, so a bump tells
+        # the sender to re-bootstrap standbys with a full snapshot.
+        self._state_gen = 0
+        self._dirty: Optional[Dict[str, set]] = None
 
     @staticmethod
     def _prep_batch(cfg, slots, acq, pr):
@@ -435,6 +444,12 @@ class DefaultTokenService(TokenService):
                     self._index.ns_of[r.namespace]
                 )
             self._ns_snapshot = (tuple(ns_names), slot_ns)
+            # slot assignments may have moved: deltas collected against the
+            # old generation are meaningless, so drop them and force the
+            # replication sender into a full-snapshot resync
+            self._state_gen += 1
+            if self._dirty is not None:
+                self._dirty = {"flow": set(), "param": set()}
 
     def load_namespace_rules(
         self, namespace: str, rules: List[ClusterFlowRule]
@@ -703,6 +718,10 @@ class DefaultTokenService(TokenService):
             self._state, verdicts = step(
                 self._state, self._table, batch, np.int32(now)
             )
+            if self._dirty is not None:
+                self._dirty["flow"].update(
+                    np.unique(slots[slots >= 0]).tolist()
+                )
 
         def _materialize():
             # blocks on the async dispatch; runs outside the lock
@@ -853,6 +872,11 @@ class DefaultTokenService(TokenService):
             self._state, verdicts = step(
                 self._state, self._table, stacked, np.int32(now)
             )
+            if self._dirty is not None:
+                span = np.concatenate([p[0] for p in preps])
+                self._dirty["flow"].update(
+                    np.unique(span[span >= 0]).tolist()
+                )
         _SM.record_fused(depth)
 
         def _materialize():
@@ -945,6 +969,11 @@ class DefaultTokenService(TokenService):
                 items = dict(rule.item_thresholds or ())
                 self._param_rules[rule.flow_id] = (slot, rule.count, items)
             self._param_rules_src = {r.flow_id: r for r in rules}
+            # same resync discipline as load_rules: param slot moves/frees
+            # invalidate any delta collected against the old generation
+            self._state_gen += 1
+            if self._dirty is not None:
+                self._dirty = {"flow": set(), "param": set()}
 
     def load_namespace_param_rules(
         self, namespace: str, rules: List[ClusterParamFlowRule]
@@ -1017,6 +1046,8 @@ class DefaultTokenService(TokenService):
                 jnp.asarray(valid),
                 jnp.int32(now),
             )
+            if self._dirty is not None:
+                self._dirty["param"].add(int(slot))
         if bool(np.asarray(admit)[:n].all()):
             return TokenResult(TokenStatus.OK)
         return TokenResult(TokenStatus.BLOCKED)
@@ -1187,6 +1218,188 @@ class DefaultTokenService(TokenService):
                 # advancing, so windows older than interval_ms expire on the
                 # next read instead of resurrecting stale quota
                 self._epoch_ms = int(state["epoch_ms"])
+
+    # -- warm-standby delta replication (ha.replication backing) -------------
+    def replication_enable(self) -> None:
+        """Arm dirty-slot tracking so :meth:`export_delta` has rows to ship.
+        Idempotent; until called the dispatch paths skip the bookkeeping."""
+        with self._lock:
+            if self._dirty is None:
+                self._dirty = {"flow": set(), "param": set()}
+
+    def replication_disable(self) -> None:
+        with self._lock:
+            self._dirty = None
+
+    def state_generation(self) -> int:
+        """Bumped on every rule/param-rule reload. Deltas are row-keyed by
+        slot assignments that only hold within one generation; a sender that
+        observes a bump must ship a full snapshot before more deltas."""
+        with self._lock:
+            return self._state_gen
+
+    def export_delta(self) -> Dict[str, object]:
+        """Collect-and-clear the dirty counter rows since the last call.
+
+        Returns a compact host-side document: the shared window ``starts``
+        ring vectors (``[n_buckets]`` each — always shipped, they advance
+        with engine time), plus per-dirty-slot ``counts`` rows keyed by
+        flow_id / namespace name / param flow_id so the standby can land
+        them on its OWN slot assignment. ``gen`` is the generation the rows
+        were collected under; ``epoch_ms`` pins the engine timeline the
+        starts are relative to (the standby refuses a delta from a foreign
+        epoch). An idle tick returns a starts-only document — the sender's
+        liveness heartbeat. Destructive: the dirty sets are cleared, so a
+        sender that fails to deliver must fall back to a full snapshot."""
+        with self._rules_mutex, self._lock:
+            if self._dirty is None:
+                raise RuntimeError("replication tracking not enabled")
+            flow_slots = sorted(self._dirty["flow"])
+            param_slots = sorted(self._dirty["param"])
+            self._dirty = {"flow": set(), "param": set()}
+            now = self._engine_now()  # pins the epoch, runs a due rebase
+            delta: Dict[str, object] = {
+                "gen": int(self._state_gen),
+                "engine_now": int(now),
+                "epoch_ms": int(self._epoch_ms),
+                "wall_ms": int(_clock.now_ms()),
+                "flow_starts": np.asarray(self._state.flow.starts),
+                "occupy_starts": np.asarray(self._state.occupy.starts),
+                "ns_starts": np.asarray(self._state.ns.starts),
+                "param_starts": np.asarray(self._param_state.starts),
+            }
+            if flow_slots:
+                sl = np.asarray(flow_slots, np.int32)
+                rev = {v: k for k, v in self._index.slot_of.items()}
+                delta["flow_ids"] = [int(rev[s]) for s in flow_slots]
+                # one fancy-indexed device gather per tensor, host-copied
+                delta["flow_counts"] = np.asarray(self._state.flow.counts[sl])
+                delta["occupy_counts"] = np.asarray(
+                    self._state.occupy.counts[sl]
+                )
+                # namespace guard rows these slots feed
+                ns_names, slot_ns = self._ns_snapshot
+                rows = sorted(
+                    {int(slot_ns[s]) for s in flow_slots if slot_ns[s] >= 0}
+                )
+                if rows:
+                    delta["ns_names"] = [ns_names[r] for r in rows]
+                    delta["ns_counts"] = np.asarray(
+                        self._state.ns.counts[np.asarray(rows, np.int32)]
+                    )
+            if param_slots:
+                pr = np.asarray(param_slots, np.int32)
+                prev = {
+                    s: fid for fid, (s, _, _) in self._param_rules.items()
+                }
+                delta["param_fids"] = [int(prev[s]) for s in param_slots]
+                delta["param_counts"] = np.asarray(
+                    self._param_state.counts[pr]
+                )
+            return delta
+
+    def apply_replication_delta(self, delta: Dict[str, object]) -> None:
+        """Scatter a primary's :meth:`export_delta` into THIS (standby)
+        service. Rows remap by flow_id / namespace / param flow_id onto the
+        local slot assignment — the standby loaded the same rules from the
+        bootstrap snapshot, but possibly in a different slot order. A delta
+        naming a flow this service doesn't know, or carrying a foreign
+        engine epoch, raises ``ValueError``: both mean the standby's base
+        state predates a reload on the primary, and the caller must answer
+        NEED_SNAPSHOT rather than apply rows against the wrong baseline."""
+        from sentinel_tpu.engine.state import EngineState as _ES
+        from sentinel_tpu.stats.window import WindowState as _WS
+
+        def _rotate(ws, new_starts):
+            """Mirror the primary's ring rotation on rows the delta does NOT
+            carry: when the primary advanced ``starts[b]`` it zeroed column
+            ``b`` for every resource (window.py rotation), so any local row
+            whose column still holds counts from the previous occupancy of
+            that ring slot must be zeroed too — otherwise applying the new
+            starts would resurrect those stale counts as current-window
+            traffic. Dirty rows are scattered with authoritative values
+            afterwards, so pre-zeroing them is harmless."""
+            changed = np.asarray(ws.starts) != np.asarray(new_starts)
+            if not changed.any():
+                return ws
+            keep = jnp.asarray((~changed).astype(np.int32))
+            shape = (1, keep.shape[0]) + (1,) * (ws.counts.ndim - 2)
+            return ws._replace(
+                counts=ws.counts * keep.reshape(shape).astype(
+                    ws.counts.dtype
+                )
+            )
+
+        with self._rules_mutex, self._lock:
+            if (
+                self._epoch_ms is None
+                or int(delta["epoch_ms"]) != self._epoch_ms
+            ):
+                raise ValueError("replication epoch mismatch")
+            flow = _rotate(self._state.flow, delta["flow_starts"])
+            occupy = _rotate(self._state.occupy, delta["occupy_starts"])
+            ns = _rotate(self._state.ns, delta["ns_starts"])
+            flow_ids = delta.get("flow_ids")
+            if flow_ids:
+                slots = []
+                for fid in flow_ids:
+                    s = self._index.slot_of.get(int(fid))
+                    if s is None:
+                        raise ValueError(f"delta names unknown flow {fid}")
+                    slots.append(s)
+                sl = jnp.asarray(np.asarray(slots, np.int32))
+                flow = flow._replace(
+                    counts=flow.counts.at[sl].set(
+                        jnp.asarray(delta["flow_counts"])
+                    )
+                )
+                occupy = occupy._replace(
+                    counts=occupy.counts.at[sl].set(
+                        jnp.asarray(delta["occupy_counts"])
+                    )
+                )
+            ns_names = delta.get("ns_names")
+            if ns_names:
+                rows = []
+                for name in ns_names:
+                    r = self._index.ns_of.get(name)
+                    if r is None:
+                        raise ValueError(
+                            f"delta names unknown namespace {name!r}"
+                        )
+                    rows.append(r)
+                nr = jnp.asarray(np.asarray(rows, np.int32))
+                ns = ns._replace(
+                    counts=ns.counts.at[nr].set(
+                        jnp.asarray(delta["ns_counts"])
+                    )
+                )
+            self._state = self._place_state(_ES(
+                flow=_WS(jnp.asarray(delta["flow_starts"]), flow.counts),
+                occupy=_WS(
+                    jnp.asarray(delta["occupy_starts"]), occupy.counts
+                ),
+                ns=_WS(jnp.asarray(delta["ns_starts"]), ns.counts),
+            ))
+            pstate = _rotate(self._param_state, delta["param_starts"])
+            pcounts = pstate.counts
+            param_fids = delta.get("param_fids")
+            if param_fids:
+                rows = []
+                for fid in param_fids:
+                    entry = self._param_rules.get(int(fid))
+                    if entry is None:
+                        raise ValueError(
+                            f"delta names unknown param rule {fid}"
+                        )
+                    rows.append(entry[0])
+                pr = jnp.asarray(np.asarray(rows, np.int32))
+                pcounts = pcounts.at[pr].set(
+                    jnp.asarray(delta["param_counts"])
+                )
+            self._param_state = self._param_state._replace(
+                starts=jnp.asarray(delta["param_starts"]), counts=pcounts,
+            )
 
     # -- introspection (FetchClusterMetricCommandHandler analog) ------------
     def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:

@@ -21,6 +21,28 @@ T = TypeVar("T")
 
 Converter = Callable[[S], T]
 
+# failed refreshes by datasource class, fed to the Prometheus surface as
+# ``sentinel_datasource_refresh_failures_total`` (metrics.exporter)
+_FAILURES_LOCK = threading.Lock()
+_REFRESH_FAILURES: Dict[str, int] = {}
+
+
+def _count_refresh_failure(source: "ReadableDataSource") -> None:
+    name = type(source).__name__
+    with _FAILURES_LOCK:
+        _REFRESH_FAILURES[name] = _REFRESH_FAILURES.get(name, 0) + 1
+
+
+def refresh_failure_totals() -> Dict[str, int]:
+    """Cumulative failed refreshes per datasource class."""
+    with _FAILURES_LOCK:
+        return dict(_REFRESH_FAILURES)
+
+
+def reset_refresh_failures_for_tests() -> None:
+    with _FAILURES_LOCK:
+        _REFRESH_FAILURES.clear()
+
 
 class ReadableDataSource(Generic[S, T]):
     """Parses a source value into rules and publishes into ``property``."""
@@ -35,11 +57,28 @@ class ReadableDataSource(Generic[S, T]):
     def load_config(self) -> Optional[T]:
         return self.converter(self.read_source())
 
-    def refresh(self) -> None:
+    def refresh(self) -> bool:
+        """One read→parse→publish cycle. Returns True on success. A failed
+        read or parse keeps the last-known-good config published (a broken
+        source must degrade to stale rules, never to NO rules) and counts
+        toward ``sentinel_datasource_refresh_failures_total``."""
         try:
-            self.property.update_value(self.load_config())
+            config = self.load_config()
         except Exception as e:
+            _count_refresh_failure(self)
             record_log.warning("datasource refresh failed: %s", e)
+            return False
+        if config is None and self.property.value is not None:
+            # a parse that yields nothing while good config is live is a
+            # failure (truncated file mid-write, empty GET on a flaky
+            # backend) — publishing None would wipe the rules
+            _count_refresh_failure(self)
+            record_log.warning(
+                "datasource refresh parsed no config; keeping last-known-good"
+            )
+            return False
+        self.property.update_value(config)
+        return True
 
     def close(self) -> None:
         pass
@@ -48,11 +87,19 @@ class ReadableDataSource(Generic[S, T]):
 class AutoRefreshDataSource(ReadableDataSource[S, T]):
     """Polls ``read_source`` on a background thread
     (``AutoRefreshDataSource.java:32``). Subclasses may override
-    ``is_modified`` to skip unchanged sources."""
+    ``is_modified`` to skip unchanged sources.
 
-    def __init__(self, converter: Converter, refresh_interval_s: float = 3.0):
+    Consecutive failures back the poll off exponentially (doubling per
+    failure, capped at ``backoff_cap_x`` times the configured interval) so a
+    dead backend is probed, not hammered; one success snaps the cadence
+    back."""
+
+    def __init__(self, converter: Converter, refresh_interval_s: float = 3.0,
+                 backoff_cap_x: float = 10.0):
         super().__init__(converter)
         self.refresh_interval_s = refresh_interval_s
+        self.backoff_cap_x = float(backoff_cap_x)
+        self._consecutive_failures = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -64,13 +111,26 @@ class AutoRefreshDataSource(ReadableDataSource[S, T]):
         self._thread.start()
         return self
 
+    def _poll_interval_s(self) -> float:
+        cap = self.refresh_interval_s * self.backoff_cap_x
+        return min(
+            self.refresh_interval_s * (2.0 ** self._consecutive_failures), cap
+        )
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.refresh_interval_s):
+        while not self._stop.wait(self._poll_interval_s()):
             try:
-                if self.is_modified():
-                    self.refresh()
+                if not self.is_modified():
+                    continue
+                ok = self.refresh()
             except Exception as e:
+                ok = False
+                _count_refresh_failure(self)
                 record_log.warning("datasource poll failed: %s", e)
+            if ok:
+                self._consecutive_failures = 0
+            else:
+                self._consecutive_failures += 1
 
     def is_modified(self) -> bool:
         return True

@@ -38,13 +38,13 @@ class FileRefreshableDataSource(AutoRefreshDataSource[str, object]):
             return True
         return False
 
-    def refresh(self) -> None:
+    def refresh(self) -> bool:
         try:
             st = os.stat(self.path)
             self._last_sig = (st.st_mtime_ns, st.st_size)
         except OSError:
             pass
-        super().refresh()
+        return super().refresh()
 
 
 class FileWritableDataSource(WritableDataSource):

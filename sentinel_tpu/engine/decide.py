@@ -61,6 +61,11 @@ class TokenStatus(enum.IntEnum):
     # server is alive and asks the caller to back off (wait_ms carries a
     # retry hint). Never produced by the device kernels.
     OVERLOAD = 8
+    # warm-standby refusal: the server answered instead of deciding because
+    # it is replicating from a primary and has not been promoted — clients
+    # should walk on to the (still-alive) primary. Like OVERLOAD, never
+    # produced by the device kernels.
+    STANDBY = 9
 
 
 class RequestBatch(NamedTuple):

@@ -19,6 +19,11 @@ of forgetting every in-window verdict.
   the periodic :class:`SnapshotManager`.
 - :mod:`~sentinel_tpu.ha.manager` — :class:`ClusterStateManager`, runtime
   client/server/off transitions that rewire the slot chain live.
+- :mod:`~sentinel_tpu.ha.replication` — warm-standby delta streaming:
+  :class:`ReplicationSender` ships dirty counter rows every tick over wire
+  rev 3; :class:`StandbyApplier` applies them behind a closed front door
+  until promotion, bounding failover loss at one ship interval instead of
+  one snapshot period.
 """
 
 from sentinel_tpu.ha.endpoints import Endpoint, EndpointHealth, HealthState
@@ -29,6 +34,7 @@ from sentinel_tpu.ha.fallback import (
     LocalFallbackPolicy,
 )
 from sentinel_tpu.ha.manager import ClusterStateManager
+from sentinel_tpu.ha.replication import ReplicationSender, StandbyApplier
 from sentinel_tpu.ha.snapshot import (
     SNAPSHOT_VERSION,
     SnapshotManager,
@@ -50,6 +56,8 @@ __all__ = [
     "FallbackRule",
     "LocalFallbackPolicy",
     "ClusterStateManager",
+    "ReplicationSender",
+    "StandbyApplier",
     "SNAPSHOT_VERSION",
     "SnapshotManager",
     "encode_snapshot",

@@ -134,6 +134,20 @@ class FailoverTokenClient(TokenService):
             )
         return False
 
+    @staticmethod
+    def _standby_refusal(result) -> bool:
+        """An unpromoted warm standby's closed-door refusal (STANDBY). Same
+        whole-batch rule as OVERLOAD: every row refused, or it's an
+        answer."""
+        if isinstance(result, TokenResult):
+            return result.status == TokenStatus.STANDBY
+        if isinstance(result, tuple) and len(result) == 3:
+            status = np.asarray(result[0])
+            return status.size > 0 and bool(
+                (status == int(TokenStatus.STANDBY)).all()
+            )
+        return False
+
     def _call(self, op: Callable, failed=None):
         """Walk available endpoints inside the deadline; ``op(member)``
         returns the raw result and ``failed(result)`` judges it. Returns the
@@ -144,7 +158,14 @@ class FailoverTokenClient(TokenService):
         (evicting an overloaded-but-alive server would dogpile the
         standbys) and the walk tries the next endpoint. When every
         reachable endpoint is overloaded the first OVERLOAD reply — with
-        its retry hint — is returned rather than degrading to fallback."""
+        its retry hint — is returned rather than degrading to fallback.
+
+        STANDBY replies are likewise proof of life: an unpromoted warm
+        standby keeps its door closed so clients walk on to the primary.
+        Unlike OVERLOAD, a STANDBY reply carries no verdict at all, so it
+        is never returned — if nothing else answers, the local fallback
+        decides (without counting the cluster as exhausted: the standby is
+        alive and about to promote)."""
         if failed is None:
             failed = lambda r: (
                 r is None
@@ -153,6 +174,7 @@ class FailoverTokenClient(TokenService):
             )
         deadline = _clock.now_ms() + self.deadline_ms
         overload_result = None
+        saw_standby = False
         for i, member in enumerate(self._members):
             # health is consulted immediately before dispatch, never up
             # front for the whole list: allows_request() may flip an OPEN
@@ -176,6 +198,12 @@ class FailoverTokenClient(TokenService):
                     break
                 continue
             member.health.record_success()
+            if self._standby_refusal(result):
+                saw_standby = True
+                ha_metrics().count_fallback("standby_redirect")
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
             if self._overloaded(result):
                 ha_metrics().count_fallback("overload_backoff")
                 if overload_result is None:
@@ -187,7 +215,8 @@ class FailoverTokenClient(TokenService):
             return result
         if overload_result is not None:
             return overload_result
-        self._note_exhausted()
+        if not saw_standby:
+            self._note_exhausted()
         return None
 
     # -- TokenService --------------------------------------------------------

@@ -178,6 +178,21 @@ def restore_from_doc(service, doc: Dict[str, object]) -> None:
 
 
 # -- directory artifacts -----------------------------------------------------
+def _fsync_dir(directory: str) -> None:
+    """Flush a rename to the directory inode (no-op where directories can't
+    be opened, e.g. Windows)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_snapshot(service, directory: str, retain: int = 3) -> str:
     """Write one snapshot artifact; atomic (tmp + rename), prunes to the
     newest ``retain`` files. Returns the artifact path."""
@@ -188,7 +203,13 @@ def save_snapshot(service, directory: str, retain: int = 3) -> str:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, separators=(",", ":"))
+        # crash safety: the rename below is only atomic for data already on
+        # disk — an unsynced tmp can survive a crash as a torn artifact
+        # under the FINAL name
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(directory)  # persist the rename itself
     ha_metrics().count_snapshot("save")
     for stale in _artifacts(directory)[:-max(1, int(retain))]:
         try:

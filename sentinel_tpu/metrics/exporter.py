@@ -34,6 +34,7 @@ from typing import Dict, Optional, Tuple
 
 from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.httpd import HttpService, Response
+from sentinel_tpu.datasource import base as _datasource_base
 from sentinel_tpu.local import chain as _chain
 from sentinel_tpu.metrics import extension as _ext
 from sentinel_tpu.metrics.ha import ha_metrics
@@ -139,6 +140,22 @@ def render(now_ms: Optional[int] = None) -> str:
         label = f'{{resource="{_escape(name)}"}}'
         lines.append(f"sentinel_pass_total{label} {passed.get(name, 0)}")
         lines.append(f"sentinel_block_total{label} {blocked.get(name, 0)}")
+    lines.append(
+        "# HELP sentinel_datasource_refresh_failures_total Failed rule "
+        "datasource refreshes (read or parse), by datasource class."
+    )
+    lines.append("# TYPE sentinel_datasource_refresh_failures_total counter")
+    failures = _datasource_base.refresh_failure_totals()
+    if failures:
+        for name, count in sorted(failures.items()):
+            lines.append(
+                "sentinel_datasource_refresh_failures_total"
+                f'{{source="{_escape(name)}"}} {count}'
+            )
+    else:
+        lines.append(
+            'sentinel_datasource_refresh_failures_total{source=""} 0'
+        )
     lines.append(server_metrics().render())
     lines.append(ha_metrics().render())
     return "\n".join(lines) + "\n"

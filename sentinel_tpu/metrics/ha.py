@@ -31,6 +31,14 @@ class HaMetrics:
         self._fallback: Dict[str, int] = {}
         self._snapshot: Dict[str, int] = {}  # op → count: save | restore
         self._last_failover_ms = 0
+        # warm-standby replication (ha.replication): event → count, where
+        # event ∈ shipped | applied | snapshot | need_snapshot | reconnect |
+        # error | promoted
+        self._repl: Dict[str, int] = {}
+        self._repl_bytes = 0
+        # acked end-to-end delta age, as observed by the sender (wall-clock
+        # ms between export_delta's capture and the standby's ACK)
+        self._repl_lag_ms = 0.0
 
     # -- writers ------------------------------------------------------------
     def count_failover(self, from_endpoint: str, to_endpoint: str,
@@ -49,6 +57,18 @@ class HaMetrics:
         with self._lock:
             self._snapshot[op] = self._snapshot.get(op, 0) + 1
 
+    def count_repl(self, event: str, n: int = 1) -> None:
+        with self._lock:
+            self._repl[event] = self._repl.get(event, 0) + n
+
+    def add_repl_bytes(self, n: int) -> None:
+        with self._lock:
+            self._repl_bytes += int(n)
+
+    def set_repl_lag(self, ms: float) -> None:
+        with self._lock:
+            self._repl_lag_ms = float(ms)
+
     # -- readers ------------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -60,6 +80,11 @@ class HaMetrics:
                 "fallback": dict(sorted(self._fallback.items())),
                 "snapshots": dict(sorted(self._snapshot.items())),
                 "lastFailoverMs": self._last_failover_ms,
+                "replication": {
+                    "events": dict(sorted(self._repl.items())),
+                    "bytesTotal": self._repl_bytes,
+                    "lagMs": self._repl_lag_ms,
+                },
             }
 
     def fallback_totals(self) -> Dict[str, int]:
@@ -111,6 +136,36 @@ class HaMetrics:
                 )
         else:
             lines.append('sentinel_snapshot_total{op="save"} 0')
+        with self._lock:
+            repl = sorted(self._repl.items())
+            repl_bytes = self._repl_bytes
+            repl_lag = self._repl_lag_ms
+        lines.append(
+            "# HELP sentinel_repl_deltas_total Warm-standby replication "
+            "events (shipped/applied/snapshot/need_snapshot/reconnect/"
+            "error/promoted)."
+        )
+        lines.append("# TYPE sentinel_repl_deltas_total counter")
+        if repl:
+            for event, count in repl:
+                lines.append(
+                    "sentinel_repl_deltas_total"
+                    f'{{event="{_escape(event)}"}} {count}'
+                )
+        else:
+            lines.append('sentinel_repl_deltas_total{event="shipped"} 0')
+        lines.append(
+            "# HELP sentinel_repl_bytes_total Replication payload bytes "
+            "shipped to standbys."
+        )
+        lines.append("# TYPE sentinel_repl_bytes_total counter")
+        lines.append(f"sentinel_repl_bytes_total {repl_bytes}")
+        lines.append(
+            "# HELP sentinel_repl_lag_ms Age of the last acked delta "
+            "(capture → standby ACK, wall-clock ms)."
+        )
+        lines.append("# TYPE sentinel_repl_lag_ms gauge")
+        lines.append(f"sentinel_repl_lag_ms {repl_lag:g}")
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -119,6 +174,9 @@ class HaMetrics:
             self._fallback.clear()
             self._snapshot.clear()
             self._last_failover_ms = 0
+            self._repl.clear()
+            self._repl_bytes = 0
+            self._repl_lag_ms = 0.0
 
 
 _SINGLETON = HaMetrics()

@@ -35,6 +35,7 @@ VERDICT_NAMES: Dict[int, str] = {
     4: "too_many_request",  # namespace guard tripped
     5: "fail",            # device step failed / degraded
     8: "overload",        # admission refused: queue full / deadline / brownout
+    9: "standby",         # unpromoted warm standby refused to decide
 }
 
 # reasons on the sentinel_server_shed_total counter: every dropped or

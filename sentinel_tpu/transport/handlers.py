@@ -517,6 +517,41 @@ def _snapshot_dir_of_embedded():
     return getattr(server, "snapshot_dir", None)
 
 
+@command_mapping(
+    "cluster/server/promote",
+    "warm-standby control; action=promote|status",
+)
+def cmd_cluster_server_promote(params, body):
+    """Replication role surface (``sentinel_tpu.ha.replication``):
+
+    - ``promote``: open an unpromoted standby's front door (idempotent;
+      errors if this server is not a standby);
+    - ``status``: replication role + sender/applier progress counters.
+    """
+    with _EMBEDDED_LOCK:
+        server = _EMBEDDED_SERVER["server"]
+    if server is None:
+        return {"error": "this machine is not a token server"}
+    action = params.get("action", "status")
+    if action == "promote":
+        applier = getattr(server, "applier", None)
+        if applier is None:
+            return {"error": "this server is not a standby"}
+        already = applier.promoted
+        server.promote(reason=params.get("reason", "manual"))
+        return {"promoted": True, "alreadyPromoted": already}
+    if action == "status":
+        out = {"isStandby": bool(getattr(server, "is_standby", False))}
+        applier = getattr(server, "applier", None)
+        if applier is not None:
+            out["applier"] = applier.status()
+        replicator = getattr(server, "replicator", None)
+        if replicator is not None:
+            out["sender"] = replicator.status()
+        return out
+    return {"error": "action must be promote|status"}
+
+
 @command_mapping("cluster/server/metrics", "token-server per-flow metrics")
 def cmd_cluster_server_metrics(params, body):
     from sentinel_tpu.cluster import api as cluster_api

@@ -9,6 +9,7 @@ dashboard addresses are tried in order.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import threading
 import urllib.request
@@ -44,6 +45,7 @@ class HeartbeatSender:
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._consecutive_failures = 0
 
     def _payload(self) -> bytes:
         return json.dumps(
@@ -84,9 +86,24 @@ class HeartbeatSender:
         self._thread.start()
         return self
 
+    def _interval_s(self) -> float:
+        """Next wait. A dead dashboard is probed on an exponentially
+        growing interval (doubling per consecutive failure, capped at 10×)
+        with ±25% jitter so a fleet that lost its dashboard together
+        doesn't re-register in one synchronized thundering herd; one
+        success snaps back to the configured cadence."""
+        base = self.interval_ms / 1000.0
+        if self._consecutive_failures == 0:
+            return base
+        backoff = min(base * (2.0 ** self._consecutive_failures), base * 10.0)
+        return backoff * random.uniform(0.75, 1.25)
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_ms / 1000.0):
-            self.send_once()
+        while not self._stop.wait(self._interval_s()):
+            if self.send_once():
+                self._consecutive_failures = 0
+            else:
+                self._consecutive_failures += 1
 
     def stop(self) -> None:
         self._stop.set()

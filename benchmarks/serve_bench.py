@@ -84,7 +84,7 @@ def _pcts(rtt_ms: np.ndarray) -> dict:
 def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                  serve_buckets=(4096, 16384), native: bool = True,
                  port: int = 0, n_dispatchers: int = 2,
-                 fuse_depth: int = 4):
+                 fuse_depth: int = 4, intake_shards: int = 1):
     """Service (100k rules — the headline's problem size) + front door."""
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
@@ -128,7 +128,7 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                 server = NativeTokenServer(
                     service, host="127.0.0.1", port=port,
                     max_batch=max_batch, n_dispatchers=n_dispatchers,
-                    fuse_depth=fuse_depth,
+                    fuse_depth=fuse_depth, intake_shards=intake_shards,
                 )
                 front_door = "native-epoll"
         except Exception:
@@ -306,7 +306,9 @@ def measure_ha(deadline_ms: float = 500.0,
 
 def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                   n_flows: int = 100_000, max_batch: int = 16384,
-                  n_dispatchers: int = None, budget_s: float = None) -> dict:
+                  n_dispatchers: int = None, budget_s: float = None,
+                  intake_shards: int = 1,
+                  single_door_baseline: bool = False) -> dict:
     """Full measurement on the CURRENT backend (caller configured jax).
 
     ``closed_kw`` may be one closed-loop config (dict) or a list of
@@ -334,10 +336,12 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
     service, server, front_door = build_server(
         n_flows=n_flows, max_batch=max_batch, native=native,
         n_dispatchers=n_dispatchers, serve_buckets=buckets,
+        intake_shards=intake_shards,
     )
     try:
         candidates = (closed_kw if isinstance(closed_kw, (list, tuple))
                       else [closed_kw or {}])
+        winning_kw = candidates[0] or {}
         # server-side stage breakdown per candidate: the server runs
         # in-process, so its pipeline histograms (queue wait / decide /
         # write / batch size) are snapshotted per closed-loop round and
@@ -378,11 +382,36 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                     ),
                 },
             }
+            # zero-copy host path evidence: per-shard intake occupancy
+            # (busy_ms over the measurement wall) and how many bytes the
+            # host actually copied per served verdict — the number the
+            # direct-to-staging decode + scatter encode are driving down
+            shard_snap = stages.get("intake_shards") or {}
+            c["host_path"] = {
+                "intake_shards": (
+                    intake_shards if front_door == "native-epoll" else None
+                ),
+                "shard_occupancy": {
+                    k: round(min(
+                        (v.get("busy_ms") or 0.0) / wall_ms, 1.0
+                    ), 4)
+                    for k, v in sorted(shard_snap.items())
+                },
+                "shard_pulls": {
+                    k: int(v.get("pulls") or 0)
+                    for k, v in sorted(shard_snap.items())
+                },
+                "bytes_copied_per_verdict": round(
+                    (stages.get("host_copy_bytes_total") or 0)
+                    / max(c["verdicts_ok"], 1), 2,
+                ),
+            }
             if closed is None or c["verdicts_per_sec"] > \
                     closed["verdicts_per_sec"]:
                 if closed is not None:
                     alts.append(closed)
                 closed = c
+                winning_kw = kw or {}
             else:
                 alts.append(c)
         if sweep_rates is None:
@@ -403,6 +432,29 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
     finally:
         server.stop()
         service.close()
+    baseline = None
+    if single_door_baseline and intake_shards > 1 \
+            and front_door == "native-epoll":
+        # same-run, same-client-config single-door control: the honest
+        # denominator for any sharding-speedup claim (same host, same
+        # backend warmth, same subprocess client build)
+        svc_b, srv_b, _ = build_server(
+            n_flows=n_flows, max_batch=max_batch, native=native,
+            n_dispatchers=n_dispatchers, serve_buckets=buckets,
+            intake_shards=1,
+        )
+        try:
+            b = run_closed(srv_b.port, n_flows=n_flows, **winning_kw)
+            baseline = {
+                "intake_shards": 1,
+                "verdicts_per_sec": b["verdicts_per_sec"],
+                "p50_ms": b["p50_ms"],
+                "p99_ms": b["p99_ms"],
+                "errors": b["errors"],
+            }
+        finally:
+            srv_b.stop()
+            svc_b.close()
     op = operating_point(curve)
     # HA probe rides the artifact: failover convergence + the all-down
     # fallback window's blocked-rate. Never aborts the measurement — a
@@ -424,6 +476,9 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
         # per-candidate closed_loop.fusion block records the depths the
         # token service's ladder ACTUALLY fused under that load
         "fusion_depth": getattr(server, "fuse_depth", None),
+        "intake_shards": (
+            intake_shards if front_door == "native-epoll" else None
+        ),
         "front_door": front_door,
         "verdicts_per_sec": closed["verdicts_per_sec"],
         "p50_ms": closed["p50_ms"],
@@ -438,6 +493,11 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
             closed["verdicts_per_sec"] / ceiling, 3
         ) if ceiling else None,
         "ha": ha,
+        **({"single_door_baseline": baseline,
+            "sharding_speedup": round(
+                closed["verdicts_per_sec"]
+                / max(baseline["verdicts_per_sec"], 1), 3,
+            )} if baseline else {}),
         "host_cores": os.cpu_count(),
     }
 
@@ -449,12 +509,30 @@ def main() -> None:
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--no-native", action="store_true")
     ap.add_argument("--flows", type=int, default=100_000)
+    ap.add_argument("--intake-shards", type=int, default=1,
+                    help="SO_REUSEPORT intake shards on the native door")
+    ap.add_argument("--single-door-baseline", action="store_true",
+                    help="with --intake-shards > 1, also measure a "
+                         "same-config intake_shards=1 control run")
+    ap.add_argument("--clients", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--pipeline", type=int, default=None)
     args = ap.parse_args()
+    closed_kw = {
+        k: v for k, v in (
+            ("clients", args.clients), ("batch", args.batch),
+            ("pipeline", args.pipeline),
+        ) if v is not None
+    } or None
     import jax
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    doc = serve_measure(native=not args.no_native, n_flows=args.flows)
+    doc = serve_measure(
+        native=not args.no_native, n_flows=args.flows,
+        closed_kw=closed_kw, intake_shards=args.intake_shards,
+        single_door_baseline=args.single_door_baseline,
+    )
     line = json.dumps(
         {
             "metric": "served_end_to_end",

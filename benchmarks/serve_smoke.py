@@ -43,7 +43,7 @@ REF_PATH = os.path.join(
 REF_DERATE = 0.5
 
 
-def run_smoke(seconds: float = 4.0) -> dict:
+def run_smoke(seconds: float = 4.0, intake_shards: int = 1) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -53,6 +53,7 @@ def run_smoke(seconds: float = 4.0) -> dict:
     service, server, front_door = build_server(
         n_flows=n_flows, max_batch=4096, serve_buckets=(1024, 4096),
         native=True, n_dispatchers=2, fuse_depth=4,
+        intake_shards=intake_shards,
     )
     try:
         from sentinel_tpu.metrics.server import server_metrics
@@ -70,6 +71,7 @@ def run_smoke(seconds: float = 4.0) -> dict:
         service.close()
     return {
         "front_door": front_door,
+        "intake_shards": intake_shards,
         "verdicts_per_sec": closed["verdicts_per_sec"],
         "p50_ms": closed["p50_ms"],
         "p99_ms": closed["p99_ms"],
@@ -89,9 +91,12 @@ def main() -> int:
                     help="override the reference-derived p99 budget")
     ap.add_argument("--update-ref", action="store_true",
                     help="write the committed reference from this run")
+    ap.add_argument("--intake-shards", type=int, default=1,
+                    help="SO_REUSEPORT intake shards on the native door; "
+                         "the committed floor gates both 1 and 2")
     args = ap.parse_args()
 
-    doc = run_smoke(seconds=args.seconds)
+    doc = run_smoke(seconds=args.seconds, intake_shards=args.intake_shards)
     print(json.dumps(doc, indent=2))
 
     if args.update_ref:
@@ -105,6 +110,7 @@ def main() -> int:
             "config": {
                 "clients": 2, "batch": 4096, "pipeline": 4,
                 "seconds": args.seconds, "n_flows": 10_000,
+                "intake_shards": args.intake_shards,
             },
         }
         os.makedirs(os.path.dirname(REF_PATH), exist_ok=True)

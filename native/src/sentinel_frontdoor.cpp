@@ -539,6 +539,12 @@ SN_EXPORT void *sn_fd_create(const char *host, int32_t port,
   s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   int one = 1;
   setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // SO_REUSEPORT: N Frontdoor instances may bind the same port, and the
+  // kernel spreads accepted connections across their listen queues — the
+  // multi-door intake sharding the Python server builds on. Unconditional:
+  // harmless for a single door, and gating it behind a new export would
+  // break ctypes signature resolution against stale .so builds.
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(uint16_t(port));
@@ -669,7 +675,11 @@ SN_EXPORT int32_t sn_fd_wait_batch(void *h, int32_t timeout_ms, int64_t *ids,
 
 // Encode + enqueue verdict frames for the frames returned by wait_batch.
 // status/remaining/wait are request-order arrays covering all frames
-// back-to-back (same order wait_batch returned them).
+// back-to-back (same order wait_batch returned them). Scatter encode:
+// consecutive frames for the SAME connection are laid into ONE contiguous
+// per-writer buffer — one allocation, one outbox item, and (usually) one
+// send() per connection instead of one per frame. Pipelined clients queue
+// many frames per socket, so fused groups collapse to a handful of writes.
 SN_EXPORT void sn_fd_submit(void *h, int32_t n_frames, const int32_t *f_fd,
                             const int32_t *f_gen, const int32_t *f_xid,
                             const int32_t *f_n, const uint8_t *f_type,
@@ -677,39 +687,51 @@ SN_EXPORT void sn_fd_submit(void *h, int32_t n_frames, const int32_t *f_fd,
                             const int32_t *wait_ms) {
   auto *s = static_cast<Frontdoor *>(h);
   std::vector<std::pair<std::pair<int32_t, uint32_t>, std::string>> staged;
-  staged.reserve(size_t(n_frames));
   size_t off = 0;
-  for (int32_t i = 0; i < n_frames; ++i) {
-    int32_t n = f_n[i];
-    std::string frame;
-    if (f_type[i] == kTypeBatchFlow) {
-      size_t payload = size_t(kHead) + 2 + size_t(n) * kRspRow;
-      frame.resize(2 + payload);
-      uint8_t *p = reinterpret_cast<uint8_t *>(&frame[0]);
-      put16(p, uint16_t(payload));
-      put32(p + 2, uint32_t(f_xid[i]));
-      p[6] = kTypeBatchFlow;
-      put16(p + 7, uint16_t(n));
-      uint8_t *row = p + 9;
-      for (int32_t j = 0; j < n; ++j, row += kRspRow) {
-        row[0] = uint8_t(status[off + size_t(j)]);
-        put32(row + 1, uint32_t(remaining[off + size_t(j)]));
-        put32(row + 5, uint32_t(wait_ms[off + size_t(j)]));
+  for (int32_t i = 0; i < n_frames;) {
+    // run of consecutive frames bound for one connection
+    int32_t run_end = i + 1;
+    while (run_end < n_frames && f_fd[run_end] == f_fd[i] &&
+           f_gen[run_end] == f_gen[i])
+      ++run_end;
+    size_t total = 0;
+    for (int32_t k = i; k < run_end; ++k)
+      total += (f_type[k] == kTypeBatchFlow)
+                   ? 2 + size_t(kHead) + 2 + size_t(f_n[k]) * kRspRow
+                   : 2 + size_t(kHead) + kRspRow;
+    std::string buf;
+    buf.resize(total);
+    uint8_t *p = reinterpret_cast<uint8_t *>(&buf[0]);
+    for (int32_t k = i; k < run_end; ++k) {
+      int32_t n = f_n[k];
+      if (f_type[k] == kTypeBatchFlow) {
+        size_t payload = size_t(kHead) + 2 + size_t(n) * kRspRow;
+        put16(p, uint16_t(payload));
+        put32(p + 2, uint32_t(f_xid[k]));
+        p[6] = kTypeBatchFlow;
+        put16(p + 7, uint16_t(n));
+        uint8_t *row = p + 9;
+        for (int32_t j = 0; j < n; ++j, row += kRspRow) {
+          row[0] = uint8_t(status[off + size_t(j)]);
+          put32(row + 1, uint32_t(remaining[off + size_t(j)]));
+          put32(row + 5, uint32_t(wait_ms[off + size_t(j)]));
+        }
+        p += 2 + payload;
+      } else {  // single FLOW response
+        size_t payload = size_t(kHead) + kRspRow;
+        put16(p, uint16_t(payload));
+        put32(p + 2, uint32_t(f_xid[k]));
+        p[6] = kTypeFlow;
+        p[7] = uint8_t(status[off]);
+        put32(p + 8, uint32_t(remaining[off]));
+        put32(p + 12, uint32_t(wait_ms[off]));
+        p += 2 + payload;
       }
-    } else {  // single FLOW response
-      size_t payload = size_t(kHead) + kRspRow;
-      frame.resize(2 + payload);
-      uint8_t *p = reinterpret_cast<uint8_t *>(&frame[0]);
-      put16(p, uint16_t(payload));
-      put32(p + 2, uint32_t(f_xid[i]));
-      p[6] = kTypeFlow;
-      p[7] = uint8_t(status[off]);
-      put32(p + 8, uint32_t(remaining[off]));
-      put32(p + 12, uint32_t(wait_ms[off]));
+      off += size_t(n);
     }
     staged.emplace_back(
-        std::make_pair(f_fd[i], uint32_t(f_gen[i])), std::move(frame));
-    off += size_t(n);
+        std::make_pair(f_fd[i], uint32_t(f_gen[i])), std::move(buf));
+    i = run_end;
   }
   {
     std::lock_guard<std::mutex> lk(s->mu);

@@ -39,6 +39,38 @@ RECONNECT_BASE_S = 0.1
 RECONNECT_MAX_S = 30.0
 RECONNECT_JITTER = 0.2
 
+# process-wide client receive accounting (all TokenClient readers): bytes
+# received off token-server sockets and growable-buffer expansions — the
+# exporter renders these as sentinel_client_recv_bytes_total /
+# sentinel_client_recv_buf_grows_total
+_recv_lock = threading.Lock()
+_recv_bytes = 0
+_recv_buf_grows = 0
+
+
+def _count_recv(n: int, grows: int = 0) -> None:
+    global _recv_bytes, _recv_buf_grows
+    with _recv_lock:
+        _recv_bytes += n
+        _recv_buf_grows += grows
+
+
+def client_recv_bytes_total() -> int:
+    with _recv_lock:
+        return _recv_bytes
+
+
+def client_recv_buf_grows_total() -> int:
+    with _recv_lock:
+        return _recv_buf_grows
+
+
+def reset_client_metrics_for_tests() -> None:
+    global _recv_bytes, _recv_buf_grows
+    with _recv_lock:
+        _recv_bytes = 0
+        _recv_buf_grows = 0
+
 
 class _Pending:
     __slots__ = ("event", "response")
@@ -163,27 +195,72 @@ class TokenClient(TokenService):
             self._drop_connection(sock)
 
     def _read_loop(self, sock: socket.socket) -> None:
-        frames = P.FrameReader()
+        # growable receive buffer, parsed in place: recv_into lands bytes
+        # directly in the bytearray (no per-chunk bytes object), frames are
+        # split by offset arithmetic (no per-feed copy/compact), and only
+        # payloads that still have a waiter get copied out for the handoff.
+        # The buffer doubles when a partial frame fills it (one max frame is
+        # 2+65535 bytes, just over the initial 64KiB) and never shrinks —
+        # its high-water mark is the deepest response burst seen.
+        buf = bytearray(65536)
+        view = memoryview(buf)
+        r = w = 0  # parse offset / write offset into buf
+        head = P._HEAD.size
         try:
             while True:
-                data = sock.recv(65536)
-                if not data:
+                if w == len(buf):
+                    if r > 0:
+                        # reclaim the consumed prefix before growing
+                        view[: w - r] = view[r:w]
+                        w -= r
+                        r = 0
+                    else:
+                        grown = bytearray(2 * len(buf))
+                        grown[:w] = buf
+                        buf = grown
+                        view = memoryview(buf)
+                        _count_recv(0, grows=1)
+                n = sock.recv_into(view[w:])
+                if n == 0:
                     break
-                for payload in frames.feed(data):
+                if chaos.ARMED:  # inbound bit-rot injection (frame_corrupt)
+                    data = chaos.mangle(
+                        "frame_corrupt", bytes(view[w : w + n])
+                    )
+                    view[w : w + n] = data
+                _count_recv(n)
+                w += n
+                while w - r >= 2:
+                    ln = (buf[r] << 8) | buf[r + 1]
+                    # a 2-byte length cannot exceed MAX_FRAME, but a frame
+                    # too short for even a header is garbage — drop the
+                    # connection (same contract as protocol.FrameReader)
+                    if ln < head:
+                        raise ValueError("runt frame")
+                    if w - r < 2 + ln:
+                        break
+                    payload = view[r + 2 : r + 2 + ln]
+                    r += 2 + ln
                     if P.peek_type(payload) == P.MsgType.BATCH_FLOW:
-                        # store the raw payload; the waiting thread decodes
-                        # (spreads the vectorized decode across callers)
-                        xid = int.from_bytes(payload[:4], "big", signed=True)
+                        # copy + store the raw payload; the waiting thread
+                        # decodes (spreads the vectorized decode across
+                        # callers). Frames whose waiter already gave up
+                        # skip even this copy.
+                        xid = int.from_bytes(
+                            payload[:4], "big", signed=True
+                        )
                         pending = self._pending.get(xid)
                         if pending is not None:
-                            pending.response = payload
+                            pending.response = bytes(payload)
                             pending.event.set()
                         continue
-                    rsp = P.decode_response(payload)
+                    rsp = P.decode_response(bytes(payload))
                     pending = self._pending.get(rsp.xid)
                     if pending is not None:
                         pending.response = rsp
                         pending.event.set()
+                if r == w:
+                    r = w = 0  # fully drained: rewind without compaction
         except OSError:
             pass
         except (ValueError, struct.error):

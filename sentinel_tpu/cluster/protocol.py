@@ -248,6 +248,83 @@ def decode_batch_request(payload: bytes):
     )
 
 
+def decode_batch_request_into(payload, ids_out, counts_out, prios_out, at=0):
+    """Zero-copy BATCH_FLOW request decode: write the frame's N rows
+    straight into caller-owned arrays starting at index ``at`` and return
+    ``(xid, n)``.
+
+    This is the staging-buffer entry point: the native intake lanes hand
+    preallocated (freelist-recycled) ``int64/int32/bool`` staging arrays and
+    frames land in them directly — no per-frame intermediate ndarrays, no
+    realloc per pull. Decoded values are bit-identical to
+    :func:`decode_batch_request` (property-tested); the only difference is
+    where the rows land. Raises ``ValueError`` on a truncated frame or when
+    the rows would overflow the staging span — callers treat both as a
+    protocol error on that connection.
+    """
+    xid, _ = _HEAD.unpack_from(payload, 0)
+    (n,) = _BATCH_N.unpack_from(payload, _HEAD.size)
+    off = _HEAD.size + _BATCH_N.size
+    if len(payload) < off + n * BATCH_REQ_DTYPE.itemsize:
+        raise ValueError(
+            f"truncated batch frame: {n} rows declared, "
+            f"{len(payload) - off} payload bytes"
+        )
+    if at + n > ids_out.shape[0]:
+        raise ValueError(
+            f"staging overflow: rows [{at}, {at + n}) exceed capacity "
+            f"{ids_out.shape[0]}"
+        )
+    rows = np.frombuffer(payload, dtype=BATCH_REQ_DTYPE, count=n, offset=off)
+    # casted assignment decodes the big-endian rows during the copy into the
+    # native-endian staging arrays — one pass per column, no intermediates
+    ids_out[at : at + n] = rows["flow_id"]
+    counts_out[at : at + n] = rows["count"]
+    prios_out[at : at + n] = rows["prio"]
+    return xid, n
+
+
+class StagingPool:
+    """Thread-safe freelist of preallocated staging blocks.
+
+    ``factory()`` builds one block (any object — the native server uses a
+    bundle of pinned request/frame-metadata arrays; the fused dispatcher
+    uses stacked ``[depth, batch]`` RequestBatch leaves). ``acquire`` pops a
+    recycled block or builds a fresh one when the freelist is dry (burst
+    absorption — the pool never blocks a lane); ``release`` returns a block
+    for reuse, dropping it once ``capacity`` blocks are already parked so a
+    transient burst doesn't pin its high-water memory forever.
+
+    Counters: ``reused`` / ``built`` expose the recycle rate — a healthy
+    steady state reuses nearly always (``built`` ≈ the concurrency depth).
+    """
+
+    def __init__(self, factory, capacity: int = 16):
+        import threading
+
+        self._factory = factory
+        self.capacity = int(capacity)
+        self._free: List[object] = []
+        self._lock = threading.Lock()
+        self.reused = 0
+        self.built = 0
+
+    def acquire(self):
+        with self._lock:
+            if self._free:
+                self.reused += 1
+                return self._free.pop()
+            self.built += 1
+        return self._factory()
+
+    def release(self, block) -> None:
+        if block is None:
+            return
+        with self._lock:
+            if len(self._free) < self.capacity:
+                self._free.append(block)
+
+
 def decode_batch_deadline(payload: bytes) -> int:
     """The rev-2 relative deadline (ms) trailing a BATCH_FLOW request, or 0
     when absent (rev-1 frame / no budget declared). Tolerant of malformed
@@ -281,16 +358,41 @@ def encode_batch_response(xid: int, status, remaining, wait_ms) -> bytes:
     )
 
 
-def encode_batch_responses(xids, counts, status, remaining, wait_ms) -> bytes:
+def batch_responses_size(counts) -> int:
+    """Exact byte size :func:`encode_batch_responses` needs for ``counts``
+    (callers sizing reusable ``out=`` scatter buffers)."""
+    counts = np.asarray(counts, dtype=np.int64)
+    head = _HEAD.size + _BATCH_N.size
+    return int(
+        counts.shape[0] * (_LEN.size + head)
+        + int(counts.sum()) * BATCH_RSP_DTYPE.itemsize
+    )
+
+
+def encode_batch_responses(xids, counts, status, remaining, wait_ms,
+                           out=None):
     """F BATCH_FLOW response frames in ONE buffer — the vectorized reply
     path. ``counts[f]`` rows belong to frame f (``sum(counts)`` must equal
     ``len(status)``); the verdict arrays are concatenated in frame order.
-    The row conversion is a single numpy pass over ALL frames' verdicts;
-    only the 9-byte frame headers are packed in a small F-loop, so the
-    per-row Python cost no longer scales with frame count."""
+
+    Scatter encode: with ``out=`` (a ``bytearray`` — e.g. one reusable
+    per-writer buffer), the frames are laid directly into it (grown in
+    place when too small) and a ``memoryview`` of the filled span is
+    returned — zero allocation on the steady-state path. Without ``out``
+    a fresh ``bytes`` is allocated and returned (the original behavior).
+
+    Two encode paths, byte-identical (property-tested against each other):
+
+    - **uniform counts** (every frame the same size — the closed-loop /
+      fused steady state): ONE vectorized pass lays rows AND headers via a
+      strided ``[F, frame_len]`` uint8 view; no per-frame Python at all.
+    - **ragged counts**: one numpy pass for all rows, then a small F-loop
+      packs the 9-byte headers.
+    """
     xids = np.asarray(xids)
     counts = np.asarray(counts, dtype=np.int64)
     status = np.asarray(status, dtype=np.int8)
+    F = xids.shape[0]
     total = int(counts.sum())
     if total != status.shape[0]:
         raise ValueError(
@@ -300,23 +402,50 @@ def encode_batch_responses(xids, counts, status, remaining, wait_ms) -> bytes:
     rows["status"] = status
     rows["remaining"] = np.asarray(remaining, dtype=np.int32)
     rows["wait_ms"] = np.asarray(wait_ms, dtype=np.int32)
-    blob = rows.tobytes()
     isz = BATCH_RSP_DTYPE.itemsize
     head = _HEAD.size + _BATCH_N.size
-    out = bytearray(xids.shape[0] * (_LEN.size + head) + total * isz)
-    mv = memoryview(out)
-    off = 0
-    row0 = 0
-    for f in range(xids.shape[0]):
-        n = int(counts[f])
-        _LEN.pack_into(out, off, head + n * isz)
-        _HEAD.pack_into(out, off + _LEN.size, int(xids[f]), MsgType.BATCH_FLOW)
-        _BATCH_N.pack_into(out, off + _LEN.size + _HEAD.size, n)
-        start = off + _LEN.size + head
-        mv[start : start + n * isz] = blob[row0 * isz : (row0 + n) * isz]
-        off = start + n * isz
-        row0 += n
-    return bytes(out)
+    size = F * (_LEN.size + head) + total * isz
+    if out is None:
+        buf = bytearray(size)
+    else:
+        if len(out) < size:
+            out.extend(bytes(size - len(out)))  # grow once, then steady
+        buf = out
+    uniform = F > 0 and int(counts.min()) == int(counts.max())
+    if uniform and total:
+        n = int(counts[0])
+        plen = head + n * isz
+        flen = _LEN.size + plen
+        view = np.frombuffer(buf, np.uint8, count=F * flen).reshape(F, flen)
+        view[:, 0] = plen >> 8
+        view[:, 1] = plen & 0xFF
+        view[:, 2:6] = (
+            np.ascontiguousarray(xids, dtype=">i4")
+            .view(np.uint8).reshape(F, 4)
+        )
+        view[:, 6] = int(MsgType.BATCH_FLOW)
+        view[:, 7] = n >> 8
+        view[:, 8] = n & 0xFF
+        view[:, 9:] = rows.view(np.uint8).reshape(F, n * isz)
+    else:
+        blob = rows.tobytes()
+        mv = memoryview(buf)
+        off = 0
+        row0 = 0
+        for f in range(F):
+            n = int(counts[f])
+            _LEN.pack_into(buf, off, head + n * isz)
+            _HEAD.pack_into(
+                buf, off + _LEN.size, int(xids[f]), MsgType.BATCH_FLOW
+            )
+            _BATCH_N.pack_into(buf, off + _LEN.size + _HEAD.size, n)
+            start = off + _LEN.size + head
+            mv[start : start + n * isz] = blob[row0 * isz : (row0 + n) * isz]
+            off = start + n * isz
+            row0 += n
+    if out is None:
+        return bytes(buf)
+    return memoryview(buf)[:size]
 
 
 def decode_batch_response(payload: bytes):

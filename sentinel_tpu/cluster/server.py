@@ -634,12 +634,21 @@ class _LoopWorker:
                     pass
             for writer, (xids, counts, slices) in grouped.items():
                 try:
+                    # scatter encode into the connection's reused buffer
+                    # (out=): the transport copies what it can't send
+                    # synchronously before write() returns, so recycling
+                    # the bytearray on the next flush is safe
+                    buf = srv._writer_bufs.get(writer)
+                    if buf is None:
+                        buf = bytearray()
+                        srv._writer_bufs[writer] = buf
                     writer.write(
                         P.encode_batch_responses(
                             xids, counts,
                             np.concatenate([s[0] for s in slices]),
                             np.concatenate([s[1] for s in slices]),
                             np.concatenate([s[2] for s in slices]),
+                            out=buf,
                         )
                     )
                     writers_to_drain.add(writer)
@@ -763,6 +772,13 @@ class TokenServer:
         self.repl_interval_ms = repl_interval_ms
         self.applier = None  # StandbyApplier while in standby mode
         self.replicator = None  # ReplicationSender while primary
+        # per-connection scatter-encode buffers: encode_batch_responses
+        # lays each writer's grouped verdict frames into its reused
+        # bytearray (out=) instead of allocating a bytes blob per flush;
+        # weak keys let a closed connection's buffer fall away with it
+        import weakref
+
+        self._writer_bufs = weakref.WeakKeyDictionary()
 
     def tuning_kwargs(self) -> dict:
         """Operator-tunable constructor kwargs, for rebuilding this server on

@@ -76,6 +76,7 @@ class NativeTokenServer:
         max_batch: int = 16384,
         n_dispatchers: int = 2,
         fuse_depth: int = 4,
+        intake_shards: int = 1,
         intake_timeout_ms: int = 20,
         idle_ttl_s: Optional[float] = 600.0,
         arena_cap: int = 65536,
@@ -99,6 +100,13 @@ class NativeTokenServer:
         self.port = port
         self.max_batch = max_batch
         self.n_dispatchers = max(1, int(n_dispatchers))
+        # SO_REUSEPORT intake sharding: N doors bound to the SAME port, the
+        # kernel hash-spreads connections across them, and each door gets a
+        # dedicated intake thread with its own bounded handoff queue. The
+        # single device lane drains the UNION of the shard queues, so the
+        # fusion ladder still sees one merged burst — sharding multiplies
+        # intake pull/decode bandwidth without forking the device pipeline.
+        self.intake_shards = max(1, int(intake_shards))
         # fuse_depth bounds how many queued intake pulls the device lane
         # folds into one dispatch (each pull is itself up to max_batch
         # rows) — the host-prep budget of the adaptive frame fusion
@@ -122,14 +130,18 @@ class NativeTokenServer:
         self.overload = (
             overload if overload is not None else AdmissionController()
         )
-        self._door = None
+        self._door = None  # door 0 (back-compat handle; owns self.port)
+        self._doors: List = []
         self._threads: List[threading.Thread] = []
         self._lane_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._intake_stop = threading.Event()
         self._abandon = threading.Event()  # give up lane drain (dead lane)
-        self._dispatch_q: Optional[queue.Queue] = None
+        self._shard_qs: List[queue.Queue] = []
+        self._dispatch_sem: Optional[threading.Semaphore] = None
+        self._dispatch_q: Optional[queue.Queue] = None  # alias: shard 0's q
         self._reply_q: Optional[queue.Queue] = None
+        self._staging = None  # StagingPool of intake decode blocks
         notify = getattr(service, "connected_count_changed", None)
         self.connections = ConnectionManager(on_count_changed=notify)
         self._addr_by_conn = {}  # (fd, gen) → address
@@ -164,6 +176,7 @@ class NativeTokenServer:
             max_batch=self.max_batch,
             n_dispatchers=self.n_dispatchers,
             fuse_depth=self.fuse_depth,
+            intake_shards=self.intake_shards,
             intake_timeout_ms=self.intake_timeout_ms,
             idle_ttl_s=self.idle_ttl_s,
             arena_cap=self.arena_cap,
@@ -220,27 +233,60 @@ class NativeTokenServer:
         self._stop.clear()
         self._intake_stop.clear()
         self._abandon.clear()
-        # bounded handoffs: dispatch queue depth caps how far intake runs
-        # ahead of the device (its size IS the fusion opportunity); reply
-        # queue depth caps device-step in-flight count
-        self._dispatch_q = queue.Queue(maxsize=max(2, 2 * self.fuse_depth))
+        # bounded handoffs: each shard's dispatch queue depth caps how far
+        # its intake runs ahead of the device (their union IS the fusion
+        # opportunity); reply queue depth caps device-step in-flight count.
+        # The semaphore counts queued pulls across ALL shard queues so the
+        # device lane blocks on one primitive instead of polling N queues.
+        self._shard_qs = [
+            queue.Queue(maxsize=max(2, 2 * self.fuse_depth))
+            for _ in range(self.intake_shards)
+        ]
+        self._dispatch_q = self._shard_qs[0]
+        self._dispatch_sem = threading.Semaphore(0)
         self._reply_q = queue.Queue(maxsize=max(2, 2 * self.n_dispatchers))
-        self._door = self._Frontdoor(
-            self.host, self.port, arena_cap=self.arena_cap
+        # recycled intake decode blocks: the C++ arena memcpys each pull
+        # straight into one of these (wait_batch_into) and the block rides
+        # the pull through device prep and reply submit, then returns to
+        # the pool — zero steady-state allocation on the intake path
+        from sentinel_tpu.cluster.protocol import StagingPool
+
+        self._staging = StagingPool(
+            self._alloc_staging_block,
+            capacity=2 * self.fuse_depth + self.n_dispatchers
+            + self.intake_shards + 2,
         )
-        self.port = self._door.port
+        # door 0 binds the requested port (possibly 0 → ephemeral); the
+        # remaining shards bind the LEARNED concrete port via SO_REUSEPORT
+        # (set unconditionally in sn_fd_create) so the kernel spreads
+        # accepted connections across the shard listeners
+        doors = [self._Frontdoor(self.host, self.port,
+                                 arena_cap=self.arena_cap)]
+        self.port = doors[0].port
+        for _ in range(1, self.intake_shards):
+            doors.append(
+                self._Frontdoor(self.host, self.port,
+                                arena_cap=self.arena_cap)
+            )
+        self._doors = doors
+        self._door = doors[0]
         if self.idle_ttl_s:
-            self._door.set_idle_ttl(int(self.idle_ttl_s * 1000))
+            for d in doors:
+                d.set_idle_ttl(int(self.idle_ttl_s * 1000))
         lanes = [
             threading.Thread(
-                target=self._intake_loop, name="sentinel-native-intake",
-                daemon=True,
-            ),
+                target=self._intake_loop,
+                args=(i, doors[i], self._shard_qs[i]),
+                name=f"sentinel-native-intake-{i}", daemon=True,
+            )
+            for i in range(self.intake_shards)
+        ]
+        lanes.append(
             threading.Thread(
                 target=self._device_loop, name="sentinel-native-device",
                 daemon=True,
-            ),
-        ]
+            )
+        )
         lanes.extend(
             threading.Thread(
                 target=self._reply_loop,
@@ -271,7 +317,7 @@ class NativeTokenServer:
                 (self.stats() or {}).get("pending_frames", 0)
             ),
             "dispatch_lane_depth": lambda: float(
-                self._dispatch_q.qsize() if self._dispatch_q else 0
+                sum(q.qsize() for q in self._shard_qs)
             ),
             "reply_lane_depth": lambda: float(
                 self._reply_q.qsize() if self._reply_q else 0
@@ -305,8 +351,37 @@ class NativeTokenServer:
                 sender_id=f"{self.host}:{self.port}",
             ).start()
         record_log.info(
-            "native token server listening on %s:%d (%d dispatchers)",
-            self.host, self.port, self.n_dispatchers,
+            "native token server listening on %s:%d "
+            "(%d intake shards, %d dispatchers)",
+            self.host, self.port, self.intake_shards, self.n_dispatchers,
+        )
+
+    def _alloc_staging_block(self) -> dict:
+        """One intake decode block: row arrays sized for the largest pull
+        (``max_batch``, clamped so a max-size frame always fits) plus frame
+        metadata. ``prios`` is the raw wire byte (what the C++ arena
+        holds); ``prios_bool`` is its normalized boolean row, converted in
+        place per pull so downstream masking (`~`, shed_mask) sees real
+        booleans whatever byte a client sent."""
+        rows = max(
+            min(int(self.max_batch), int(self.arena_cap)),
+            P.MAX_BATCH_PER_FRAME,
+        )
+        # frames per pull is bounded by rows except for degenerate 0-row
+        # frames; the frame capacity below also CAPS how many frames one
+        # wait_batch_into may take, so a smaller array just splits a
+        # pathological all-empty-frame burst across pulls
+        max_f = rows + 64
+        return dict(
+            ids=np.empty(rows, np.int64),
+            counts=np.empty(rows, np.int32),
+            prios=np.empty(rows, np.uint8),
+            prios_bool=np.empty(rows, bool),
+            f_fd=np.empty(max_f, np.int32),
+            f_gen=np.empty(max_f, np.int32),
+            f_xid=np.empty(max_f, np.int32),
+            f_n=np.empty(max_f, np.int32),
+            f_type=np.empty(max_f, np.uint8),
         )
 
     def stop(self) -> None:
@@ -343,12 +418,17 @@ class NativeTokenServer:
                 t.join(timeout=2)
         self._lane_threads = []
         self._stop.set()
-        self._door.stop()
+        for d in self._doors:
+            d.stop()
         for t in self._threads:
             t.join(timeout=5)
         self._threads = []
+        self._shard_qs = []
+        self._dispatch_sem = None
         self._dispatch_q = None
         self._reply_q = None
+        self._staging = None
+        self._doors = []
         self._door = None
         # the door closed every socket without emitting CTRL_CLOSE (the
         # control thread is already down), so deregister the clients here —
@@ -389,88 +469,136 @@ class NativeTokenServer:
                 if deadline is not None and time.monotonic() >= deadline:
                     return False
 
-    def _intake_loop(self) -> None:
-        """Lane 1: pull decoded frames from the C++ door, hand copies to the
-        device lane. The door wakes ``wait_batch`` the moment the first
-        frame queues — ``intake_timeout_ms`` is only the shutdown-poll
-        granularity, never a batching stall."""
-        door = self._door
-        q = self._dispatch_q
-        while not self._intake_stop.is_set():
+    def _intake_loop(self, shard: int, door, q: queue.Queue) -> None:
+        """Lane 1 (×``intake_shards``): pull decoded frames from this
+        shard's C++ door straight into a recycled staging block, hand the
+        block to the device lane. The door wakes ``wait_batch_into`` the
+        moment the first frame queues — ``intake_timeout_ms`` is only the
+        shutdown-poll granularity, never a batching stall.
+
+        Zero-copy shape: the C++ IO thread memcpys its arena directly into
+        the staging arrays (no thread-local bounce buffer, no per-pull
+        ``np.array`` copies); the block travels with the pull and returns
+        to the pool after the reply lane submits its verdicts. Pulls this
+        lane answers itself (standby/overload refusals, chaos drops) reuse
+        the block immediately — ``sn_fd_submit`` copies synchronously."""
+        pool = self._staging
+        if self.intake_shards > 1:
+            # best-effort shard→core pinning so each intake lane's cache
+            # stays hot; harmless no-op on single-core or restricted hosts
             try:
-                # max_batch bounds one pull (clamped to >= one max frame);
-                # the remainder stays queued for the next cycle
-                got = door.wait_batch(
-                    timeout_ms=self.intake_timeout_ms, max_n=self.max_batch
-                )
-            except Exception:
-                if self._stop.is_set() or self._intake_stop.is_set():
+                cpus = sorted(os.sched_getaffinity(0))
+                if len(cpus) > 1:
+                    os.sched_setaffinity(0, {cpus[shard % len(cpus)]})
+            except (AttributeError, OSError):
+                pass
+        block = pool.acquire()
+        try:
+            while not self._intake_stop.is_set():
+                try:
+                    # max_batch bounds one pull (clamped to >= one max
+                    # frame); the remainder stays queued for the next cycle
+                    got = door.wait_batch_into(
+                        block, timeout_ms=self.intake_timeout_ms,
+                        max_n=self.max_batch,
+                    )
+                except Exception:
+                    if self._stop.is_set() or self._intake_stop.is_set():
+                        break
+                    record_log.exception(
+                        "native wait_batch failed; intake %d down", shard
+                    )
                     break
-                record_log.exception("native wait_batch failed; intake down")
-                break
-            if got is None:
-                continue
-            if chaos.ARMED:
-                chaos.maybe_sleep("lane_delay")
-                if chaos.should("frame_drop"):
-                    _SM.count_shed("chaos_drop", len(got[0]))
+                if got is None:
                     continue
-            t0 = time.perf_counter()
-            ids, counts, prios, frames = got
-            # wait_batch returns views into this thread's reused buffers —
-            # valid only until OUR next call — so the lane handoff copies.
-            # The trailing monotonic stamp is the pull's arrival time: the
-            # device lane sheds by it (the C++ door strips the wire
-            # deadline, so age is the native deadline proxy).
-            pull = (
-                np.array(ids), np.array(counts), np.array(prios),
-                tuple(np.array(f) for f in frames),
-                time.monotonic(),
-            )
-            n = len(ids)
-            if self.is_standby:
-                # unpromoted warm standby: data plane is closed. Refuse the
-                # whole pull with STANDBY so the failover client walks on to
-                # the live primary (no retry hint — this is not backpressure)
-                _SM.count_shed("standby", n)
-                status = np.full(n, _STANDBY, np.int8)
-                _SM.record_verdict_batch(status, None, ())
-                try:
-                    door.submit(
-                        pull[3], status, np.zeros(n, np.int32),
-                        np.zeros(n, np.int32),
-                    )
-                except Exception:
-                    if not self._stop.is_set():
-                        record_log.exception("native standby submit failed")
-                continue
-            _SM.batch_size.record(n)
-            self.overload.note_enqueued(n)
-            give_up = (
-                None if self.shed_age_ms is None
-                else self.shed_age_ms / 1000.0
-            )
-            if self._lane_put(q, pull, give_up_after_s=give_up):
-                _SM.intake_ms.record((time.perf_counter() - t0) * 1e3)
-            else:
-                # dispatch lane saturated past the age budget: refuse the
-                # whole pull explicitly rather than queue frames that will
-                # only expire — the clients get an immediate retry hint
-                self.overload.note_done(n)
-                _SM.count_shed("queue_full", n)
-                status = np.full(n, _OVERLOAD, np.int8)
-                wait = np.full(
-                    n, self.overload.retry_hint_ms, np.int32
+                n, k = got
+                if chaos.ARMED:
+                    chaos.maybe_sleep("lane_delay")
+                    if chaos.should("frame_drop"):
+                        _SM.count_shed("chaos_drop", n)
+                        continue
+                t0 = time.perf_counter()
+                # normalize the wire prio bytes into the block's boolean
+                # row in place (clients send 0/1 but the wire admits any
+                # byte; masking downstream needs real booleans)
+                prios = np.not_equal(
+                    block["prios"][:n], 0, out=block["prios_bool"][:n]
                 )
-                _SM.record_verdict_batch(status, None, ())
-                try:
-                    door.submit(
-                        pull[3], status, np.zeros(n, np.int32), wait
+                # the one host copy this path pays: C arena → staging
+                # (13B/row + 17B/frame) plus the 1B/row bool normalize
+                _SM.count_copy_bytes(n * 14 + k * 17)
+                # pull = (rows..., frames, age stamp, owning door, block):
+                # the age stamp is the shed-by-age deadline proxy (the C++
+                # door strips the wire deadline); the door routes replies
+                # and refusals back to the shard that owns the connection
+                pull = (
+                    block["ids"][:n], block["counts"][:n], prios,
+                    (block["f_fd"][:k], block["f_gen"][:k],
+                     block["f_xid"][:k], block["f_n"][:k],
+                     block["f_type"][:k]),
+                    time.monotonic(), door, block,
+                )
+                if self.is_standby:
+                    # unpromoted warm standby: data plane is closed. Refuse
+                    # the whole pull with STANDBY so the failover client
+                    # walks on to the live primary (no retry hint — this is
+                    # not backpressure)
+                    _SM.count_shed("standby", n)
+                    status = np.full(n, _STANDBY, np.int8)
+                    _SM.record_verdict_batch(status, None, ())
+                    try:
+                        door.submit(
+                            pull[3], status, np.zeros(n, np.int32),
+                            np.zeros(n, np.int32),
+                        )
+                    except Exception:
+                        if not self._stop.is_set():
+                            record_log.exception(
+                                "native standby submit failed"
+                            )
+                    continue
+                _SM.batch_size.record(n)
+                self.overload.note_enqueued(n)
+                give_up = (
+                    None if self.shed_age_ms is None
+                    else self.shed_age_ms / 1000.0
+                )
+                if self._lane_put(q, pull, give_up_after_s=give_up):
+                    self._dispatch_sem.release()
+                    dt_ms = (time.perf_counter() - t0) * 1e3
+                    _SM.intake_ms.record(dt_ms)
+                    _SM.count_shard_pull(shard, n, dt_ms)
+                    # the block now rides the pull; next cycle decodes
+                    # into a fresh (usually recycled) one
+                    block = pool.acquire()
+                else:
+                    # dispatch lane saturated past the age budget: refuse
+                    # the whole pull explicitly rather than queue frames
+                    # that will only expire — the clients get an immediate
+                    # retry hint
+                    self.overload.note_done(n)
+                    _SM.count_shed("queue_full", n)
+                    status = np.full(n, _OVERLOAD, np.int8)
+                    wait = np.full(
+                        n, self.overload.retry_hint_ms, np.int32
                     )
-                except Exception:
-                    if not self._stop.is_set():
-                        record_log.exception("native overload submit failed")
-        self._lane_put(q, self._SENTINEL)
+                    _SM.record_verdict_batch(status, None, ())
+                    try:
+                        door.submit(
+                            pull[3], status, np.zeros(n, np.int32), wait
+                        )
+                    except Exception:
+                        if not self._stop.is_set():
+                            record_log.exception(
+                                "native overload submit failed"
+                            )
+        finally:
+            pool.release(block)
+            # sentinel handoff keeps the forever semantics; only a
+            # successful put may release the semaphore (the device lane
+            # trusts every release to have a queued item behind it)
+            if self._lane_put(q, self._SENTINEL):
+                self._dispatch_sem.release()
 
     def _device_loop(self) -> None:
         """Lane 2: the only thread issuing device work — dispatch order IS
@@ -479,28 +607,70 @@ class NativeTokenServer:
         service's fusion ladder folds the full engine frames inside into a
         single chained scan step. Dispatch returns before the device
         finishes (async), so this lane loops back to prep the next group
-        while the reply lanes block on the verdicts."""
-        q = self._dispatch_q
+        while the reply lanes block on the verdicts.
+
+        With intake sharding the drain is the UNION of the shard queues:
+        the semaphore counts queued pulls across all of them, and a
+        round-robin ``get_nowait`` scan fetches the item each acquired
+        permit guarantees — so a burst split across N doors by the kernel
+        still fuses into one device step. Shutdown ends after every
+        shard's sentinel has been consumed."""
+        qs = self._shard_qs
+        sem = self._dispatch_sem
+        n_shards = len(qs)
+        done_shards = 0
+        rr = 0
         service = self.service
         dispatch = getattr(service, "dispatch_batch_arrays", None)
+
+        def pop_next():
+            # every sem permit has a queued item behind it and this lane
+            # is the sole consumer, so one scan pass finds it; the spin
+            # guard only matters if a lane died mid-shutdown
+            nonlocal rr
+            while True:
+                for j in range(n_shards):
+                    qi = (rr + j) % n_shards
+                    try:
+                        item = qs[qi].get_nowait()
+                    except queue.Empty:
+                        continue
+                    rr = (qi + 1) % n_shards
+                    return item
+                if self._abandon.is_set():
+                    return None
+
         try:
             while True:
-                item = q.get()
-                if item is self._SENTINEL:
+                if not sem.acquire(timeout=0.5):
+                    if self._abandon.is_set():
+                        break
+                    continue
+                item = pop_next()
+                if item is None:
                     break
+                if item is self._SENTINEL:
+                    done_shards += 1
+                    if done_shards >= n_shards:
+                        break
+                    continue
                 pulls = [item]
                 # adaptive frame fusion: everything already queued joins
-                # this dispatch. Idle queue → depth 1 (no added latency);
+                # this dispatch. Idle queues → depth 1 (no added latency);
                 # backlog → deep fused step (max amortization).
                 stop_after = False
                 while len(pulls) < self.fuse_depth:
-                    try:
-                        nxt = q.get_nowait()
-                    except queue.Empty:
+                    if not sem.acquire(blocking=False):
+                        break
+                    nxt = pop_next()
+                    if nxt is None:
                         break
                     if nxt is self._SENTINEL:
-                        stop_after = True  # intake is done; finish group
-                        break
+                        done_shards += 1
+                        if done_shards >= n_shards:
+                            stop_after = True  # all intake done; finish
+                            break
+                        continue
                     pulls.append(nxt)
                 if len(pulls) == 1:
                     ids, counts, prios = item[0], item[1], item[2]
@@ -508,6 +678,9 @@ class NativeTokenServer:
                     ids = np.concatenate([p[0] for p in pulls])
                     counts = np.concatenate([p[1] for p in pulls])
                     prios = np.concatenate([p[2] for p in pulls])
+                    _SM.count_copy_bytes(
+                        ids.nbytes + counts.nbytes + prios.nbytes
+                    )
                 lengths = [len(p[0]) for p in pulls]
                 n_rows = len(ids)
                 # deadline proxy: pulls older than shed_age_ms are answered
@@ -615,9 +788,13 @@ class NativeTokenServer:
                     self._reply_q, (pulls, lengths, mat)
                 ):
                     # abandoned shutdown drop: nobody will materialize or
-                    # answer these rows — account for them
+                    # answer these rows — account for them and park the
+                    # staging blocks the reply lane would have returned
                     self.overload.note_done(n_rows)
                     _SM.count_shed("lane_abandon", n_rows)
+                    if self._staging is not None:
+                        for p in pulls:
+                            self._staging.release(p[6])
                 if stop_after:
                     break
         finally:
@@ -627,10 +804,15 @@ class NativeTokenServer:
 
     def _reply_loop(self) -> None:
         """Lane 3 (×``n_dispatchers``): block on the async verdicts, slice
-        them back per intake pull, submit to the door. While one reply
-        thread waits on device results the device lane keeps dispatching,
-        and a second reply thread overlaps the next group's encode."""
-        door = self._door
+        them back per intake pull, submit to each pull's owning door. While
+        one reply thread waits on device results the device lane keeps
+        dispatching, and a second reply thread overlaps the next group's
+        encode. Consecutive pulls from the same door collapse into one
+        ``submit_many`` call — one outbox lock and one IO wakeup per run,
+        with the C++ scatter encode grouping same-connection frames across
+        pull boundaries. Once the verdicts are submitted (``sn_fd_submit``
+        copies synchronously) the pulls' staging blocks go back to the
+        intake pool."""
         rq = self._reply_q
         while True:
             item = rq.get()
@@ -650,98 +832,125 @@ class NativeTokenServer:
             t_write = time.perf_counter()
             _SM.decide_ms.record((t_write - t0) * 1e3)
             off = 0
-            for pull, ln in zip(pulls, lengths):
+            i = 0
+            n_pulls = len(pulls)
+            while i < n_pulls:
+                door = pulls[i][5]
+                frames_list = []
+                span = 0
+                j = i
+                while j < n_pulls and pulls[j][5] is door:
+                    frames_list.append(pulls[j][3])
+                    span += lengths[j]
+                    j += 1
                 try:
-                    door.submit(
-                        pull[3],
-                        status[off : off + ln],
-                        remaining[off : off + ln],
-                        wait[off : off + ln],
+                    door.submit_many(
+                        frames_list,
+                        status[off : off + span],
+                        remaining[off : off + span],
+                        wait[off : off + span],
                     )
                 except Exception:
                     if not self._stop.is_set():
                         record_log.exception("native submit failed")
-                off += ln
+                off += span
+                i = j
             self.overload.note_done(off)
             _SM.write_ms.record((time.perf_counter() - t_write) * 1e3)
+            pool = self._staging
+            if pool is not None:
+                for p in pulls:
+                    pool.release(p[6])
 
     # -- control plane ------------------------------------------------------
     def _control_loop(self) -> None:
-        door = self._door
-        service = self.service
+        # one poll thread covers every shard door: control traffic is
+        # low-rate (handshakes, params, repl frames), and (fd, gen) keys
+        # are globally unique across doors, so the session maps need no
+        # per-door namespacing — only the REPLY must go out through the
+        # door that owns the connection
+        doors = list(self._doors)
         while not self._stop.is_set():
-            try:
-                item = door.next_control()
-            except Exception:
-                if self._stop.is_set():
-                    return
-                raise
-            if item is None:
-                self._stop.wait(0.002)
-                continue
-            kind, fd, gen, payload = item
-            if kind == door.CTRL_OPEN:
-                address = payload.decode("latin-1")
-                with self._addr_lock:
-                    self._addr_by_conn[(fd, gen)] = address
-                self.connections.attach_closer(
-                    address,
-                    lambda fd=fd, gen=gen: door.close_conn(fd, gen),
-                )
-                continue
-            if kind == door.CTRL_CLOSE:
-                with self._addr_lock:
-                    address = self._addr_by_conn.pop((fd, gen), None)
-                if address:
-                    self.connections.remove_address(address)
-                self._repl_sessions.pop((fd, gen), None)
-                continue
-            # kind == CTRL_FRAME: a non-data-plane request
-            with self._addr_lock:
-                address = self._addr_by_conn.get((fd, gen), f"fd{fd}")
-            # rev-3 replication frames ride the control lane but are not
-            # requests (decode_request would reject their type bytes) —
-            # route them to the standby applier's per-connection session
-            if len(payload) >= 5 and P.peek_type(payload) in P.REPL_TYPES:
-                if self.applier is None:
-                    record_log.warning(
-                        "repl frame on non-standby server; closing %s",
-                        address,
-                    )
-                    door.close_conn(fd, gen)
-                    continue
-                sess = self._repl_sessions.get((fd, gen))
-                if sess is None:
-                    sess = self.applier.connection()
-                    self._repl_sessions[(fd, gen)] = sess
+            got_any = False
+            for door in doors:
                 try:
-                    sess.handle(
-                        payload, lambda b, fd=fd, gen=gen: door.send(
-                            fd, gen, b
-                        ),
-                    )
-                except ValueError:
-                    record_log.warning("torn repl stream; closing %s",
-                                       address)
-                    self._repl_sessions.pop((fd, gen), None)
-                    door.close_conn(fd, gen)
-                continue
-            try:
-                req = P.decode_request(payload)
-            except Exception:
-                record_log.warning("bad control frame; closing %s", address)
-                door.close_conn(fd, gen)
-                continue
-            try:
-                rsp = self._handle_control(req, address)
-            except Exception:
-                record_log.exception("%s control request failed",
-                                     type(req).__name__)
-                rsp = P.FlowResponse(
-                    req.xid, getattr(req, "msg_type", P.MsgType.PING),
-                    int(TokenStatus.FAIL),
+                    item = door.next_control()
+                except Exception:
+                    if self._stop.is_set():
+                        return
+                    raise
+                if item is None:
+                    continue
+                got_any = True
+                self._handle_control_item(door, item)
+            if not got_any:
+                self._stop.wait(0.002)
+
+    def _handle_control_item(self, door, item) -> None:
+        kind, fd, gen, payload = item
+        if kind == door.CTRL_OPEN:
+            address = payload.decode("latin-1")
+            with self._addr_lock:
+                self._addr_by_conn[(fd, gen)] = address
+            self.connections.attach_closer(
+                address,
+                lambda fd=fd, gen=gen, door=door: door.close_conn(fd, gen),
+            )
+            return
+        if kind == door.CTRL_CLOSE:
+            with self._addr_lock:
+                address = self._addr_by_conn.pop((fd, gen), None)
+            if address:
+                self.connections.remove_address(address)
+            self._repl_sessions.pop((fd, gen), None)
+            return
+        # kind == CTRL_FRAME: a non-data-plane request
+        with self._addr_lock:
+            address = self._addr_by_conn.get((fd, gen), f"fd{fd}")
+        # rev-3 replication frames ride the control lane but are not
+        # requests (decode_request would reject their type bytes) —
+        # route them to the standby applier's per-connection session
+        if len(payload) >= 5 and P.peek_type(payload) in P.REPL_TYPES:
+            if self.applier is None:
+                record_log.warning(
+                    "repl frame on non-standby server; closing %s",
+                    address,
                 )
-            door.send(fd, gen, P.encode_response(rsp))
+                door.close_conn(fd, gen)
+                return
+            sess = self._repl_sessions.get((fd, gen))
+            if sess is None:
+                sess = self.applier.connection()
+                self._repl_sessions[(fd, gen)] = sess
+            try:
+                sess.handle(
+                    payload,
+                    lambda b, fd=fd, gen=gen, door=door: door.send(
+                        fd, gen, b
+                    ),
+                )
+            except ValueError:
+                record_log.warning("torn repl stream; closing %s",
+                                   address)
+                self._repl_sessions.pop((fd, gen), None)
+                door.close_conn(fd, gen)
+            return
+        try:
+            req = P.decode_request(payload)
+        except Exception:
+            record_log.warning("bad control frame; closing %s", address)
+            door.close_conn(fd, gen)
+            return
+        try:
+            rsp = self._handle_control(req, address)
+        except Exception:
+            record_log.exception("%s control request failed",
+                                 type(req).__name__)
+            rsp = P.FlowResponse(
+                req.xid, getattr(req, "msg_type", P.MsgType.PING),
+                int(TokenStatus.FAIL),
+            )
+        door.send(fd, gen, P.encode_response(rsp))
 
     def _handle_control(self, req, address: str) -> P.FlowResponse:
         service = self.service
@@ -774,4 +983,12 @@ class NativeTokenServer:
         return P.FlowResponse(req.xid, req.msg_type, int(TokenStatus.FAIL))
 
     def stats(self) -> dict:
-        return self._door.stats() if self._door is not None else {}
+        """Door counters, summed across the intake shards."""
+        doors = list(self._doors)
+        if not doors:
+            return {}
+        out: dict = {}
+        for d in doors:
+            for key, v in d.stats().items():
+                out[key] = out.get(key, 0) + v
+        return out

@@ -25,11 +25,15 @@ from sentinel_tpu.engine.decide import (
     RequestBatch,
     VerdictBatch,
     TokenStatus,
+    alloc_fused_batch,
     decide,
     make_batch,
+    make_batch_into,
 )
 
 __all__ = [
+    "alloc_fused_batch",
+    "make_batch_into",
     "EngineConfig",
     "RuleTable",
     "ClusterFlowRule",

@@ -109,6 +109,51 @@ def make_batch(
     )
 
 
+def alloc_fused_batch(config: EngineConfig, depth: int) -> RequestBatch:
+    """One ``[depth, batch_size]`` stacked-frame staging block — the numpy
+    leaves :func:`decide_fused_donating` consumes. Freelist-recycled by the
+    fused dispatcher (`cluster.protocol.StagingPool`): jit copies numpy
+    arguments to device buffers during the call, so a block is safe to
+    recycle the moment the dispatch returns."""
+    N = config.batch_size
+    return RequestBatch(
+        flow_slot=np.empty((depth, N), np.int32),
+        acquire=np.empty((depth, N), np.int32),
+        prioritized=np.empty((depth, N), bool),
+        valid=np.empty((depth, N), bool),
+    )
+
+
+def make_batch_into(
+    out: RequestBatch,
+    row: int,
+    flow_slots,
+    acquires=None,
+    prioritized=None,
+) -> None:
+    """:func:`make_batch` writing into row ``row`` of a stacked staging
+    block (see :func:`alloc_fused_batch`) instead of allocating fresh
+    leaves — identical padding semantics (slot −1 / acquire 0 / prio False /
+    valid False beyond n; acquire defaults to 1 for live rows),
+    property-tested bit-identical against :func:`make_batch`."""
+    N = out.flow_slot.shape[-1]
+    n = len(flow_slots)
+    if n > N:
+        raise ValueError(f"batch of {n} exceeds configured size {N}")
+    slot, acq, prio, valid = (
+        out.flow_slot[row], out.acquire[row], out.prioritized[row],
+        out.valid[row],
+    )
+    slot[:n] = flow_slots
+    slot[n:] = -1
+    acq[:n] = 1 if acquires is None else acquires
+    acq[n:] = 0
+    prio[:n] = False if prioritized is None else prioritized
+    prio[n:] = False
+    valid[:n] = True
+    valid[n:] = False
+
+
 from sentinel_tpu.engine.prefix import segment_prefix_builder as _segment_prefix_builder
 from sentinel_tpu.ops.scan_mm import blocked_cumsum as _blocked_cumsum
 

@@ -158,6 +158,28 @@ def render(now_ms: Optional[int] = None) -> str:
         )
     lines.append(server_metrics().render())
     lines.append(ha_metrics().render())
+    # client-side receive accounting (import deferred: cluster.client pulls
+    # in the token-service stack, which this module must not load eagerly)
+    from sentinel_tpu.cluster import client as _client
+
+    lines.append(
+        "# HELP sentinel_client_recv_bytes_total Bytes received from token "
+        "servers by this process's client readers."
+    )
+    lines.append("# TYPE sentinel_client_recv_bytes_total counter")
+    lines.append(
+        f"sentinel_client_recv_bytes_total "
+        f"{_client.client_recv_bytes_total()}"
+    )
+    lines.append(
+        "# HELP sentinel_client_recv_buf_grows_total Growable receive "
+        "buffer expansions across client readers."
+    )
+    lines.append("# TYPE sentinel_client_recv_buf_grows_total counter")
+    lines.append(
+        f"sentinel_client_recv_buf_grows_total "
+        f"{_client.client_recv_buf_grows_total()}"
+    )
     return "\n".join(lines) + "\n"
 
 

@@ -123,6 +123,16 @@ class ServerMetrics:
         # that used to be invisible when _lane_put gave up silently
         self._shed: Dict[str, int] = {}
         self._shed_lock = threading.Lock()
+        # per-intake-shard pull accounting (multi-door native server):
+        # shard → {pulls, requests, busy_ms}. busy_ms is cumulative lane
+        # busy time, so occupancy over a window is rate(busy_ms)/1000.
+        self._shards: Dict[int, Dict[str, float]] = {}
+        self._shard_lock = threading.Lock()
+        # host bytes copied on the serving path (arena→staging memcpy,
+        # fusion concatenate) — the bench divides by verdicts served to
+        # report bytes-copied-per-verdict
+        self._copy_bytes = 0
+        self._copy_lock = threading.Lock()
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._gauge_lock = threading.Lock()
 
@@ -139,6 +149,35 @@ class ServerMetrics:
     def fused_frames_total(self) -> int:
         with self._fused_lock:
             return self._fused_frames
+
+    # -- intake shard + host-copy counters ----------------------------------
+    def count_shard_pull(
+        self, shard: int, n_rows: int, busy_ms: float
+    ) -> None:
+        """One intake pull handed to the device lane by ``shard``:
+        ``n_rows`` requests, ``busy_ms`` of lane busy time."""
+        with self._shard_lock:
+            s = self._shards.setdefault(
+                int(shard), {"pulls": 0, "requests": 0, "busy_ms": 0.0}
+            )
+            s["pulls"] += 1
+            s["requests"] += int(n_rows)
+            s["busy_ms"] += float(busy_ms)
+
+    def shard_totals(self) -> Dict[int, Dict[str, float]]:
+        with self._shard_lock:
+            return {k: dict(v) for k, v in self._shards.items()}
+
+    def count_copy_bytes(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._copy_lock:
+            self._copy_bytes += int(n)
+
+    @property
+    def host_copy_bytes_total(self) -> int:
+        with self._copy_lock:
+            return self._copy_bytes
 
     # -- shed counters ------------------------------------------------------
     def count_shed(self, reason: str, n: int = 1) -> None:
@@ -257,6 +296,10 @@ class ServerMetrics:
             "fusedFramesTotal": self.fused_frames_total,
             "shedTotal": self.shed_total,
             "shedByReason": self.shed_totals(),
+            "hostCopyBytesTotal": self.host_copy_bytes_total,
+            "intakeShards": {
+                str(k): v for k, v in sorted(self.shard_totals().items())
+            },
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -291,6 +334,10 @@ class ServerMetrics:
             }
         out["fused_frames_total"] = self.fused_frames_total
         out["shed_total"] = self.shed_totals()
+        out["host_copy_bytes_total"] = self.host_copy_bytes_total
+        out["intake_shards"] = {
+            str(k): v for k, v in sorted(self.shard_totals().items())
+        }
         return out
 
     def render(self) -> str:
@@ -347,6 +394,33 @@ class ServerMetrics:
             # zero-sample so the series exists before the first shed and
             # rate() queries don't gap when overload begins
             lines.append('sentinel_server_shed_total{reason="queue_full"} 0')
+        lines.append(
+            "# HELP sentinel_server_host_copy_bytes_total Host bytes "
+            "copied on the serving path (arena staging + fusion concat)."
+        )
+        lines.append("# TYPE sentinel_server_host_copy_bytes_total counter")
+        lines.append(
+            f"sentinel_server_host_copy_bytes_total "
+            f"{self.host_copy_bytes_total}"
+        )
+        shards = self.shard_totals()
+        if shards:
+            for mname, skey, help_text in (
+                ("shard_pulls_total", "pulls",
+                 "Intake pulls handed to the device lane, per shard."),
+                ("shard_requests_total", "requests",
+                 "Requests pulled through each intake shard."),
+                ("shard_intake_busy_ms_total", "busy_ms",
+                 "Cumulative intake-lane busy time per shard (ms); "
+                 "rate()/1000 is the shard's occupancy."),
+            ):
+                lines.append(f"# HELP sentinel_server_{mname} {help_text}")
+                lines.append(f"# TYPE sentinel_server_{mname} counter")
+                for shard, vals in sorted(shards.items()):
+                    lines.append(
+                        f'sentinel_server_{mname}{{shard="{shard}"}} '
+                        f"{vals[skey]:g}"
+                    )
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -403,6 +477,10 @@ class ServerMetrics:
             self._verdicts.clear()
         with self._shed_lock:
             self._shed.clear()
+        with self._shard_lock:
+            self._shards.clear()
+        with self._copy_lock:
+            self._copy_bytes = 0
         self._rate.reset()
 
 

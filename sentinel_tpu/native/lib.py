@@ -465,6 +465,46 @@ class Frontdoor:
             b["prios"][:n].astype(bool), frames,
         )
 
+    def wait_batch_into(self, staging: dict, timeout_ms: int = 100,
+                        max_n: Optional[int] = None):
+        """:meth:`wait_batch`, but decoded rows land directly in the
+        caller's ``staging`` arrays (same keys/dtypes as :meth:`_bufs`)
+        instead of thread-local buffers — the zero-copy intake path: the
+        IO thread's arena is memcpy'd once into a recycled staging block
+        and never touched by the allocator again. Returns ``None`` on
+        timeout, else ``(n, k)`` row/frame counts; the caller owns slicing
+        views out of ``staging`` and keeping the block alive until the
+        verdicts for those rows have been submitted. ``max_n`` additionally
+        clamps to the staging row capacity, and the frame-array length
+        bounds how many frames one pull may take (the remainder stays
+        queued)."""
+        from sentinel_tpu.cluster.protocol import MAX_BATCH_PER_FRAME
+
+        cap = int(staging["ids"].shape[0])
+        max_f = int(staging["f_fd"].shape[0])
+        if max_n is None:
+            max_n = cap
+        max_n = min(
+            max(int(max_n), MAX_BATCH_PER_FRAME), cap, self.arena_cap
+        )
+        n_frames = ctypes.c_int32()
+        n = self._lib.sn_fd_wait_batch(
+            self._h, timeout_ms,
+            self._ptr(staging["ids"], ctypes.c_int64),
+            self._ptr(staging["counts"], ctypes.c_int32),
+            self._ptr(staging["prios"], ctypes.c_uint8),
+            max_n,
+            self._ptr(staging["f_fd"], ctypes.c_int32),
+            self._ptr(staging["f_gen"], ctypes.c_int32),
+            self._ptr(staging["f_xid"], ctypes.c_int32),
+            self._ptr(staging["f_n"], ctypes.c_int32),
+            self._ptr(staging["f_type"], ctypes.c_uint8),
+            max_f, ctypes.byref(n_frames),
+        )
+        if n <= 0:
+            return None
+        return n, n_frames.value
+
     def submit(self, frames, status, remaining, wait_ms) -> None:
         """Encode + send verdict frames for a ``wait_batch`` result."""
         import numpy as np
@@ -493,6 +533,25 @@ class Frontdoor:
             self._ptr(remaining, ctypes.c_int32),
             self._ptr(wait_ms, ctypes.c_int32),
         )
+
+    def submit_many(self, frames_list, status, remaining, wait_ms) -> None:
+        """Answer SEVERAL ``wait_batch`` pulls with one native call.
+
+        ``frames_list`` holds each pull's frame-metadata tuple, in the same
+        order their requests are concatenated in the verdict arrays. One
+        ``sn_fd_submit`` call means one outbox lock acquisition and one IO
+        wakeup for the whole fused group, and the C++ scatter encode can
+        group consecutive same-connection frames ACROSS pull boundaries
+        into single per-writer buffers."""
+        import numpy as np
+
+        if len(frames_list) == 1:
+            return self.submit(frames_list[0], status, remaining, wait_ms)
+        merged = tuple(
+            np.concatenate([np.asarray(fr[i]) for fr in frames_list])
+            for i in range(5)
+        )
+        self.submit(merged, status, remaining, wait_ms)
 
     def send(self, fd: int, gen: int, frame: bytes) -> None:
         self._lib.sn_fd_send(self._h, fd, gen, frame, len(frame))

@@ -193,3 +193,73 @@ class TestClientReaderFuzz:
         finally:
             c.close()
             t.join(timeout=5)
+
+
+class TestDecodeIntoFuzz:
+    """The zero-copy decode entry point must agree with the reference
+    decoder on every truncation cut of a valid frame: reject everywhere the
+    reference rejects, match bit-for-bit everywhere it succeeds."""
+
+    def test_every_truncation_cut_agrees_with_reference(self):
+        rng = np.random.default_rng(SEED)
+        ids = rng.integers(-(2**62), 2**62, size=17).astype(np.int64)
+        cnt = rng.integers(-(2**31), 2**31 - 1, size=17).astype(np.int32)
+        pr = rng.integers(0, 2, size=17).astype(bool)
+        payload = P.encode_batch_request(42, ids, cnt, pr, deadline_ms=99)[2:]
+        ids_out = np.empty(64, np.int64)
+        counts_out = np.empty(64, np.int32)
+        prios_out = np.empty(64, bool)
+        for cut in range(len(payload) + 1):
+            piece = payload[:cut]
+            try:
+                ref = P.decode_batch_request(piece)
+            except (ValueError, struct.error):
+                ref = None
+            try:
+                got = P.decode_batch_request_into(
+                    piece, ids_out, counts_out, prios_out
+                )
+            except (ValueError, struct.error):
+                got = None
+            if ref is None:
+                assert got is None, f"decode_into accepted cut={cut}"
+            else:
+                assert got is not None, f"decode_into rejected cut={cut}"
+                xid, n = got
+                assert xid == ref[0] and n == len(ref[1])
+                np.testing.assert_array_equal(ids_out[:n], ref[1])
+                np.testing.assert_array_equal(counts_out[:n], ref[2])
+                np.testing.assert_array_equal(prios_out[:n], ref[3])
+
+    def test_random_blobs_never_escape_valueerror(self):
+        rng = random.Random(SEED + 7)
+        ids_out = np.empty(64, np.int64)
+        counts_out = np.empty(64, np.int32)
+        prios_out = np.empty(64, bool)
+        for _ in range(200):
+            blob = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(0, 120))
+            )
+            try:
+                P.decode_batch_request_into(
+                    blob, ids_out, counts_out, prios_out
+                )
+            except (ValueError, struct.error):
+                pass  # the only sanctioned failure modes
+
+
+@pytest.mark.skipif(not native_available(), reason="native library not built")
+class TestShardedNativeFuzz:
+    def test_garbage_never_kills_a_sharded_lane(self, svc):
+        server = NativeTokenServer(
+            svc, port=0, idle_ttl_s=None, intake_shards=2
+        )
+        server.start()
+        try:
+            # double the corpus: with two doors behind one port the kernel
+            # spreads connections, so both intake lanes eat garbage
+            _throw_garbage(server.port, _garbage_corpus(seed=SEED + 2))
+            _throw_garbage(server.port, _garbage_corpus(seed=SEED + 3))
+            _assert_still_serving(server.port)
+        finally:
+            server.stop()

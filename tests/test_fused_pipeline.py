@@ -268,3 +268,277 @@ class TestThreeLanePipeline:
             assert _SM.fused_frames_total >= 4
         finally:
             server.stop()
+
+
+# -- zero-copy host path -----------------------------------------------------
+
+from sentinel_tpu.engine import (  # noqa: E402
+    alloc_fused_batch,
+    make_batch,
+    make_batch_into,
+)
+
+
+class TestZeroCopyDecode:
+    """decode_batch_request_into must be bit-identical to the allocating
+    decoder — the rows just land in caller-owned staging."""
+
+    def test_decode_into_bit_identical_randomized(self):
+        rng = np.random.default_rng(0xD6)
+        cap = 4096
+        ids_out = np.empty(cap, np.int64)
+        counts_out = np.empty(cap, np.int32)
+        prios_out = np.empty(cap, bool)
+        at = 0
+        for trial in range(60):
+            n = int(rng.integers(0, 300))
+            if at + n > cap:
+                at = 0
+            ids = rng.integers(-(2**62), 2**62, size=n).astype(np.int64)
+            cnt = rng.integers(-(2**31), 2**31 - 1, size=n).astype(np.int32)
+            pr = rng.integers(0, 2, size=n).astype(bool)
+            xid = int(rng.integers(-(2**31), 2**31 - 1))
+            deadline = int(rng.integers(0, 3)) * 17 or None
+            payload = P.encode_batch_request(
+                xid, ids, cnt, pr, deadline_ms=deadline
+            )[2:]
+            x_ref, i_ref, c_ref, p_ref = P.decode_batch_request(payload)
+            x_new, m = P.decode_batch_request_into(
+                payload, ids_out, counts_out, prios_out, at=at
+            )
+            assert (x_new, m) == (x_ref, n) and x_ref == xid
+            np.testing.assert_array_equal(ids_out[at : at + n], i_ref)
+            np.testing.assert_array_equal(counts_out[at : at + n], c_ref)
+            np.testing.assert_array_equal(prios_out[at : at + n], p_ref)
+            at += n
+
+    def test_decode_into_rejects_truncated_and_overflow(self):
+        payload = P.encode_batch_request(7, np.arange(10, dtype=np.int64))[2:]
+        ids_out = np.empty(64, np.int64)
+        counts_out = np.empty(64, np.int32)
+        prios_out = np.empty(64, bool)
+        with pytest.raises(ValueError, match="truncated"):
+            P.decode_batch_request_into(
+                payload[:-3], ids_out, counts_out, prios_out
+            )
+        with pytest.raises(ValueError, match="staging overflow"):
+            P.decode_batch_request_into(
+                payload, ids_out, counts_out, prios_out, at=60
+            )
+        # the error paths must not have written past the staging span
+        # guard: a rejected frame leaves the arrays usable
+        xid, n = P.decode_batch_request_into(
+            payload, ids_out, counts_out, prios_out, at=0
+        )
+        assert (xid, n) == (7, 10)
+
+
+class TestScatterEncode:
+    """encode_batch_responses: uniform fast path, ragged fallback, and the
+    out= scatter buffer must all produce identical bytes."""
+
+    def _random_frames(self, rng, uniform):
+        F = int(rng.integers(1, 9))
+        if uniform:
+            counts = np.full(F, int(rng.integers(1, 65)), np.int64)
+        else:
+            counts = rng.integers(0, 65, size=F).astype(np.int64)
+        total = int(counts.sum())
+        xids = rng.integers(-(2**31), 2**31 - 1, size=F).astype(np.int64)
+        st = rng.integers(-5, 10, size=total).astype(np.int8)
+        rm = rng.integers(-(2**31), 2**31 - 1, size=total).astype(np.int32)
+        wt = rng.integers(0, 2**31 - 1, size=total).astype(np.int32)
+        return xids, counts, st, rm, wt
+
+    def test_scatter_encode_bit_identical_randomized(self):
+        rng = np.random.default_rng(0xE7)
+        for trial in range(40):
+            xids, counts, st, rm, wt = self._random_frames(
+                rng, uniform=bool(trial % 2)
+            )
+            blob = P.encode_batch_responses(xids, counts, st, rm, wt)
+            # reference: one single-frame encode per frame, concatenated
+            ref = b""
+            off = 0
+            for f in range(len(xids)):
+                n = int(counts[f])
+                ref += P.encode_batch_response(
+                    int(xids[f]), st[off : off + n], rm[off : off + n],
+                    wt[off : off + n],
+                )
+                off += n
+            assert blob == ref
+            assert len(blob) == P.batch_responses_size(counts)
+            # scatter path: same bytes laid into a reused bytearray
+            buf = bytearray()
+            mv = P.encode_batch_responses(xids, counts, st, rm, wt, out=buf)
+            assert bytes(mv) == ref
+            # second encode into the SAME buffer (steady-state reuse)
+            mv2 = P.encode_batch_responses(xids, counts, st, rm, wt, out=buf)
+            assert bytes(mv2) == ref
+
+    def test_out_buffer_grows_then_steady(self):
+        xids = np.array([1, 2], np.int64)
+        counts = np.array([3, 3], np.int64)
+        st = np.zeros(6, np.int8)
+        rm = np.zeros(6, np.int32)
+        wt = np.zeros(6, np.int32)
+        buf = bytearray(4)  # deliberately too small
+        mv = P.encode_batch_responses(xids, counts, st, rm, wt, out=buf)
+        assert len(mv) == P.batch_responses_size(counts)
+        assert len(buf) >= len(mv)
+        cap_after_grow = len(buf)
+        P.encode_batch_responses(xids, counts, st, rm, wt, out=buf)
+        assert len(buf) == cap_after_grow  # no regrow on reuse
+
+
+class TestStagingPool:
+    def test_reuse_after_release(self):
+        made = []
+
+        def factory():
+            made.append(object())
+            return made[-1]
+
+        pool = P.StagingPool(factory, capacity=2)
+        a, b, c = pool.acquire(), pool.acquire(), pool.acquire()
+        assert (pool.built, pool.reused) == (3, 0)
+        pool.release(a)
+        assert pool.acquire() is a  # LIFO recycle, no fresh build
+        assert (pool.built, pool.reused) == (3, 1)
+        pool.release(None)  # tolerated no-op
+        pool.release(a)
+        pool.release(b)
+        pool.release(c)  # over capacity: dropped, not parked
+        assert pool.acquire() in (a, b)
+        assert pool.acquire() in (a, b)
+        assert pool.built == 3 and pool.reused == 3
+        # freelist drained → next acquire builds fresh
+        pool.acquire()
+        assert pool.built == 4
+
+
+class TestMakeBatchInto:
+    def test_bit_identical_to_make_batch_randomized(self):
+        rng = np.random.default_rng(0xF8)
+        depth = 3
+        block = alloc_fused_batch(CFG, depth)
+        for trial in range(30):
+            f = int(rng.integers(0, depth))
+            n = int(rng.integers(0, CFG.batch_size + 1))
+            slots = rng.integers(0, 64, size=n).astype(np.int32)
+            acq = rng.integers(1, 5, size=n).astype(np.int32)
+            pr = rng.integers(0, 2, size=n).astype(bool)
+            if trial % 3 == 0:
+                make_batch_into(block, f, slots)
+                ref = make_batch(CFG, slots)
+            else:
+                make_batch_into(block, f, slots, acq, pr)
+                ref = make_batch(CFG, slots, acq, pr)
+            np.testing.assert_array_equal(block.flow_slot[f], ref.flow_slot)
+            np.testing.assert_array_equal(block.acquire[f], ref.acquire)
+            np.testing.assert_array_equal(
+                block.prioritized[f], ref.prioritized
+            )
+            np.testing.assert_array_equal(block.valid[f], ref.valid)
+
+    def test_oversized_row_raises(self):
+        block = alloc_fused_batch(CFG, 1)
+        with pytest.raises(ValueError):
+            make_batch_into(
+                block, 0, np.zeros(CFG.batch_size + 1, np.int32)
+            )
+
+
+@native_only
+class TestShardedIntake:
+    """SO_REUSEPORT multi-door intake: N doors on one port, per-shard
+    queues, one device lane draining the union."""
+
+    def _server(self, **kw):
+        svc = DefaultTokenService(SRV_CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=2, count=1e9, mode=G)])
+        server = NativeTokenServer(svc, port=0, idle_ttl_s=None, **kw)
+        server.start()
+        return server
+
+    def test_doors_share_one_port_and_lose_no_xids(self):
+        _SM.reset()
+        server = self._server(intake_shards=2, fuse_depth=4)
+        try:
+            assert len(server._doors) == 2
+            assert all(d.port == server.port for d in server._doors)
+            assert server.tuning_kwargs()["intake_shards"] == 2
+            per_client, rows = 25, 128
+            ids = np.full(rows, 2, np.int64)
+
+            def run_client(tag, results):
+                with socket.create_connection(
+                    ("127.0.0.1", server.port), timeout=15
+                ) as s:
+                    s.sendall(
+                        b"".join(
+                            P.encode_batch_request(tag * 1000 + i, ids)
+                            for i in range(per_client)
+                        )
+                    )
+                    frames, _ = _read_frames(s, per_client)
+                    results[tag] = sorted(
+                        P.decode_batch_response(raw)[0] for raw in frames
+                    )
+
+            # several connections so the kernel's REUSEPORT hash has a
+            # chance to spread them across both doors (not guaranteed —
+            # correctness must hold either way)
+            results = {}
+            clients = [
+                threading.Thread(target=run_client, args=(t, results))
+                for t in range(1, 7)
+            ]
+            for t in clients:
+                t.start()
+            for t in clients:
+                t.join(timeout=30)
+            for tag in range(1, 7):
+                assert results[tag] == [
+                    tag * 1000 + i for i in range(per_client)
+                ]
+            # aggregated door stats cover every frame exactly once
+            st = server.stats()
+            assert st["requests_in"] == 6 * per_client * rows
+            shard_rows = sum(
+                s["requests"] for s in _SM.shard_totals().values()
+            )
+            assert shard_rows == 6 * per_client * rows
+        finally:
+            server.stop()
+
+    def test_staging_blocks_recycle_not_leak(self):
+        server = self._server(intake_shards=2, fuse_depth=4)
+        try:
+            pool = server._staging
+            with socket.create_connection(
+                ("127.0.0.1", server.port), timeout=15
+            ) as s:
+                for round_ in range(6):
+                    s.sendall(
+                        b"".join(
+                            P.encode_batch_request(
+                                round_ * 10 + i, np.full(256, 2, np.int64)
+                            )
+                            for i in range(8)
+                        )
+                    )
+                    _read_frames(s, 8)
+            # quiesced: every block except the one each intake lane holds
+            # must be back on the freelist (a leak would strand blocks)
+            expected_free = pool.built - server.intake_shards
+            deadline = time.time() + 2.0
+            while time.time() < deadline:
+                if len(pool._free) == expected_free:
+                    break
+                time.sleep(0.01)
+            assert len(pool._free) == expected_free
+            assert pool.reused > 0  # steady state recycles, not reallocs
+        finally:
+            server.stop()

@@ -328,6 +328,14 @@ class TestNativeMixedSoak:
         from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
 
         server, svc = native_server
+        # lift the namespace guard out of the way: the zero-copy host path
+        # serves a pipelined pump well past the 30k-QPS default, and this
+        # soak asserts the always-loaded RULES never block — the ns cap
+        # has its own tests
+        svc.load_rules([
+            ClusterFlowRule(flow_id=1, count=5.0, mode=G),
+            ClusterFlowRule(flow_id=2, count=1e9, mode=G),
+        ], ns_max_qps=1e12)
         svc.load_param_rules([ClusterParamFlowRule(flow_id=3, count=1e9)])
         # timeout far above the soak duration: a descheduled holder must
         # not have its token swept mid-test (that would be a flake, and

@@ -81,11 +81,39 @@ def _pcts(rtt_ms: np.ndarray) -> dict:
     }
 
 
+def force_virtual_cpu_devices(n: int) -> None:
+    """Pin jax to a CPU backend exposing ``n`` virtual devices. Must run
+    before the first CPU-backend creation; ``jax_platforms`` is updated via
+    config (the environment may preload jax against an accelerator plugin,
+    so a plain env var arrives too late — same recipe as tests/conftest.py
+    and ``__graft_entry__._force_virtual_cpu_mesh``)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    got = len(jax.devices())
+    if got < n:
+        raise RuntimeError(
+            f"wanted {n} virtual CPU devices, backend exposes {got} "
+            "(jax already initialized before the flag?)"
+        )
+
+
 def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                  serve_buckets=(4096, 16384), native: bool = True,
                  port: int = 0, n_dispatchers: int = 2,
-                 fuse_depth: int = 4, intake_shards: int = 1):
-    """Service (100k rules — the headline's problem size) + front door."""
+                 fuse_depth: int = 4, intake_shards: int = 1,
+                 mesh_devices: int = 0):
+    """Service (100k rules — the headline's problem size) + front door.
+
+    ``mesh_devices > 0`` backs the service with a flow-sharded mesh over
+    that many devices (the caller must have made them visible — see
+    :func:`force_virtual_cpu_devices` for the CPU-mesh recipe); the front
+    door and everything behind it is unchanged, which is the point."""
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
     from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
@@ -94,7 +122,16 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
     config = EngineConfig(
         max_flows=n_flows, max_namespaces=64, batch_size=max_batch
     )
-    service = DefaultTokenService(config, serve_buckets=serve_buckets)
+    mesh = None
+    if mesh_devices:
+        import jax
+
+        from sentinel_tpu.parallel import make_flow_mesh
+
+        mesh = make_flow_mesh(jax.devices()[:mesh_devices])
+    service = DefaultTokenService(
+        config, serve_buckets=serve_buckets, mesh=mesh
+    )
     service.load_rules(
         [
             ClusterFlowRule(flow_id=i, count=1e9, mode=ThresholdMode.GLOBAL,
@@ -308,7 +345,9 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                   n_flows: int = 100_000, max_batch: int = 16384,
                   n_dispatchers: int = None, budget_s: float = None,
                   intake_shards: int = 1,
-                  single_door_baseline: bool = False) -> dict:
+                  single_door_baseline: bool = False,
+                  mesh_devices: int = 0,
+                  mesh_control: bool = True) -> dict:
     """Full measurement on the CURRENT backend (caller configured jax).
 
     ``closed_kw`` may be one closed-loop config (dict) or a list of
@@ -336,7 +375,7 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
     service, server, front_door = build_server(
         n_flows=n_flows, max_batch=max_batch, native=native,
         n_dispatchers=n_dispatchers, serve_buckets=buckets,
-        intake_shards=intake_shards,
+        intake_shards=intake_shards, mesh_devices=mesh_devices,
     )
     try:
         candidates = (closed_kw if isinstance(closed_kw, (list, tuple))
@@ -455,6 +494,54 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
         finally:
             srv_b.stop()
             svc_b.close()
+    mesh_block = None
+    if mesh_devices:
+        mesh_block = {
+            "n_devices": mesh_devices,
+            "per_shard_rows": n_flows // mesh_devices,
+            "service_ceiling_vps": round(ceiling),
+        }
+        if mesh_control:
+            # same-run single-shard control: same host, same client config,
+            # same backend warmth — the honest denominator for any mesh
+            # claim. The ceiling ratio isolates psum-stitch + shard_map
+            # overhead per step (the TCP numbers fold in the host path,
+            # which the mesh leaves untouched by design).
+            svc_c, srv_c, _ = build_server(
+                n_flows=n_flows, max_batch=max_batch, native=native,
+                n_dispatchers=n_dispatchers, serve_buckets=buckets,
+                intake_shards=intake_shards, mesh_devices=0,
+            )
+            try:
+                c = run_closed(srv_c.port, n_flows=n_flows, **winning_kw)
+                rng = np.random.default_rng(0)
+                ids = rng.integers(0, n_flows, size=max_batch).astype(
+                    np.int64
+                )
+                for _ in range(3):
+                    svc_c.request_batch_arrays(ids)
+                t0 = time.perf_counter()
+                reps = 20
+                for _ in range(reps):
+                    svc_c.request_batch_arrays(ids)
+                ceiling_c = max_batch * reps / (time.perf_counter() - t0)
+                mesh_block["single_shard_control"] = {
+                    "verdicts_per_sec": c["verdicts_per_sec"],
+                    "p50_ms": c["p50_ms"],
+                    "p99_ms": c["p99_ms"],
+                    "errors": c["errors"],
+                    "service_ceiling_vps": round(ceiling_c),
+                }
+                # >1 means the sharded step costs that factor more per
+                # dispatch than the single-shard step on THIS backend (on
+                # a 1-core CPU mesh all shards time-slice one core, so
+                # expect well above 1; on real ICI this is the psum tax)
+                mesh_block["psum_overhead_step_ratio"] = round(
+                    ceiling_c / ceiling, 3
+                ) if ceiling else None
+            finally:
+                srv_c.stop()
+                svc_c.close()
     op = operating_point(curve)
     # HA probe rides the artifact: failover convergence + the all-down
     # fallback window's blocked-rate. Never aborts the measurement — a
@@ -493,6 +580,7 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
             closed["verdicts_per_sec"] / ceiling, 3
         ) if ceiling else None,
         "ha": ha,
+        **({"mesh": mesh_block} if mesh_block else {}),
         **({"single_door_baseline": baseline,
             "sharding_speedup": round(
                 closed["verdicts_per_sec"]
@@ -514,6 +602,13 @@ def main() -> None:
     ap.add_argument("--single-door-baseline", action="store_true",
                     help="with --intake-shards > 1, also measure a "
                          "same-config intake_shards=1 control run")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="back the service with a flow-sharded mesh over N "
+                         "devices; off a real pod this forces N virtual CPU "
+                         "devices. Records a `mesh` artifact block with a "
+                         "same-run single-shard control")
+    ap.add_argument("--no-mesh-control", action="store_true",
+                    help="skip the single-shard control run in mesh mode")
     ap.add_argument("--clients", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--pipeline", type=int, default=None)
@@ -526,12 +621,20 @@ def main() -> None:
     } or None
     import jax
 
-    if args.cpu:
+    # NOTE: checked via env, not jax.default_backend() — that call would
+    # initialize the backend before force_virtual_cpu_devices can act
+    on_tpu = os.environ.get("JAX_PLATFORMS", "").startswith("tpu")
+    if args.mesh_devices and not on_tpu:
+        # virtual CPU mesh: must be forced before backend creation
+        force_virtual_cpu_devices(args.mesh_devices)
+    elif args.cpu:
         jax.config.update("jax_platforms", "cpu")
     doc = serve_measure(
         native=not args.no_native, n_flows=args.flows,
         closed_kw=closed_kw, intake_shards=args.intake_shards,
         single_door_baseline=args.single_door_baseline,
+        mesh_devices=args.mesh_devices,
+        mesh_control=not args.no_mesh_control,
     )
     line = json.dumps(
         {

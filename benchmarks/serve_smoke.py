@@ -43,17 +43,75 @@ REF_PATH = os.path.join(
 REF_DERATE = 0.5
 
 
-def run_smoke(seconds: float = 4.0, intake_shards: int = 1) -> dict:
-    import jax
+def _xid_probe(port: int, n_flows: int, frames: int = 24,
+               batch: int = 1024) -> dict:
+    """Pipelined xid-exactness check through the real door: send ``frames``
+    BATCH_FLOW requests with distinct xids on one connection without
+    reading, then drain — every xid must come back exactly once, every
+    response row count must match its request. The closed-loop bench
+    counts errors but matches frames positionally; under a fused sharded
+    device lane THIS is the gate that catches a reply lane slicing a fused
+    group against the wrong frame order."""
+    import socket
 
-    jax.config.update("jax_platforms", "cpu")
-    from benchmarks.serve_bench import build_server, run_closed
+    import numpy as np
+
+    from sentinel_tpu.cluster import protocol as P
+
+    rng = np.random.default_rng(7)
+    sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    reader = P.FrameReader()
+    sent = {}
+    try:
+        for k in range(frames):
+            xid = 0x5EED0000 + k  # high but inside the signed-int32 xid field
+            ids = rng.integers(0, n_flows, size=batch).astype(np.int64)
+            sent[xid] = batch
+            sock.sendall(P.encode_batch_request(xid, ids))
+        got = {}
+        while len(got) < frames:
+            data = sock.recv(65536)
+            if not data:
+                break
+            for payload in reader.feed(data):
+                if P.peek_type(payload) != P.MsgType.BATCH_FLOW:
+                    continue
+                xid, status, _rem, _wait = P.decode_batch_response(payload)
+                got[xid] = got.get(xid, 0) + len(status)
+    finally:
+        sock.close()
+    mismatches = sorted(
+        x for x in set(sent) | set(got) if sent.get(x) != got.get(x)
+    )
+    return {
+        "frames_sent": frames,
+        "frames_answered": len(got),
+        "xid_mismatches": [hex(x) for x in mismatches],
+        "exact": not mismatches,
+    }
+
+
+def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
+              mesh_devices: int = 0) -> dict:
+    from benchmarks.serve_bench import (
+        build_server,
+        force_virtual_cpu_devices,
+        run_closed,
+    )
+
+    if mesh_devices:
+        force_virtual_cpu_devices(mesh_devices)
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     n_flows = 10_000
     service, server, front_door = build_server(
         n_flows=n_flows, max_batch=4096, serve_buckets=(1024, 4096),
         native=True, n_dispatchers=2, fuse_depth=4,
-        intake_shards=intake_shards,
+        intake_shards=intake_shards, mesh_devices=mesh_devices,
     )
     try:
         from sentinel_tpu.metrics.server import server_metrics
@@ -66,18 +124,22 @@ def run_smoke(seconds: float = 4.0, intake_shards: int = 1) -> dict:
         )
         fused = sm.fused_frames_total
         depth = sm.fused_depth.snapshot()
+        xid = _xid_probe(server.port, n_flows)
     finally:
         server.stop()
         service.close()
     return {
         "front_door": front_door,
         "intake_shards": intake_shards,
+        "mesh_devices": mesh_devices or None,
         "verdicts_per_sec": closed["verdicts_per_sec"],
         "p50_ms": closed["p50_ms"],
         "p99_ms": closed["p99_ms"],
         "errors": closed["errors"],
+        "verdicts_ok": closed["verdicts_ok"],
         "fused_frames_total": fused,
         "fused_depth_max": depth.get("max"),
+        "xid_probe": xid,
         "seconds": seconds,
     }
 
@@ -94,10 +156,45 @@ def main() -> int:
     ap.add_argument("--intake-shards", type=int, default=1,
                     help="SO_REUSEPORT intake shards on the native door; "
                          "the committed floor gates both 1 and 2")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="back the service with a flow-sharded virtual CPU "
+                         "mesh over N devices. Gates CORRECTNESS (zero "
+                         "client errors, xid exactness, fusion ladder "
+                         "active under the mesh), not the single-shard "
+                         "rate floor — N shards time-slicing one CI core "
+                         "are legitimately slower")
     args = ap.parse_args()
 
-    doc = run_smoke(seconds=args.seconds, intake_shards=args.intake_shards)
+    doc = run_smoke(seconds=args.seconds, intake_shards=args.intake_shards,
+                    mesh_devices=args.mesh_devices)
     print(json.dumps(doc, indent=2))
+
+    if args.mesh_devices:
+        failures = []
+        if doc["errors"]:
+            failures.append(f"{doc['errors']} client-observed errors")
+        if not doc["verdicts_ok"]:
+            failures.append("zero verdicts served through the mesh")
+        if not doc["fused_frames_total"]:
+            failures.append(
+                "fusion ladder never fired under the mesh "
+                "(sharded-fused dispatch inactive)"
+            )
+        if not doc["xid_probe"]["exact"]:
+            failures.append(
+                f"xid probe mismatches: {doc['xid_probe']['xid_mismatches']}"
+            )
+        if failures:
+            for f_ in failures:
+                print(f"MESH SMOKE FAIL: {f_}", file=sys.stderr)
+            return 1
+        print(
+            f"MESH SMOKE OK: {doc['verdicts_per_sec']} verdicts/s over "
+            f"{args.mesh_devices} shards, fused_frames="
+            f"{doc['fused_frames_total']} (max depth "
+            f"{doc['fused_depth_max']}), xid exact"
+        )
+        return 0
 
     if args.update_ref:
         ref = {

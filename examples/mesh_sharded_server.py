@@ -6,6 +6,14 @@ devices (here: an 8-device virtual CPU mesh standing in for a v5e-8);
 jitted step (``parallel/sharding.py``), and the TCP front door serves that
 sharded step exactly like a single-chip one — clients cannot tell.
 
+This demo exercises the REAL serving path, not a demo fork of it: the
+mesh-backed service runs the same donating sharded step, greedy fusion
+ladder (oversized pulls fold into one ``lax.scan``-of-``shard_map`` device
+dispatch), prep cache, and staging freelists as production serving — the
+mesh only changes the step function (``docs/PERF.md`` "Pod serving"). The
+same layout snapshots and delta-replicates to standbys of any mesh shape
+(``docs/CLUSTER_HA.md``).
+
 reference shape: one embedded token server owning its namespace's flows
 (``DefaultTokenService.java:36-97`` + ``NettyTransportServer.java:73-101``);
 the intra-pod flow-axis sharding is the TPU-build extension (SURVEY.md §7.5,

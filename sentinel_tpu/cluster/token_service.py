@@ -346,11 +346,12 @@ class DefaultTokenService(TokenService):
         fast path keys on the callable identity), which at serving rates
         costs more than the kernel itself.
 
-        The single-shard step DONATES the state buffers: every serving
-        step scatter-updates the full [max_flows, buckets, events] window
+        BOTH steps DONATE the state buffers: every serving step
+        scatter-updates the full [max_flows, buckets, events] window
         tensors, and without donation XLA must copy them first (measured
         22% of the 64-bucket step at 100k flows on CPU; on TPU it is HBM
-        traffic and allocator churn). Safe because the service lock makes
+        traffic and allocator churn — and under a mesh the copy is paid
+        per shard, every dispatch). Safe because the service lock makes
         `self._state, verdicts = step(self._state, …)` the only reader of
         the old buffer, and warmup feeds throwaway states. If a dispatch
         ever raises AFTER consuming its donated input, later steps fail
@@ -368,7 +369,7 @@ class DefaultTokenService(TokenService):
             from sentinel_tpu.parallel.sharding import make_sharded_decide
 
             step = make_sharded_decide(
-                cfg, self.mesh, grouped=True, uniform=uniform
+                cfg, self.mesh, grouped=True, uniform=uniform, donate=True
             )
         self._sharded_steps[key] = step
         return step
@@ -378,17 +379,27 @@ class DefaultTokenService(TokenService):
         variant — ``lax.scan`` of the donated-state step over ``depth``
         stacked full-``batch_size`` frames. Cached per variant for the same
         reason as :meth:`_step_fn` (fresh closures would route every fused
-        dispatch through pjit's slow path). Single-shard only — the caller
-        skips fusion when a mesh is set."""
+        dispatch through pjit's slow path). Under a mesh the scan runs
+        inside one ``shard_map`` entry and psum-stitches each frame's
+        verdicts before the next frame decides — same per-frame semantics,
+        one dispatch."""
         key = (depth, uniform)
         step = self._fused_steps.get(key)
         if step is not None:
             return step
-        from sentinel_tpu.engine.decide import decide_fused_donating
+        if self.mesh is None:
+            from sentinel_tpu.engine.decide import decide_fused_donating
 
-        step = decide_fused_donating(
-            self.config, depth, grouped=True, uniform=uniform
-        )
+            step = decide_fused_donating(
+                self.config, depth, grouped=True, uniform=uniform
+            )
+        else:
+            from sentinel_tpu.parallel.sharding import make_sharded_decide
+
+            step = make_sharded_decide(
+                self.config, self.mesh, grouped=True, uniform=uniform,
+                donate=True, depth=depth,
+            )
         self._fused_steps[key] = step
         return step
 
@@ -601,25 +612,36 @@ class DefaultTokenService(TokenService):
             # returns a same-shaped state, chaining keeps warmup at a
             # single extra state allocation instead of one per variant.
             ws = self._place_state(make_state(self.config))
+            compiles = 0
             for bucket in self._serve_buckets:
                 cfg = self.config._replace(batch_size=bucket)
                 batch = make_batch(cfg, [-1])
                 for uniform in (True, False):
                     step = self._step_fn(bucket, uniform)
                     ws, _ = step(ws, self._table, batch, jnp.int32(now))
+                    compiles += 1
             # fused multi-frame variants (full batch_size frames only):
-            # compile the ladder's scan depths for the uniform-acquire
-            # common case so the first oversized pull doesn't pay scan
-            # compilation while holding the service lock. Mixed-acquire
-            # fused spans are rare and compile lazily on first use.
-            if self.mesh is None:
-                base = make_batch(self.config, [-1])
-                for fdepth in self._fuse_depths:
-                    stacked = type(base)(
-                        *(np.stack([leaf] * fdepth) for leaf in base)
-                    )
-                    step = self._fused_step_fn(fdepth, True)
+            # compile the ladder's scan depths so the first oversized pull
+            # doesn't pay scan compilation while holding the service lock.
+            # Single-shard warms the uniform-acquire common case only
+            # (mixed-acquire fused spans are rare and compile lazily);
+            # under a mesh, warm EVERY (depth, uniform) sharded-fused
+            # bucket — mesh compiles are far slower, and a cold bucket in
+            # the serving window would stall the whole pod's device lane.
+            fused_uniforms = (True,) if self.mesh is None else (True, False)
+            base = make_batch(self.config, [-1])
+            for fdepth in self._fuse_depths:
+                stacked = type(base)(
+                    *(np.stack([leaf] * fdepth) for leaf in base)
+                )
+                for uniform in fused_uniforms:
+                    step = self._fused_step_fn(fdepth, uniform)
                     ws, _ = step(ws, self._table, stacked, jnp.int32(now))
+                    compiles += 1
+            # compile counts on the cluster stat log: a serving window
+            # that shows more compiles than warmup recorded hit a cold
+            # bucket (shape drift, ladder change) — visible, not silent.
+            log_cluster("warmup_step_compiles", count=compiles)
             idx = hash_indices(
                 np.zeros(1, np.int64), self.param_config.depth, self.param_config.width
             )
@@ -798,13 +820,14 @@ class DefaultTokenService(TokenService):
         BENCH_r05) is then paid once per fused group instead of once per
         frame. Leftovers and sub-``cap`` tails take the ordinary per-chunk
         path. As before, ALL dispatches are issued before any chunk
-        materializes, so one big pull pipelines internally; fusion is
-        skipped entirely when the ladder is empty or the service runs over
-        a mesh (the sharded step has its own dispatch machinery).
+        materializes, so one big pull pipelines internally. The ladder runs
+        identically over a mesh — the fused step is then one ``shard_map``
+        entry scanning the sharded step (psum stitch per frame), and the
+        staging/prep machinery below is mesh-oblivious by construction.
         """
         mats = []
         pos = 0
-        ladder = self._fuse_depths if self.mesh is None else ()
+        ladder = self._fuse_depths
         while ladder and (n - pos) // cap >= ladder[-1]:
             depth = next(
                 (d for d in ladder if d <= (n - pos) // cap), None
@@ -1307,14 +1330,21 @@ class DefaultTokenService(TokenService):
                 "ns_starts": np.asarray(self._state.ns.starts),
                 "param_starts": np.asarray(self._param_state.starts),
             }
+            # row gathers go through the shard-aware host collector: on a
+            # mesh it walks addressable shards and numpy-gathers each one's
+            # slab (the delta's row keys stay GLOBAL slots, so the wire
+            # document is identical whatever mesh produced it); single-shard
+            # it is one host copy + numpy index. Either way no device gather
+            # kernel — the dirty set's size varies every tick, and a device
+            # gather would pay a fresh XLA compile per distinct row count.
+            from sentinel_tpu.parallel.sharding import host_rows
             if flow_slots:
                 sl = np.asarray(flow_slots, np.int32)
                 rev = {v: k for k, v in self._index.slot_of.items()}
                 delta["flow_ids"] = [int(rev[s]) for s in flow_slots]
-                # one fancy-indexed device gather per tensor, host-copied
-                delta["flow_counts"] = np.asarray(self._state.flow.counts[sl])
-                delta["occupy_counts"] = np.asarray(
-                    self._state.occupy.counts[sl]
+                delta["flow_counts"] = host_rows(self._state.flow.counts, sl)
+                delta["occupy_counts"] = host_rows(
+                    self._state.occupy.counts, sl
                 )
                 # namespace guard rows these slots feed
                 ns_names, slot_ns = self._ns_snapshot
@@ -1323,8 +1353,8 @@ class DefaultTokenService(TokenService):
                 )
                 if rows:
                     delta["ns_names"] = [ns_names[r] for r in rows]
-                    delta["ns_counts"] = np.asarray(
-                        self._state.ns.counts[np.asarray(rows, np.int32)]
+                    delta["ns_counts"] = host_rows(
+                        self._state.ns.counts, np.asarray(rows, np.int32)
                     )
             if param_slots:
                 pr = np.asarray(param_slots, np.int32)
@@ -1332,9 +1362,7 @@ class DefaultTokenService(TokenService):
                     s: fid for fid, (s, _, _) in self._param_rules.items()
                 }
                 delta["param_fids"] = [int(prev[s]) for s in param_slots]
-                delta["param_counts"] = np.asarray(
-                    self._param_state.counts[pr]
-                )
+                delta["param_counts"] = host_rows(self._param_state.counts, pr)
             return delta
 
     def apply_replication_delta(self, delta: Dict[str, object]) -> None:

@@ -417,5 +417,14 @@ class TestNativeMixedSoak:
             t.join(timeout=30)
         assert [t for t in threads if t.is_alive()] == []  # no wedged pump
         assert failures == []
+        # the namespace guard must not have been the limiter: the pinned
+        # cap survives every reload above (load_rules only overwrites
+        # ns_max_qps when passed explicitly), both in the host config and
+        # in the live device table the decide step actually reads. If
+        # either drifted back toward the 30k default, the pump would block
+        # on the guard and this soak would be testing the wrong thing.
+        assert svc._ns_max_qps == 1e12
+        # the device table stores float32, so compare in float32
+        assert np.asarray(svc._table.ns_max_qps).min() == np.float32(1e12)
         # semaphore fully released after the soak
         assert svc.concurrency.now_calls(9) == 0

@@ -161,6 +161,54 @@ class TestDeltaApply:
         s = standby.metrics_snapshot()
         assert p[1]["pass_qps"] == s[1]["pass_qps"] > 0
 
+    @pytest.mark.parametrize("standby_devices", [1, 4])
+    def test_mesh_primary_delta_converges(self, standby_devices):
+        """PR-7 sharded replication: a mesh-backed primary's export_delta
+        (shard-aware host row gather) lands bit-for-bit on a standby with
+        a DIFFERENT mesh shape — through the real rev-3 blob codecs."""
+        import jax
+
+        from sentinel_tpu.parallel import make_flow_mesh
+
+        mesh = make_flow_mesh()
+        primary = DefaultTokenService(CFG, mesh=mesh)
+        primary.load_rules(
+            [ClusterFlowRule(flow_id=i, count=1e9, mode=G) for i in range(16)]
+        )
+        primary.replication_enable()
+        standby_mesh = (
+            None if standby_devices == 1
+            else make_flow_mesh(jax.devices()[:standby_devices])
+        )
+        standby = DefaultTokenService(CFG, mesh=standby_mesh)
+        standby.import_state(
+            R.decode_snapshot_blob(
+                R.encode_snapshot_blob(primary.export_state())
+            )
+        )
+        ids = np.tile(np.arange(16, dtype=np.int64), 8)
+        primary.request_batch_arrays(ids)
+        delta = R.decode_delta_blob(
+            R.encode_delta_blob(primary.export_delta())
+        )
+        assert delta.get("flow_ids"), "dirty rows expected"
+        standby.apply_replication_delta(delta)
+        np.testing.assert_array_equal(
+            np.asarray(standby._state.flow.counts),
+            np.asarray(primary._state.flow.counts),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(standby._state.ns.counts),
+            np.asarray(primary._state.ns.counts),
+        )
+        if standby_mesh is not None:
+            assert (
+                len(standby._state.flow.counts.addressable_shards)
+                == standby_devices
+            )
+        primary.close()
+        standby.close()
+
     def test_idle_tick_ships_heartbeat_delta(self):
         primary = _service()
         standby = _service()

@@ -179,6 +179,151 @@ class TestShardedParity:
             assert ok_per_flow[f] == 2 + (f % 3)  # count=2+(f%3)
 
 
+class TestShardedDonationAndFusion:
+    """The donating + fused sharded step (PR 7): donation must hold (no
+    full sharded-state copy per dispatch) and the fused scan must be
+    bit-identical, frame by frame, to sequential sharded dispatches."""
+
+    def test_sharded_step_donates_state(self, mesh):
+        rules, table, index = _build()
+        step = make_sharded_decide(CFG, mesh, donate=True)
+        state = shard_state(make_state(CFG), mesh)
+        table_8 = shard_rules(table, mesh)
+        batch = make_batch(CFG, [index.lookup(0)] * 4)
+        new_state, _ = step(state, table_8, batch, jnp.int32(10_000))
+        # the donated input's buffers are gone — XLA updated them in place
+        assert state.flow.counts.is_deleted()
+        assert state.occupy.counts.is_deleted()
+        # and the result is still properly sharded for the next dispatch
+        assert len(new_state.flow.counts.addressable_shards) == 8
+
+    @pytest.mark.parametrize("depth", [2, 4])
+    def test_fused_sharded_bit_identical_per_frame(self, mesh, depth):
+        """scan(depth) of the sharded step == depth sequential sharded
+        dispatches, per-frame verdicts AND final state, bit for bit."""
+        rules, table, index = _build(num_rules=16, count=6.0)
+        table_8 = shard_rules(table, mesh)
+        plain = make_sharded_decide(CFG, mesh, grouped=True, uniform=True)
+        fused = make_sharded_decide(
+            CFG, mesh, grouped=True, uniform=True, donate=True, depth=depth
+        )
+        rng = np.random.default_rng(11)
+        frames = []
+        for _ in range(depth):
+            slots = np.sort(
+                np.asarray(
+                    [index.lookup(int(f))
+                     for f in rng.integers(0, 16, CFG.batch_size)],
+                    np.int32,
+                )
+            )
+            frames.append(make_batch(CFG, slots))
+        seq_state = shard_state(make_state(CFG), mesh)
+        seq_verdicts = []
+        for b in frames:
+            seq_state, v = plain(seq_state, table_8, b, jnp.int32(10_000))
+            seq_verdicts.append(jax.tree.map(np.asarray, v))
+        stacked = type(frames[0])(
+            *(np.stack([getattr(b, k) for b in frames])
+              for k in frames[0]._fields)
+        )
+        fused_state = shard_state(make_state(CFG), mesh)
+        out_state, fv = fused(fused_state, table_8, stacked, jnp.int32(10_000))
+        assert fused_state.flow.counts.is_deleted()  # donated
+        fv = jax.tree.map(np.asarray, fv)
+        for f in range(depth):
+            for leaf in ("status", "wait_ms", "remaining"):
+                np.testing.assert_array_equal(
+                    getattr(seq_verdicts[f], leaf), getattr(fv, leaf)[f],
+                    err_msg=f"fused frame {f} {leaf} diverged",
+                )
+        np.testing.assert_array_equal(
+            np.asarray(out_state.flow.counts), np.asarray(seq_state.flow.counts)
+        )
+
+    def test_host_rows_gathers_sharded_and_replicated(self, mesh):
+        from sentinel_tpu.parallel.sharding import host_rows
+
+        state = shard_state(make_state(CFG), mesh)
+        ramp = jnp.arange(64, dtype=state.flow.counts.dtype)[:, None, None]
+        counts = state.flow.counts + ramp
+        rows = np.asarray([0, 7, 8, 33, 63], np.int32)  # spans 4 shards
+        got = host_rows(counts, rows)
+        np.testing.assert_array_equal(got, np.asarray(counts)[rows])
+        # replicated leaf takes the plain-copy path
+        got_s = host_rows(state.flow.starts, np.asarray([0, 1], np.int32))
+        np.testing.assert_array_equal(got_s, np.asarray(state.flow.starts)[:2])
+
+
+class TestShardedSnapshotRoundTrip:
+    """export_state on a mesh-backed primary → import_state on a standby
+    with a DIFFERENT mesh shape (including no mesh at all): counters land
+    bit-for-bit, re-sharded to the importer's own layout."""
+
+    def _primed(self, mesh):
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+        svc = DefaultTokenService(CFG, mesh=mesh)
+        svc.load_rules(
+            [ClusterFlowRule(flow_id=i, count=1e9, mode=G) for i in range(16)]
+        )
+        ids = np.tile(np.arange(16, dtype=np.int64), 8)
+        svc.request_batch_arrays(ids)
+        return svc
+
+    @pytest.mark.parametrize("standby_devices", [1, 4])
+    def test_mesh_snapshot_onto_different_mesh_shape(
+        self, mesh, standby_devices
+    ):
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+        svc = self._primed(mesh)
+        snap = svc.export_state()
+        standby_mesh = (
+            None if standby_devices == 1
+            else make_flow_mesh(jax.devices()[:standby_devices])
+        )
+        standby = DefaultTokenService(CFG, mesh=standby_mesh)
+        standby.import_state(snap)
+        np.testing.assert_array_equal(
+            np.asarray(standby._state.flow.counts),
+            np.asarray(svc._state.flow.counts),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(standby._state.ns.counts),
+            np.asarray(svc._state.ns.counts),
+        )
+        if standby_mesh is not None:
+            assert (
+                len(standby._state.flow.counts.addressable_shards)
+                == standby_devices
+            )
+        # the promoted standby keeps enforcing: same verdicts as primary
+        # for the next pull
+        ids = np.tile(np.arange(16, dtype=np.int64), 4)
+        s_p, r_p, w_p = svc.request_batch_arrays(ids)
+        s_s, r_s, w_s = standby.request_batch_arrays(ids)
+        np.testing.assert_array_equal(s_p, s_s)
+        np.testing.assert_array_equal(r_p, r_s)
+        svc.close()
+        standby.close()
+
+    def test_single_shard_snapshot_onto_mesh(self, mesh):
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+        svc = self._primed(None)
+        snap = svc.export_state()
+        standby = DefaultTokenService(CFG, mesh=mesh)
+        standby.import_state(snap)
+        np.testing.assert_array_equal(
+            np.asarray(standby._state.flow.counts),
+            np.asarray(svc._state.flow.counts),
+        )
+        assert len(standby._state.flow.counts.addressable_shards) == 8
+        svc.close()
+        standby.close()
+
+
 class TestMeshBackedService:
     """DefaultTokenService(mesh=...) — a pod's chips serving together
     (tier 1 of SURVEY §7.5; tier 2 is tests/test_namespace_partition.py)."""
@@ -201,6 +346,34 @@ class TestMeshBackedService:
         # state is genuinely sharded across the mesh
         assert len(svc._state.flow.counts.addressable_shards) == 8
         svc.close()
+
+    def test_fusion_ladder_active_under_mesh(self, mesh):
+        """An oversized pull through a mesh-backed service takes the fused
+        path (the PR-7 guard drop) and its verdicts are bit-identical to
+        the same pull through a single-shard service."""
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.metrics.server import server_metrics
+
+        rules = [
+            ClusterFlowRule(flow_id=i, count=1e9, mode=G) for i in range(16)
+        ]
+        svc8 = DefaultTokenService(CFG, mesh=mesh, fuse_depths=(4, 2))
+        svc8.load_rules(rules, ns_max_qps=1e12)
+        svc8.warmup()
+        svc1 = DefaultTokenService(CFG, fuse_depths=(4, 2))
+        svc1.load_rules(rules, ns_max_qps=1e12)
+        svc1.warmup()
+        before = server_metrics().fused_frames_total
+        # 5 full frames: greedy ladder folds 4 into one scan + 1 plain
+        ids = np.tile(np.arange(16, dtype=np.int64), (5 * CFG.batch_size) // 16)
+        s8, r8, w8 = svc8.request_batch_arrays(ids)
+        assert server_metrics().fused_frames_total - before >= 4
+        s1, r1, w1 = svc1.request_batch_arrays(ids)
+        np.testing.assert_array_equal(s8, s1)
+        np.testing.assert_array_equal(r8, r1)
+        np.testing.assert_array_equal(w8, w1)
+        svc8.close()
+        svc1.close()
 
     def test_rule_reload_keeps_serving(self, mesh):
         from sentinel_tpu.cluster.token_service import DefaultTokenService

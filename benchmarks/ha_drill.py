@@ -588,6 +588,216 @@ def run_replication_drill(
     }
 
 
+def run_rebalance_drill(
+    count: float = 300.0,
+    bucket_ms: int = 500,
+    drive_rate: float = 150.0,
+):
+    """Elastic-fleet drill: move a namespace between two LIVE token servers
+    under sustained load and verify the lossless-handoff contract.
+
+    Topology: two in-process ``TokenServer``s (in-process because the move
+    coordinator runs inside the source server's process by design — it
+    needs the service's export hook); a ``RoutingTokenClient`` with a local
+    BLOCK fallback paces admissions against a fixed window of ``count``
+    tokens. With ``bucket_ms=500`` the window is 5s; the whole loaded phase
+    stays under the ~4.5s bucket-rotation point so expiry can't refill the
+    window mid-measure. Phases and invariants:
+
+    - **abort atomicity** (quiet): a chaos ``conn_reset`` kills the move's
+      connection mid-protocol. The move must FAIL, the source must remain
+      the sole owner with BIT-EQUAL counters (export before == after), and
+      the destination must have staged nothing.
+    - **move under load**: half-way into the window the namespace moves for
+      real. Every request must RESOLVE (verdict, redirect follow-through,
+      or fallback — never an exception), total admissions across BOTH
+      servers must stay within ``count`` (over-admission exactly 0: the
+      handoff ships the spent window, so the destination continues it
+      rather than starting fresh), and the routing client must converge on
+      the new owner within ONE shard-map epoch bump (< 2 epochs crossed).
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from sentinel_tpu import chaos
+    from sentinel_tpu.cluster.rebalance import (
+        MoveCoordinator,
+        ShardMapPublisher,
+    )
+    from sentinel_tpu.cluster.routing import RoutingTokenClient
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+    from sentinel_tpu.engine.rules import ThresholdMode
+    from sentinel_tpu.ha import FallbackAction, FallbackRule, LocalFallbackPolicy
+    from sentinel_tpu.metrics.ha import ha_metrics
+
+    failures = []
+    window_s = bucket_ms * 10 / 1000.0  # EngineConfig default n_buckets=10
+    rule_qps = count / window_s
+    cfg = EngineConfig(
+        max_flows=64, max_namespaces=4, batch_size=64, bucket_ms=bucket_ms
+    )
+    svc_src = DefaultTokenService(cfg)
+    svc_dst = DefaultTokenService(cfg)
+    svc_src.load_rules(
+        [ClusterFlowRule(DRILL_FLOW, rule_qps, ThresholdMode.GLOBAL, "drill"),
+         ClusterFlowRule(7, 1e6, ThresholdMode.GLOBAL, "warm")]
+    )
+    srv_src = TokenServer(svc_src, port=0)
+    srv_dst = TokenServer(svc_dst, port=0)
+    srv_src.start()
+    srv_dst.start()
+    src_ep = f"127.0.0.1:{srv_src.port}"
+    dst_ep = f"127.0.0.1:{srv_dst.port}"
+    pub = ShardMapPublisher()
+    coord = MoveCoordinator(svc_src, self_endpoint=src_ep, publisher=pub)
+    policy = LocalFallbackPolicy(
+        [FallbackRule(DRILL_FLOW, FallbackAction.BLOCK)]
+    )
+    client = RoutingTokenClient(
+        timeout_ms=500,
+        namespace_of={DRILL_FLOW: "drill", 7: "warm"},
+        pod_of={"drill": src_ep, "warm": src_ep},
+        endpoints={src_ep: ("127.0.0.1", srv_src.port),
+                   dst_ep: ("127.0.0.1", srv_dst.port)},
+        fallback=policy,
+        shard_maps=pub,
+    )
+    admitted = blocked = resolved = raised = 0
+    move_result = {"ok": False, "wall_ms": None}
+    abort_ok = bit_equal = sole_owner = False
+    epochs_crossed = converge_requests = None
+    try:
+        # warm the full move path (export → codec → import → device prep)
+        # on a throwaway namespace so the timed phase measures the
+        # protocol, not JAX compilation
+        if not coord.move_namespace("warm", dst_ep):
+            failures.append(f"warm move failed: {coord.last_error!r}")
+        coord.release("warm")
+
+        # phase 1 — abort atomicity, no traffic in flight so the counter
+        # comparison is exact: the ONLY conn_reset probe between arm and
+        # disarm is the coordinator's own move channel
+        for _ in range(5):
+            if client.request_token(DRILL_FLOW).ok:
+                admitted += 1
+            resolved += 1
+        doc0 = svc_src.export_namespace_state("drill")
+        chaos.arm("conn_reset:n=1", seed=7)
+        try:
+            aborted_move = coord.move_namespace("drill", dst_ep)
+        finally:
+            chaos.disarm()
+        abort_ok = not aborted_move
+        if aborted_move:
+            failures.append("chaos-cut move reported success")
+        doc1 = svc_src.export_namespace_state("drill")
+        bit_equal = bool(
+            np.array_equal(doc0["flow_sums"], doc1["flow_sums"])
+            and np.array_equal(doc0["ns_sum"], doc1["ns_sum"])
+        )
+        if not bit_equal:
+            failures.append("aborted move changed the source's counters")
+        sole_owner = not svc_dst.export_namespace_state("drill")["rules"]
+        if not sole_owner:
+            failures.append("aborted move left rules on the destination")
+        r = client.request_token(DRILL_FLOW)
+        if r.status not in (TokenStatus.OK, TokenStatus.BLOCKED):
+            failures.append(f"source not serving after abort: {r.status!r}")
+        elif r.ok:
+            admitted += 1
+        resolved += 1
+
+        # phase 2 — the real move, mid-window, under sustained load
+        epoch0 = client.epoch
+        period = 1.0 / drive_rate
+        t0 = time.monotonic()
+        next_t = t0
+        mover = None
+
+        def _move():
+            t = time.monotonic()
+            move_result["ok"] = coord.move_namespace("drill", dst_ep)
+            move_result["wall_ms"] = round(
+                (time.monotonic() - t) * 1e3, 1
+            )
+
+        while time.monotonic() - t0 < 3.2:
+            next_t += period
+            time.sleep(max(0.0, next_t - time.monotonic()))
+            if mover is None and admitted >= count / 2:
+                mover = _threading.Thread(target=_move)
+                mover.start()
+            try:
+                r = client.request_token(DRILL_FLOW)
+            except Exception:
+                raised += 1
+                continue
+            resolved += 1
+            if r.ok:
+                admitted += 1
+            elif r.status == TokenStatus.BLOCKED:
+                blocked += 1
+        if mover is None:
+            failures.append(
+                f"load never half-spent the window ({admitted} admissions)"
+            )
+        else:
+            mover.join(timeout=30)
+            if not move_result["ok"]:
+                failures.append(f"live move failed: {coord.last_error!r}")
+        epochs_crossed = client.epoch - epoch0
+        if raised:
+            failures.append(f"{raised} requests raised during the move")
+        over_admission = max(0, int(admitted - count))
+        if over_admission != 0:
+            failures.append(
+                f"over-admitted {over_admission} of {count:.0f} window "
+                "tokens across the move"
+            )
+        if epochs_crossed is not None and epochs_crossed >= 2:
+            failures.append(
+                f"client crossed {epochs_crossed} routing epochs "
+                "(contract: converge within 1)"
+            )
+        # post-move convergence: the client must reach the new owner
+        # without further redirects or failures
+        converge_requests = 0
+        for _ in range(20):
+            r = client.request_token(DRILL_FLOW)
+            converge_requests += 1
+            if r.status in (TokenStatus.OK, TokenStatus.BLOCKED):
+                break
+        else:
+            failures.append("client never converged on the destination")
+        reb = ha_metrics().snapshot()["rebalance"]
+        if reb["redirectsTotal"] < 1:
+            failures.append("no MOVED redirect was ever answered")
+        if not reb["events"].get("commit"):
+            failures.append("rebalance metrics show no commit event")
+    finally:
+        client.close()
+        srv_src.stop()
+        srv_dst.stop()
+    return {
+        "window_tokens": count,
+        "rule_qps": rule_qps,
+        "admitted": admitted,
+        "blocked": blocked,
+        "requests_resolved": resolved,
+        "requests_raised": raised,
+        "over_admission": max(0, int(admitted - count)),
+        "abort_atomic": abort_ok and bit_equal and sole_owner,
+        "move_wall_ms": move_result["wall_ms"],
+        "epochs_crossed": epochs_crossed,
+        "converge_requests": converge_requests,
+        "rebalance_metrics": ha_metrics().snapshot()["rebalance"],
+        "failures": failures,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true",
@@ -597,6 +807,8 @@ def main() -> None:
                     help="run only the kill/failover phases")
     ap.add_argument("--skip-replication", action="store_true",
                     help="skip the warm-standby replication drill")
+    ap.add_argument("--skip-rebalance", action="store_true",
+                    help="skip the live shard-rebalance drill")
     # child-role flags (used with --serve)
     ap.add_argument("--standby-of", default=None)
     ap.add_argument("--promote-after-ms", type=float, default=None)
@@ -616,6 +828,9 @@ def main() -> None:
     if not args.skip_replication:
         doc["replication"] = run_replication_drill()
         doc["failures"] = doc["failures"] + doc["replication"]["failures"]
+    if not args.skip_rebalance:
+        doc["rebalance"] = run_rebalance_drill()
+        doc["failures"] = doc["failures"] + doc["rebalance"]["failures"]
     if not args.skip_overload:
         doc["overload"] = run_overload_drill()
         doc["failures"] = doc["failures"] + doc["overload"]["failures"]
@@ -639,6 +854,16 @@ def main() -> None:
             f"served in {rep['promote_convergence_ms']}ms, "
             f"{rep['standby_blocks']} post-promotion blocks, "
             f"repl lag gauge live={rep['repl_lag_gauge_live']}"
+        )
+    if "rebalance" in doc:
+        reb = doc["rebalance"]
+        print(
+            f"rebalance drill ok: over-admitted {reb['over_admission']} "
+            f"of {reb['window_tokens']:.0f} window tokens across the move "
+            f"({reb['admitted']} admitted, {reb['blocked']} blocked, "
+            f"{reb['requests_raised']} raised), abort atomic="
+            f"{reb['abort_atomic']}, live move {reb['move_wall_ms']}ms, "
+            f"{reb['epochs_crossed']} epoch(s) crossed"
         )
     if "overload" in doc:
         ovl = doc["overload"]

@@ -37,6 +37,10 @@ _EXPORTS = {
     "flow_namespaces": "sentinel_tpu.cluster.namespaces",
     "partition_rules": "sentinel_tpu.cluster.namespaces",
     "RoutingTokenClient": "sentinel_tpu.cluster.routing",
+    "MoveCoordinator": "sentinel_tpu.cluster.rebalance",
+    "MoveTarget": "sentinel_tpu.cluster.rebalance",
+    "ShardMap": "sentinel_tpu.cluster.rebalance",
+    "ShardMapPublisher": "sentinel_tpu.cluster.rebalance",
 }
 
 __all__ = sorted(_EXPORTS)

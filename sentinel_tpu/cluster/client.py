@@ -279,7 +279,10 @@ class TokenClient(TokenService):
         )
         if rsp is None:
             return TokenResult(TokenStatus.FAIL)
-        return TokenResult(TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms)
+        return TokenResult(
+            TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms,
+            endpoint=rsp.endpoint,
+        )
 
     def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
         rsp = self._roundtrip(
@@ -290,7 +293,10 @@ class TokenClient(TokenService):
         )
         if rsp is None:
             return TokenResult(TokenStatus.FAIL)
-        return TokenResult(TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms)
+        return TokenResult(
+            TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms,
+            endpoint=rsp.endpoint,
+        )
 
     def request_concurrent_token(self, flow_id, acquire=1, prioritized=False):
         rsp = self._roundtrip(

@@ -26,7 +26,31 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
+from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine.rules import ClusterFlowRule
+
+# pods whose snapshot fetch raised during aggregate_snapshots — surfaced by
+# the exporter as sentinel_assignment_snapshot_errors_total so a pod that
+# silently vanishes from the dashboard sum shows up as a counter instead
+_SNAPSHOT_ERRORS = 0
+_SNAPSHOT_ERRORS_LOCK = threading.Lock()
+
+
+def count_snapshot_error(n: int = 1) -> None:
+    global _SNAPSHOT_ERRORS
+    with _SNAPSHOT_ERRORS_LOCK:
+        _SNAPSHOT_ERRORS += int(n)
+
+
+def snapshot_error_total() -> int:
+    with _SNAPSHOT_ERRORS_LOCK:
+        return _SNAPSHOT_ERRORS
+
+
+def reset_snapshot_errors_for_tests() -> None:
+    global _SNAPSHOT_ERRORS
+    with _SNAPSHOT_ERRORS_LOCK:
+        _SNAPSHOT_ERRORS = 0
 
 
 class NamespaceAssignment:
@@ -94,11 +118,31 @@ def aggregate_snapshots(
     """DCN-tier metric aggregation: sum per-flow metric snapshots from every
     pod into the global view the dashboard shows. Namespace ownership makes
     this a disjoint union in steady state, but a snapshot taken mid-move can
-    see a flow on two pods — summing (not overwriting) keeps totals right."""
+    see a flow on two pods — summing (not overwriting) keeps totals right.
+
+    Items may be mappings or zero-arg callables fetching one (a remote pod's
+    stats pull). A pod whose fetch raises — or whose payload is malformed —
+    contributes NOTHING (no half-merged rows), is logged, and is counted in
+    ``sentinel_assignment_snapshot_errors_total``; it must not abort the
+    other pods' aggregation or silently vanish from the sum."""
     out: Dict[int, Dict[str, float]] = {}
-    for snap in snapshots:
-        for fid, metrics in snap.items():
-            slot = out.setdefault(int(fid), {})
+    for i, snap in enumerate(snapshots):
+        try:
+            if callable(snap):
+                snap = snap()
+            staged: Dict[int, Dict[str, float]] = {}
+            for fid, metrics in snap.items():
+                slot = staged.setdefault(int(fid), {})
+                for k, v in metrics.items():
+                    slot[k] = slot.get(k, 0.0) + float(v)
+        except Exception:
+            record_log.exception(
+                "pod snapshot %d failed during aggregation; skipping it", i,
+            )
+            count_snapshot_error()
+            continue
+        for fid, metrics in staged.items():
+            slot = out.setdefault(fid, {})
             for k, v in metrics.items():
-                slot[k] = slot.get(k, 0.0) + float(v)
+                slot[k] = slot.get(k, 0.0) + v
     return out

@@ -51,6 +51,23 @@ native rebuild is needed):
   length prefix. The standby acks once the last chunk lands.
 - ``REPL_ACK``: ``code:uint8, gen:int64, seq:int64`` — OK / NEED_SNAPSHOT
   (resync) / NOT_STANDBY (promoted or misconfigured peer) / ERROR.
+
+Codec rev 4 — live-rebalance frames (``sentinel_tpu.cluster.rebalance``):
+a source token server hands one namespace's counter state to a live
+destination over the same wire, two-phase:
+
+- ``MOVE_BEGIN`` / ``MOVE_COMMIT`` / ``MOVE_ABORT``: ``epoch:int64`` +
+  ``ns_len:uint16`` + namespace UTF-8 + peer-id UTF-8 — the control steps
+  of the drain-and-move protocol. The destination answers each with a
+  REPL_ACK (OK / ERROR), reusing the rev-3 ack frame.
+- ``MOVE_STATE``: the namespace's exported counter document, chunked with
+  the SAME ``(gen, seq, idx, total)`` layout as REPL_DELTA/REPL_SNAPSHOT
+  (``encode_repl_blob`` accepts MOVE_STATE; ``ReplBlobAssembler``
+  reassembles it) — the move channel inherits replication's framing,
+  chaos instrumentation, and torn-stream detection.
+- a ``MOVED`` (= 10) status on the single-request response path appends
+  the new owner's ``host:port`` endpoint as a UTF-8 trailer; batch rows
+  stay fixed-size and carry the shard-map epoch in ``remaining``.
 """
 
 from __future__ import annotations
@@ -101,6 +118,11 @@ class MsgType(enum.IntEnum):
     REPL_DELTA = 7
     REPL_ACK = 8
     REPL_SNAPSHOT = 9
+    # codec rev 4: live shard rebalancing (control plane)
+    MOVE_BEGIN = 10
+    MOVE_STATE = 11
+    MOVE_COMMIT = 12
+    MOVE_ABORT = 13
 
 
 # front doors route these type bytes to the replication applier instead of
@@ -109,6 +131,17 @@ REPL_TYPES = frozenset(
     {MsgType.REPL_HELLO, MsgType.REPL_DELTA, MsgType.REPL_ACK,
      MsgType.REPL_SNAPSHOT}
 )
+
+# rev-4 move frames route to the server's MoveTarget the same way
+MOVE_TYPES = frozenset(
+    {MsgType.MOVE_BEGIN, MsgType.MOVE_STATE, MsgType.MOVE_COMMIT,
+     MsgType.MOVE_ABORT}
+)
+
+# TokenStatus.MOVED — mirrored here as a bare int because this module must
+# stay importable without jax (socket-only processes); decode_response keys
+# the endpoint trailer on it
+MOVED_STATUS = 10
 
 
 class ReplAck(enum.IntEnum):
@@ -125,6 +158,7 @@ _REPL_ACK = struct.Struct(">Bqq")  # code, gen, seq
 _REPL_CHUNK = struct.Struct(">qqHH")  # gen, seq, idx, total
 # room left in one frame for a delta/snapshot chunk's bytes
 REPL_CHUNK_BYTES = MAX_FRAME - _HEAD.size - _REPL_CHUNK.size
+_MOVE_CTRL = struct.Struct(">qH")  # epoch, ns_len (namespace + peer follow)
 
 
 _NATIVE = None
@@ -163,6 +197,7 @@ class FlowResponse:
     remaining: int = 0
     wait_ms: int = 0
     token_id: int = 0  # CONCURRENT_ACQUIRE only
+    endpoint: str = ""  # MOVED only: the new owner's "host:port"
 
 
 @dataclass(frozen=True)
@@ -517,9 +552,13 @@ def encode_repl_blob(
 
     Every chunk carries (gen, seq, idx, total) so the standby can reassemble
     and DETECT a torn stream: a chunk whose (gen, seq) doesn't extend the
-    in-progress assembly restarts it. An empty blob still emits one chunk
+    in-progress assembly restarts it. Rev 4 reuses this codec for the move
+    channel (``MOVE_STATE``: ``gen`` = source state generation, ``seq`` =
+    move epoch). An empty blob still emits one chunk
     (total=1) — an empty delta is the sender's liveness heartbeat."""
-    if msg_type not in (MsgType.REPL_DELTA, MsgType.REPL_SNAPSHOT):
+    if msg_type not in (
+        MsgType.REPL_DELTA, MsgType.REPL_SNAPSHOT, MsgType.MOVE_STATE
+    ):
         raise ValueError(f"not a repl blob type: {msg_type}")
     total = max(1, -(-len(blob) // REPL_CHUNK_BYTES))
     if total > 0xFFFF:
@@ -578,12 +617,59 @@ class ReplBlobAssembler:
         return None
 
 
+# -- codec rev 4: move control frames -----------------------------------------
+def encode_move_ctrl(
+    xid: int, msg_type: int, epoch: int, namespace: str, peer: str = ""
+) -> bytes:
+    """MOVE_BEGIN / MOVE_COMMIT / MOVE_ABORT frame: the move's shard-map
+    epoch, the namespace being moved, and the sender's peer id (the source
+    server's ``host:port`` — what redirected clients are steered AWAY from,
+    logged on the destination for the crash matrix)."""
+    if msg_type not in (
+        MsgType.MOVE_BEGIN, MsgType.MOVE_COMMIT, MsgType.MOVE_ABORT
+    ):
+        raise ValueError(f"not a move control type: {msg_type}")
+    ns = namespace.encode("utf-8")
+    if len(ns) > 0xFFFF:
+        raise ValueError("namespace too long")
+    payload = (
+        _HEAD.pack(xid, msg_type)
+        + _MOVE_CTRL.pack(epoch, len(ns))
+        + ns
+        + peer.encode("utf-8")[:256]
+    )
+    if len(payload) > MAX_FRAME:
+        raise ValueError("move control frame too large")
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_move_ctrl(payload: bytes):
+    """MOVE_BEGIN/COMMIT/ABORT payload → (xid, epoch, namespace, peer).
+    Raises ``ValueError`` on a runt or torn payload (the door drops the
+    connection, same contract as ``decode_request``)."""
+    if len(payload) < _HEAD.size + _MOVE_CTRL.size:
+        raise ValueError("runt move control frame")
+    xid, _ = _HEAD.unpack_from(payload, 0)
+    epoch, ns_len = _MOVE_CTRL.unpack_from(payload, _HEAD.size)
+    off = _HEAD.size + _MOVE_CTRL.size
+    if len(payload) < off + ns_len:
+        raise ValueError("torn move control frame")
+    namespace = payload[off : off + ns_len].decode("utf-8", errors="replace")
+    peer = payload[off + ns_len :].decode("utf-8", errors="replace")
+    return xid, epoch, namespace, peer
+
+
 def encode_response(rsp: FlowResponse) -> bytes:
     payload = _HEAD.pack(rsp.xid, rsp.msg_type) + _FLOW_RSP.pack(
         rsp.status, rsp.remaining, rsp.wait_ms
     )
     if rsp.msg_type == MsgType.CONCURRENT_ACQUIRE:
         payload += struct.pack(">q", rsp.token_id)
+    elif rsp.status == MOVED_STATUS and rsp.endpoint:
+        # rev 4: the redirect target rides as a UTF-8 trailer. Back-compat
+        # both ways — a rev-3 decoder's unpack_from ignores trailing bytes,
+        # and a rev-4 decoder only reads the trailer on a MOVED status.
+        payload += rsp.endpoint.encode("utf-8")[:256]
     return _LEN.pack(len(payload)) + payload
 
 
@@ -614,10 +700,15 @@ def decode_response(payload: bytes) -> FlowResponse:
     mtype = MsgType(mtype)
     status, remaining, wait_ms = _FLOW_RSP.unpack_from(payload, _HEAD.size)
     token_id = 0
+    endpoint = ""
     off = _HEAD.size + _FLOW_RSP.size
     if mtype == MsgType.CONCURRENT_ACQUIRE and len(payload) >= off + 8:
         (token_id,) = struct.unpack_from(">q", payload, off)
-    return FlowResponse(xid, mtype, status, remaining, wait_ms, token_id)
+    elif status == MOVED_STATUS and len(payload) > off:
+        endpoint = payload[off:].decode("utf-8", errors="replace")
+    return FlowResponse(
+        xid, mtype, status, remaining, wait_ms, token_id, endpoint
+    )
 
 
 class FrameReader:

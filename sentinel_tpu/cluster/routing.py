@@ -10,7 +10,21 @@ partitioning (``cluster/namespaces.py``).
 Reconfiguration (``update``) swaps the routing tables atomically — in-flight
 requests finish against the old pod (its verdict is still valid: counters
 are ephemeral and the old owner keeps enforcing until clients drain), new
-requests go to the new owner.
+requests go to the new owner. The whole routing view lives in ONE immutable
+``_RouteState`` object replaced wholesale under the lock: readers take a
+single reference-read snapshot, so no request can observe half of an update
+(new pod table, old endpoint table), and retired clients are closed only
+AFTER the new state is visible — never under the lock, never while a reader
+that snapshotted the old state may still be dispatching on them.
+
+Live rebalancing (``cluster.rebalance``) plugs in two ways: shard maps
+pushed through the property system land via :meth:`apply_shard_map`
+(epoch-fenced — a stale map is ignored), and a server answering
+``TokenStatus.MOVED`` teaches the client passively: the response's
+``remaining`` carries the new shard-map epoch and (on transports that
+support it) ``endpoint`` names the destination, so the client installs the
+route, retries once against the new owner, and degrades through the local
+fallback policy if the destination is unreachable.
 """
 
 from __future__ import annotations
@@ -20,9 +34,40 @@ from typing import Callable, Dict, Mapping, Optional, Tuple
 
 from sentinel_tpu.cluster.client import TokenClient
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
+from sentinel_tpu.metrics.ha import ha_metrics
 
 Endpoint = Tuple[str, int]
+
+
+class _RouteState:
+    """One immutable snapshot of the entire routing view. Never mutated
+    after construction — reconfiguration builds a replacement and swaps the
+    single ``RoutingTokenClient._state`` reference (atomic in CPython)."""
+
+    __slots__ = ("epoch", "namespace_of", "pod_of", "endpoints", "clients")
+
+    def __init__(self, epoch, namespace_of, pod_of, endpoints, clients):
+        self.epoch = int(epoch)  # shard-map epoch fence
+        self.namespace_of: Mapping[int, str] = namespace_of
+        self.pod_of: Mapping[str, str] = pod_of
+        self.endpoints: Mapping[str, Endpoint] = endpoints
+        self.clients: Mapping[str, TokenService] = clients
+
+    def replace(self, **kw) -> "_RouteState":
+        fields = {s: kw.get(s, getattr(self, s)) for s in self.__slots__}
+        return _RouteState(**fields)
+
+
+def _parse_endpoint(text: str) -> Optional[Endpoint]:
+    host, sep, port = str(text).rpartition(":")
+    if not sep or not host:
+        return None
+    try:
+        return host, int(port)
+    except ValueError:
+        return None
 
 
 class RoutingTokenClient(TokenService):
@@ -33,15 +78,22 @@ class RoutingTokenClient(TokenService):
         pod_of: Optional[Mapping[str, str]] = None,
         endpoints: Optional[Mapping[str, Endpoint]] = None,
         client_factory: Callable[..., TokenService] = TokenClient,
+        fallback=None,
+        shard_maps=None,
     ):
         self.timeout_ms = timeout_ms
         self._factory = client_factory
         self._lock = threading.Lock()
-        # routing tables — replaced wholesale by update(), never mutated
-        self._namespace_of: Mapping[int, str] = dict(namespace_of or {})
-        self._pod_of: Mapping[str, str] = dict(pod_of or {})
-        self._endpoints: Mapping[str, Endpoint] = dict(endpoints or {})
-        self._clients: Dict[str, TokenService] = {}
+        # the one mutable cell: an immutable routing snapshot, swapped
+        # wholesale (see module docstring)
+        self._state = _RouteState(
+            0, dict(namespace_of or {}), dict(pod_of or {}),
+            dict(endpoints or {}), {},
+        )
+        # when the cluster moves a namespace out from under us and the
+        # destination is unreachable, this policy answers locally instead of
+        # surfacing MOVED to the caller (None → MOVED is surfaced)
+        self.fallback = fallback
         # namespaces each pod's client has declared via the PING handshake —
         # a pod can serve several, and AVG_LOCAL counts need every one
         self._declared: Dict[str, set] = {}
@@ -51,8 +103,22 @@ class RoutingTokenClient(TokenService):
         # caller-visible id is globally unique and release routes exactly
         self._pod_nums: Dict[str, int] = {}  # pod_id → 1-based number
         self._pods_by_num: Dict[int, str] = {}
+        if shard_maps is not None:
+            # ShardMapPublisher (cluster.rebalance): follow pushes passively
+            shard_maps.listen(self.apply_shard_map)
 
     # -- reconfiguration ----------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        """Shard-map epoch of the installed routing view."""
+        return self._state.epoch
+
+    @property
+    def _clients(self) -> Mapping[str, TokenService]:
+        """Read-only view of the live per-pod clients (tests and
+        introspection; the authoritative copy lives in ``_state``)."""
+        return self._state.clients
+
     def update(
         self,
         namespace_of: Optional[Mapping[int, str]] = None,
@@ -60,49 +126,117 @@ class RoutingTokenClient(TokenService):
         endpoints: Optional[Mapping[str, Endpoint]] = None,
     ) -> None:
         """Install new routing tables (assignment-config push analog).
-        Pods that disappeared get their clients closed."""
+        Pods that disappeared get their clients closed — only after the new
+        state is published, so a reader that routed on the old snapshot
+        never dispatches on a client closed mid-request by this thread."""
+        retired = []
         with self._lock:
+            st = self._state
+            kw = {}
             if namespace_of is not None:
-                self._namespace_of = dict(namespace_of)
+                kw["namespace_of"] = dict(namespace_of)
             if pod_of is not None:
-                self._pod_of = dict(pod_of)
+                kw["pod_of"] = dict(pod_of)
             if endpoints is not None:
-                self._endpoints = dict(endpoints)
-                for pod_id in list(self._clients):
-                    if pod_id not in self._endpoints:
-                        client = self._clients.pop(pod_id)
+                kw["endpoints"] = dict(endpoints)
+                clients = dict(st.clients)
+                for pod_id in list(clients):
+                    if pod_id not in kw["endpoints"]:
+                        retired.append(clients.pop(pod_id))
                         self._declared.pop(pod_id, None)
-                        close = getattr(client, "close", None)
-                        if close:
-                            close()
+                kw["clients"] = clients
+            self._state = st.replace(**kw)
+        for client in retired:  # after the swap, outside the lock
+            close = getattr(client, "close", None)
+            if close:
+                close()
 
+    def apply_shard_map(self, shard_map) -> bool:
+        """Point every namespace the map names at its endpoint. Epoch-fenced:
+        a map no newer than the installed view is ignored (returns False),
+        so out-of-order pushes can't roll routes back."""
+        with self._lock:
+            st = self._state
+            if int(shard_map.epoch) <= st.epoch:
+                return False
+            pod_of = dict(st.pod_of)
+            endpoints = dict(st.endpoints)
+            for ns, ep_text in shard_map.endpoint_of.items():
+                ep = _parse_endpoint(ep_text)
+                if ep is None:
+                    record_log.warning(
+                        "shard map epoch %s names unparseable endpoint %r "
+                        "for %r; keeping old route",
+                        shard_map.epoch, ep_text, ns,
+                    )
+                    continue
+                pod_of[ns] = str(ep_text)
+                endpoints[str(ep_text)] = ep
+            self._state = st.replace(
+                epoch=int(shard_map.epoch), pod_of=pod_of,
+                endpoints=endpoints,
+            )
+        return True
+
+    def _learn_move(self, namespace: str, ep_text: str, epoch: int) -> bool:
+        """Install a single route learned from a MOVED redirect. Same epoch
+        fence as :meth:`apply_shard_map`."""
+        ep = _parse_endpoint(ep_text)
+        if ep is None:
+            return False
+        with self._lock:
+            st = self._state
+            if int(epoch) <= st.epoch:
+                return False
+            pod_of = dict(st.pod_of)
+            endpoints = dict(st.endpoints)
+            pod_of[namespace] = str(ep_text)
+            endpoints[str(ep_text)] = ep
+            self._state = st.replace(
+                epoch=int(epoch), pod_of=pod_of, endpoints=endpoints,
+            )
+        return True
+
+    # -- routing ------------------------------------------------------------
     def _route_for(self, flow_id: int):
-        """(client, pod_id) actually routed to, or None. One lock acquisition
+        """(client, pod_id) actually routed to, or None. One state snapshot
         decides the route — callers that need the pod identity (concurrent
         token-id prefixing) must use THIS pair, not re-derive the pod, or a
         concurrent update() can name a different pod than the issuer."""
+        st = self._state  # one atomic snapshot; no lock for the happy path
+        ns = st.namespace_of.get(flow_id)
+        if ns is None:
+            return None
+        pod_id = st.pod_of.get(ns)
+        if pod_id is None:
+            return None
+        client = st.clients.get(pod_id)
         declare = False
-        with self._lock:
-            ns = self._namespace_of.get(flow_id)
-            if ns is None:
-                return None
-            pod_id = self._pod_of.get(ns)
-            if pod_id is None:
-                return None
-            client = self._clients.get(pod_id)
-            if client is None:
-                endpoint = self._endpoints.get(pod_id)
+        if client is None:
+            with self._lock:
+                st = self._state  # re-snapshot: tables may have moved on
+                pod_id = st.pod_of.get(ns, pod_id)
+                endpoint = st.endpoints.get(pod_id)
                 if endpoint is None:
                     return None
-                client = self._factory(
-                    endpoint[0], endpoint[1],
-                    timeout_ms=self.timeout_ms, namespace=ns,
-                )
-                self._clients[pod_id] = client
-                self._declared[pod_id] = {ns}  # ctor namespace auto-pings
-            elif ns not in self._declared.setdefault(pod_id, set()):
-                self._declared[pod_id].add(ns)
-                declare = True
+                client = st.clients.get(pod_id)
+                if client is None:
+                    client = self._factory(
+                        endpoint[0], endpoint[1],
+                        timeout_ms=self.timeout_ms, namespace=ns,
+                    )
+                    clients = dict(st.clients)
+                    clients[pod_id] = client
+                    self._state = st.replace(clients=clients)
+                    self._declared[pod_id] = {ns}  # ctor namespace auto-pings
+                elif ns not in self._declared.setdefault(pod_id, set()):
+                    self._declared[pod_id].add(ns)
+                    declare = True
+        else:
+            with self._lock:
+                if ns not in self._declared.setdefault(pod_id, set()):
+                    self._declared[pod_id].add(ns)
+                    declare = True
         if declare:
             # additional namespace on an existing pod connection: declare it
             # so the server's AVG_LOCAL connection count includes us
@@ -117,20 +251,79 @@ class RoutingTokenClient(TokenService):
         route = self._route_for(flow_id)
         return None if route is None else route[0]
 
+    # -- MOVED redirects ----------------------------------------------------
+    @staticmethod
+    def _is_moved(result) -> bool:
+        return (
+            isinstance(result, TokenResult)
+            and result.status == TokenStatus.MOVED
+        )
+
+    def _follow_move(self, flow_id, from_pod, moved, op, decide):
+        """A server answered MOVED: learn the new route (from the response's
+        endpoint trailer, or a shard-map push that already landed), retry
+        ONCE against the new owner, and degrade through the local fallback
+        policy when the destination is unreachable or unknown. Returns
+        (result, pod_id) with the pod that actually issued the verdict."""
+        ha_metrics().count_fallback("moved_follow")
+        st = self._state
+        ns = st.namespace_of.get(flow_id)
+        endpoint = getattr(moved, "endpoint", "") or ""
+        epoch = int(getattr(moved, "remaining", 0))
+        if ns is not None and endpoint:
+            self._learn_move(ns, endpoint, epoch)
+        route = self._route_for(flow_id)
+        if route is not None and route[1] != from_pod:
+            client, pod_id = route
+            try:
+                result = op(client)
+            except Exception:
+                record_log.exception(
+                    "moved-to destination %s raised; degrading", pod_id,
+                )
+                result = None
+            if result is not None and not self._is_moved(result):
+                return result, pod_id
+        # no newer route, destination unreachable, or it answered MOVED
+        # again (a second hop inside one request is a routing storm, not a
+        # redirect to chase): answer locally or surface the redirect
+        if self.fallback is not None:
+            ha_metrics().count_fallback("moved_degraded")
+            return decide(), from_pod
+        return moved, from_pod
+
     # -- TokenService -------------------------------------------------------
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
-        client = self._client_for(flow_id)
-        if client is None:
+        route = self._route_for(flow_id)
+        if route is None:
             # unknown flow/namespace/pod: same shape as the reference's
             # no-rule path — caller falls back to its local check
             return TokenResult(TokenStatus.NO_RULE_EXISTS)
-        return client.request_token(flow_id, acquire, prioritized)
+        client, pod_id = route
+        result = client.request_token(flow_id, acquire, prioritized)
+        if self._is_moved(result):
+            result, _ = self._follow_move(
+                flow_id, pod_id, result,
+                lambda c: c.request_token(flow_id, acquire, prioritized),
+                lambda: self.fallback.decide(flow_id, acquire, prioritized),
+            )
+        return result
 
     def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
-        client = self._client_for(flow_id)
-        if client is None:
+        route = self._route_for(flow_id)
+        if route is None:
             return TokenResult(TokenStatus.NO_RULE_EXISTS)
-        return client.request_params_token(flow_id, acquire, param_hashes)
+        client, pod_id = route
+        result = client.request_params_token(flow_id, acquire, param_hashes)
+        if self._is_moved(result):
+            result, _ = self._follow_move(
+                flow_id, pod_id, result,
+                lambda c: c.request_params_token(
+                    flow_id, acquire, param_hashes
+                ),
+                lambda: self.fallback.decide(flow_id, acquire),
+            )
+        return result
 
     # pod number lives in bits 48+ of the caller-visible token id; pod-local
     # ids below 2^48 (a per-pod counter would take >8900 years at 1M acq/s)
@@ -143,6 +336,14 @@ class RoutingTokenClient(TokenService):
             return TokenResult(TokenStatus.NO_RULE_EXISTS)
         client, pod_id = route
         result = client.request_concurrent_token(flow_id, acquire, prioritized)
+        if self._is_moved(result):
+            result, pod_id = self._follow_move(
+                flow_id, pod_id, result,
+                lambda c: c.request_concurrent_token(
+                    flow_id, acquire, prioritized
+                ),
+                lambda: self.fallback.decide(flow_id, acquire, prioritized),
+            )
         if (
             result.ok and result.token_id
             and result.token_id <= self._LOCAL_ID_MASK
@@ -163,22 +364,23 @@ class RoutingTokenClient(TokenService):
         token_id = int(token_id)
         num = token_id >> self._POD_ID_SHIFT
         local_id = token_id & self._LOCAL_ID_MASK
+        st = self._state
         with self._lock:
             pod_id = self._pods_by_num.get(num)
-            if pod_id is not None and pod_id in self._clients:
-                clients = [self._clients[pod_id]]
-            elif num:
-                # prefixed id whose issuing pod left the routing table: only
-                # that pod could hold the token (ids are pod-scoped), and its
-                # counters died with it — fail fast as already-released.
-                # Broadcasting the masked local id could wrongly release an
-                # UNRELATED token that another pod issued under the same
-                # local counter value (round-3 advisor finding).
-                return TokenResult(TokenStatus.ALREADY_RELEASE)
-            else:
-                # genuinely unprefixed id (issued outside the router):
-                # degrade to first-success fan-out with the raw id
-                clients = list(self._clients.values())
+        if pod_id is not None and pod_id in st.clients:
+            clients = [st.clients[pod_id]]
+        elif num:
+            # prefixed id whose issuing pod left the routing table: only
+            # that pod could hold the token (ids are pod-scoped), and its
+            # counters died with it — fail fast as already-released.
+            # Broadcasting the masked local id could wrongly release an
+            # UNRELATED token that another pod issued under the same
+            # local counter value (round-3 advisor finding).
+            return TokenResult(TokenStatus.ALREADY_RELEASE)
+        else:
+            # genuinely unprefixed id (issued outside the router):
+            # degrade to first-success fan-out with the raw id
+            clients = list(st.clients.values())
         result = TokenResult(TokenStatus.FAIL)
         for client in clients:
             r = client.release_concurrent_token(local_id)
@@ -189,9 +391,10 @@ class RoutingTokenClient(TokenService):
 
     def close(self) -> None:
         with self._lock:
-            clients, self._clients = list(self._clients.values()), {}
+            st = self._state
+            self._state = st.replace(clients={})
             self._declared.clear()
-        for client in clients:
+        for client in st.clients.values():
             close = getattr(client, "close", None)
             if close:
                 close()

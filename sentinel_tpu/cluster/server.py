@@ -156,6 +156,7 @@ class _LoopWorker:
         peer = writer.get_extra_info("peername")
         address = f"{peer[0]}:{peer[1]}" if peer else repr(writer)
         repl_session = None  # per-connection rev-3 chunk reassembly, lazy
+        move_session = None  # per-connection rev-4 move channel, lazy
         loop = asyncio.get_running_loop()
         srv.connections.attach_closer(
             address, lambda: loop.call_soon_threadsafe(writer.close)
@@ -193,6 +194,20 @@ class _LoopWorker:
                             repl_session.handle(payload, writer.write)
                         except ValueError:
                             record_log.warning("torn repl stream; closing")
+                            return
+                        await writer.drain()
+                        continue
+                    if mtype in P.MOVE_TYPES:
+                        # wire rev 4 (live-move control plane): a source
+                        # server's MoveCoordinator drains a namespace into
+                        # this one. Routed like the repl channel; the
+                        # session discards staged state on disconnect.
+                        if move_session is None:
+                            move_session = srv.move_target.connection()
+                        try:
+                            move_session.handle(payload, writer.write)
+                        except ValueError:
+                            record_log.warning("torn move stream; closing")
                             return
                         await writer.drain()
                         continue
@@ -308,6 +323,10 @@ class _LoopWorker:
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
+            if move_session is not None:
+                # a source that died mid-move must not leave a staged
+                # claim behind (crash matrix: dest discards, source owns)
+                move_session.closed()
             srv.connections.remove_address(address)
             try:
                 writer.close()
@@ -621,11 +640,21 @@ class _LoopWorker:
                         st, remaining, wait, token_id = results.get(
                             i, (int(TokenStatus.FAIL), 0, 0, 0)
                         )
+                        endpoint = ""
+                        if st == int(TokenStatus.MOVED):
+                            # rev 4: single responses carry the new owner
+                            # as a UTF-8 trailer so a redirected client
+                            # needs no shard-map fetch to follow
+                            lookup = getattr(
+                                service, "moved_redirect", None
+                            )
+                            red = lookup(item.flow_id) if lookup else None
+                            endpoint = red[0] if red else ""
                         writer.write(
                             P.encode_response(
                                 P.FlowResponse(
                                     item.xid, item.msg_type, st, remaining,
-                                    wait, token_id,
+                                    wait, token_id, endpoint,
                                 )
                             )
                         )
@@ -772,6 +801,12 @@ class TokenServer:
         self.repl_interval_ms = repl_interval_ms
         self.applier = None  # StandbyApplier while in standby mode
         self.replicator = None  # ReplicationSender while primary
+        # live-move destination side (cluster.rebalance): every server can
+        # receive a namespace over the rev-4 move channel; staging only,
+        # nothing mutates until MOVE_COMMIT
+        from sentinel_tpu.cluster.rebalance import MoveTarget
+
+        self.move_target = MoveTarget(service)
         # per-connection scatter-encode buffers: encode_batch_responses
         # lays each writer's grouped verdict frames into its reused
         # bytearray (out=) instead of allocating a bytes blob per flush;

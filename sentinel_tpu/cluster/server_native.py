@@ -170,6 +170,12 @@ class NativeTokenServer:
         self.applier = None
         self.replicator = None
         self._repl_sessions: dict = {}  # (fd, gen) → ReplSession
+        # rev-4 namespace-move channel (cluster.rebalance): one MoveSession
+        # per inbound connection, same lifecycle as _repl_sessions
+        from sentinel_tpu.cluster.rebalance import MoveTarget
+
+        self.move_target = MoveTarget(service)
+        self._move_sessions: dict = {}  # (fd, gen) → MoveSession
 
     def tuning_kwargs(self) -> dict:
         return dict(
@@ -394,6 +400,9 @@ class NativeTokenServer:
             self.applier.stop()
             self.applier = None
         self._repl_sessions.clear()
+        for sess in self._move_sessions.values():
+            sess.closed()  # discard any staged (uncommitted) move state
+        self._move_sessions.clear()
         if self._snapshots is not None:
             self._snapshots.stop(final_save=True)
             self._snapshots = None
@@ -844,6 +853,11 @@ class NativeTokenServer:
                     span += lengths[j]
                     j += 1
                 try:
+                    # the C++ scatter encode carries (status, remaining,
+                    # wait) only, so MOVED verdicts ship the shard-map
+                    # epoch in ``remaining`` without the endpoint trailer
+                    # the asyncio door appends — clients re-resolve the
+                    # destination through the shard map on the epoch bump
                     door.submit_many(
                         frames_list,
                         status[off : off + span],
@@ -903,6 +917,12 @@ class NativeTokenServer:
             if address:
                 self.connections.remove_address(address)
             self._repl_sessions.pop((fd, gen), None)
+            move_sess = self._move_sessions.pop((fd, gen), None)
+            if move_sess is not None:
+                # crash matrix: a source that dies mid-move never sent
+                # MOVE_COMMIT, so discarding its staged state here leaves
+                # the source as the sole owner
+                move_sess.closed()
             return
         # kind == CTRL_FRAME: a non-data-plane request
         with self._addr_lock:
@@ -933,6 +953,27 @@ class NativeTokenServer:
                 record_log.warning("torn repl stream; closing %s",
                                    address)
                 self._repl_sessions.pop((fd, gen), None)
+                door.close_conn(fd, gen)
+            return
+        # rev-4 namespace-move frames: same control-lane treatment, routed
+        # to the MoveTarget's per-connection session
+        if len(payload) >= 5 and P.peek_type(payload) in P.MOVE_TYPES:
+            sess = self._move_sessions.get((fd, gen))
+            if sess is None:
+                sess = self.move_target.connection()
+                self._move_sessions[(fd, gen)] = sess
+            try:
+                sess.handle(
+                    payload,
+                    lambda b, fd=fd, gen=gen, door=door: door.send(
+                        fd, gen, b
+                    ),
+                )
+            except ValueError:
+                record_log.warning("torn move stream; closing %s",
+                                   address)
+                self._move_sessions.pop((fd, gen), None)
+                sess.closed()
                 door.close_conn(fd, gen)
             return
         try:

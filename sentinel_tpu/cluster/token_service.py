@@ -129,6 +129,9 @@ class TokenResult:
     remaining: int = 0
     wait_ms: int = 0
     token_id: int = 0
+    # MOVED only: the new owner's "host:port" (``remaining`` then carries the
+    # shard-map epoch). Empty for every other status.
+    endpoint: str = ""
 
     @property
     def ok(self) -> bool:
@@ -306,6 +309,17 @@ class DefaultTokenService(TokenService):
         # the sender to re-bootstrap standbys with a full snapshot.
         self._state_gen = 0
         self._dirty: Optional[Dict[str, set]] = None
+        # live-rebalance MOVING set (cluster.rebalance): namespace →
+        # (destination "host:port", shard-map epoch). While a namespace is
+        # here its flows are masked OUT of every device batch (their rows
+        # never count a token — the zero-over-admission invariant) and the
+        # materializers overlay TokenStatus.MOVED. _moving_snap is the
+        # dispatch-path view: an immutable (mask bool[max_namespaces],
+        # epoch int32[max_namespaces]) pair rebuilt under self._lock on
+        # every begin/abort/end and rule reload, or None when nothing is
+        # moving — the idle hot path pays one `is not None` check.
+        self._moving: Dict[str, Tuple[str, int]] = {}
+        self._moving_snap: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     @staticmethod
     def _prep_batch(cfg, slots, acq, pr):
@@ -478,6 +492,10 @@ class DefaultTokenService(TokenService):
                     self._index.ns_of[r.namespace]
                 )
             self._ns_snapshot = (tuple(ns_names), slot_ns)
+            # a reload can introduce rules (hence slots) for a namespace
+            # that is mid-move; refresh the dispatch-path MOVING view so
+            # those new slots are masked too
+            self._rebuild_moving_snap()
             # slot assignments may have moved: deltas collected against the
             # old generation are meaningless, so drop them and force the
             # replication sender into a full-snapshot resync
@@ -750,6 +768,8 @@ class DefaultTokenService(TokenService):
             lookup_snap, cfg, bucket, flow_ids, acq, pr
         )
         step = self._step_fn(bucket, uniform)
+        slots_ns = slots  # pre-mask slots: verdict→namespace attribution
+        moved_mask = moved_epochs = None
         # -- device step: the only serialized section --
         with self._lock:
             if self._lookup is not lookup_snap:
@@ -758,7 +778,21 @@ class DefaultTokenService(TokenService):
                 # live table (rare, and still under the lock — the same
                 # atomicity load_rules callers had before the narrowing)
                 slots = self._lookup_from(self._lookup, flow_ids)
+                slots_ns = slots
                 order, batch = self._prep_batch(cfg, slots, acq, pr)
+            mv = self._moving_snap
+            if mv is not None:
+                # live rebalance: rows of a MOVING namespace are masked out
+                # of the device batch — their counters never move (the
+                # zero-over-admission half of the lossless move) — and the
+                # materializer overlays MOVED. Checked under the lock so a
+                # begin_move strictly orders against every dispatch.
+                moved_mask, moved_epochs = self._moving_mask_for(slots, mv)
+                if moved_mask is not None:
+                    slots = np.where(
+                        moved_mask, np.int32(-1), slots
+                    ).astype(np.int32)
+                    order, batch = self._prep_batch(cfg, slots, acq, pr)
             now = self._engine_now()
             self._state, verdicts = step(
                 self._state, self._table, batch, np.int32(now)
@@ -787,13 +821,23 @@ class DefaultTokenService(TokenService):
                 status[order] = status_sorted
                 remaining[order] = remaining_sorted
                 wait[order] = wait_sorted
+            if moved_mask is not None:
+                # MOVED overlay: the device saw these rows as no-rule; the
+                # client sees a redirect carrying the shard-map epoch
+                status[moved_mask] = np.int8(int(TokenStatus.MOVED))
+                remaining[moved_mask] = moved_epochs[moved_mask]
+                wait[moved_mask] = 0
+                from sentinel_tpu.metrics.ha import ha_metrics
+                ha_metrics().count_rebalance_redirects(
+                    int(moved_mask.sum())
+                )
             # per-namespace verdict counters (sentinel_server_verdicts_total):
             # attribute each request's verdict to its rule's namespace via
-            # the lock-free slot→namespace snapshot. `slots` is request-order
-            # (the closure sees the re-prepped assignment after a reload).
+            # the lock-free slot→namespace snapshot. `slots_ns` is request-
+            # order and PRE-mask, so MOVED verdicts land on their namespace.
             ns_names, slot_ns = self._ns_snapshot
             ns_idx = np.where(
-                slots >= 0, slot_ns[np.maximum(slots, 0)], np.int32(-1)
+                slots_ns >= 0, slot_ns[np.maximum(slots_ns, 0)], np.int32(-1)
             )
             _SM.record_verdict_batch(status, ns_idx, ns_names)
             # cluster server stat log (ClusterServerStatLogUtil analog): one
@@ -902,6 +946,7 @@ class DefaultTokenService(TokenService):
         preps = _prep_all(lookup_snap)
         _fill(preps)
         step = self._fused_step_fn(depth, uniform)
+        moved_span = moved_epochs_span = span_ns = None
         # -- device step: the only serialized section --
         with self._lock:
             if self._lookup is not lookup_snap:
@@ -927,6 +972,36 @@ class DefaultTokenService(TokenService):
                             pr[sl][order_f],
                         )
                     preps.append((slots_f, order_f, None))
+            mv = self._moving_snap
+            if mv is not None:
+                # live rebalance (see dispatch_batch_arrays): mask MOVING-
+                # namespace rows out of every staged frame so the fused
+                # step never counts their tokens, and remember the span
+                # mask for the MOVED overlay
+                span0 = np.concatenate([p[0] for p in preps])
+                m, eps = self._moving_mask_for(span0, mv)
+                if m is not None:
+                    from sentinel_tpu.engine.decide import make_batch_into
+
+                    moved_span, moved_epochs_span, span_ns = m, eps, span0
+                    preps = []
+                    for f in range(depth):
+                        sl = slice(f * cap, (f + 1) * cap)
+                        slots_f = np.where(
+                            m[sl], np.int32(-1), span0[sl]
+                        ).astype(np.int32)
+                        if bool((slots_f[:-1] <= slots_f[1:]).all()):
+                            order_f = None
+                            make_batch_into(
+                                block, f, slots_f, acq[sl], pr[sl]
+                            )
+                        else:
+                            order_f = np.argsort(slots_f, kind="stable")
+                            make_batch_into(
+                                block, f, slots_f[order_f],
+                                acq[sl][order_f], pr[sl][order_f],
+                            )
+                        preps.append((slots_f, order_f, None))
             now = self._engine_now()
             self._state, verdicts = step(
                 self._state, self._table, block, np.int32(now)
@@ -962,9 +1037,21 @@ class DefaultTokenService(TokenService):
                     status[dst.start : dst.stop][order_f] = status_all[f]
                     remaining[dst.start : dst.stop][order_f] = remaining_all[f]
                     wait[dst.start : dst.stop][order_f] = wait_all[f]
+            if moved_span is not None:
+                status[moved_span] = np.int8(int(TokenStatus.MOVED))
+                remaining[moved_span] = moved_epochs_span[moved_span]
+                wait[moved_span] = 0
+                from sentinel_tpu.metrics.ha import ha_metrics
+                ha_metrics().count_rebalance_redirects(
+                    int(moved_span.sum())
+                )
             # per-namespace verdict counters + cluster stat log, once for
-            # the whole span (mirrors dispatch_batch_arrays._materialize)
-            slots_span = np.concatenate([p[0] for p in preps])
+            # the whole span (mirrors dispatch_batch_arrays._materialize);
+            # span_ns is the PRE-mask slot span when a move masked rows
+            slots_span = (
+                span_ns if span_ns is not None
+                else np.concatenate([p[0] for p in preps])
+            )
             ns_names, slot_ns = self._ns_snapshot
             ns_idx = np.where(
                 slots_span >= 0,
@@ -995,10 +1082,24 @@ class DefaultTokenService(TokenService):
         status, remaining, wait = self.request_batch_arrays(
             flow_ids, acquires, prios
         )
-        return [
-            TokenResult(TokenStatus(int(status[i])), int(remaining[i]), int(wait[i]))
-            for i in range(n)
-        ]
+        moved = int(TokenStatus.MOVED)
+        out = []
+        for i in range(n):
+            st = int(status[i])
+            if st == moved:
+                # enrich the redirect with the destination endpoint so
+                # in-process callers (and the single-request wire path)
+                # can follow it without a second lookup
+                red = self.moved_redirect(int(flow_ids[i]))
+                out.append(TokenResult(
+                    TokenStatus(st), int(remaining[i]), int(wait[i]),
+                    endpoint=red[0] if red else "",
+                ))
+            else:
+                out.append(TokenResult(
+                    TokenStatus(st), int(remaining[i]), int(wait[i])
+                ))
+        return out
 
     def load_param_rules(self, rules: List[ClusterParamFlowRule]) -> None:
         """``ClusterParamFlowRuleManager`` analog; slots stable across
@@ -1148,6 +1249,258 @@ class DefaultTokenService(TokenService):
 
     def release_concurrent_token(self, token_id):
         return TokenResult(self.concurrency.release(token_id))
+
+    # -- live rebalance (cluster.rebalance backing) --------------------------
+    def _rebuild_moving_snap(self) -> None:
+        """Rebuild the dispatch-path MOVING view from ``self._moving``.
+        Caller holds ``self._lock`` (the lock is the linearization point:
+        a dispatch that entered the lock before a ``begin_move`` decides
+        pre-move and its tokens are included in the exported sums)."""
+        if not self._moving:
+            self._moving_snap = None
+            return
+        n = self.config.max_namespaces
+        mask = np.zeros(n, bool)
+        epochs = np.zeros(n, np.int32)
+        for ns_name, (_dest, epoch) in self._moving.items():
+            row = self._index.ns_of.get(ns_name)
+            if row is not None and row < n:
+                mask[row] = True
+                epochs[row] = np.int32(epoch)
+        self._moving_snap = (mask, epochs) if mask.any() else None
+
+    def _moving_mask_for(self, slots: np.ndarray, mv):
+        """Request-order bool mask of rows whose rule's namespace is MOVING
+        (plus the per-row shard-map epoch vector), or ``(None, None)`` when
+        this batch touches no moving namespace. Caller holds ``self._lock``
+        (reads the live ``_ns_snapshot``)."""
+        mask_arr, epoch_arr = mv
+        _names, slot_ns = self._ns_snapshot
+        ns_idx = np.where(
+            slots >= 0, slot_ns[np.maximum(slots, 0)], np.int32(-1)
+        )
+        m = (ns_idx >= 0) & mask_arr[np.maximum(ns_idx, 0)]
+        if not m.any():
+            return None, None
+        return m, epoch_arr[np.maximum(ns_idx, 0)]
+
+    def begin_move(self, namespace: str, endpoint: str, epoch: int) -> None:
+        """Mark ``namespace`` MOVING to ``endpoint`` under shard-map
+        ``epoch``: from the next device step its flows stop counting tokens
+        and answer ``TokenStatus.MOVED`` instead. Idempotent re-begin to the
+        same destination is allowed (coordinator retry); a different
+        destination while moving raises."""
+        with self._lock:
+            cur = self._moving.get(namespace)
+            if cur is not None and cur[0] != endpoint:
+                raise ValueError(
+                    f"namespace {namespace!r} already moving to {cur[0]}"
+                )
+            self._moving[namespace] = (str(endpoint), int(epoch))
+            self._rebuild_moving_snap()
+
+    def abort_move(self, namespace: str) -> None:
+        """Restore normal serving for ``namespace``. Lossless by
+        construction: MOVED-masked requests never touched the counters, so
+        un-masking resumes from exactly the pre-move state."""
+        with self._lock:
+            self._moving.pop(namespace, None)
+            self._rebuild_moving_snap()
+
+    def end_redirect(self, namespace: str) -> None:
+        """Drop the post-commit redirect tombstone AND the namespace's rules
+        (the destination owns them now). Until this is called a committed
+        move keeps answering MOVED so stale clients learn the new owner."""
+        with self._lock:
+            self._moving.pop(namespace, None)
+            self._rebuild_moving_snap()
+        self.load_namespace_rules(namespace, [])
+
+    def moving_namespaces(self) -> Dict[str, Tuple[str, int]]:
+        """namespace → (destination endpoint, shard-map epoch)."""
+        with self._lock:
+            return dict(self._moving)
+
+    def moved_redirect(self, flow_id: int) -> Optional[Tuple[str, int]]:
+        """``(destination endpoint, shard-map epoch)`` when ``flow_id``'s
+        namespace is MOVING (or committed-away), else None. The single-
+        request wire path uses this to fill the MOVED endpoint trailer."""
+        if not self._moving:
+            return None
+        with self._lock:
+            slot = int(self._lookup_from(
+                self._lookup, np.asarray([flow_id], np.int64)
+            )[0])
+            if slot < 0:
+                return None
+            names, slot_ns = self._ns_snapshot
+            row = int(slot_ns[slot])
+            if row < 0 or row >= len(names):
+                return None
+            return self._moving.get(names[row])
+
+    @staticmethod
+    def _fold_into_current(ws, spec, now: int, rows, sums):
+        """Add per-row event sums into the CURRENT ring bucket of ``ws``,
+        host-side pre-rotating that column when its recorded start is stale
+        (zero it across ALL rows and stamp the aligned start — exactly what
+        :func:`stats.window.roll` would do on the next write) so the fold
+        cannot resurrect a dead bucket's counts. Conservative direction:
+        imported counts are all attributed to *now*, so they expire at most
+        one window later than they would have at the source — never
+        earlier, which is what zero-over-admission needs."""
+        idx = int((now // spec.bucket_ms) % spec.n_buckets)
+        aligned = int(now - now % spec.bucket_ms)
+        starts = np.asarray(ws.starts)
+        counts = ws.counts
+        if int(starts[idx]) != aligned:
+            counts = counts.at[:, idx].set(0)
+            starts = np.array(starts)
+            starts[idx] = aligned
+        if rows is not None and len(rows):
+            counts = counts.at[np.asarray(rows, np.int32), idx].add(
+                jnp.asarray(np.asarray(sums), counts.dtype)
+            )
+        return ws._replace(starts=jnp.asarray(starts), counts=counts)
+
+    def export_namespace_state(self, namespace: str) -> Dict[str, object]:
+        """The *slim* representation of one namespace for a live move: its
+        rules plus per-row **live-window sums** (flow/occupy event sums, the
+        namespace guard row, and the param CMS cells), not the raw ring
+        buckets. Sums are ring- and epoch-free, so the destination can fold
+        them into its OWN current bucket regardless of clock skew or ring
+        phase — the fat-update/slim-query split of SF-sketch applied to the
+        handoff (ISSUE 8). Rules come back as rule objects; the rebalance
+        codec serializes them."""
+        from sentinel_tpu.engine.state import flow_spec
+        from sentinel_tpu.stats import window as W
+
+        with self._rules_mutex, self._lock:
+            rules = list(self._rules_by_ns.get(namespace, {}).values())
+            param_rules = [
+                r for r in self._param_rules_src.values()
+                if r.namespace == namespace
+            ]
+            now = self._engine_now()
+            spec = flow_spec(self.config)
+            fsum = np.asarray(
+                W.window_sum_all(spec, self._state.flow, jnp.int32(now))
+            )
+            osum = np.asarray(
+                W.window_sum_all(spec, self._state.occupy, jnp.int32(now))
+            )
+            nsum = np.asarray(
+                W.window_sum_all(spec, self._state.ns, jnp.int32(now))
+            )
+            flow_ids: List[int] = []
+            frows: List[np.ndarray] = []
+            orows: List[np.ndarray] = []
+            for r in rules:
+                slot = self._index.slot_of.get(r.flow_id)
+                if slot is None:
+                    continue
+                flow_ids.append(int(r.flow_id))
+                frows.append(fsum[slot])
+                orows.append(osum[slot])
+            row = self._index.ns_of.get(namespace)
+            doc: Dict[str, object] = {
+                "namespace": namespace,
+                "wall_ms": int(_clock.now_ms()),
+                "interval_ms": int(spec.interval_ms),
+                "rules": rules,
+                "param_rules": param_rules,
+                "flow_ids": flow_ids,
+                "flow_sums": (
+                    np.stack(frows) if frows
+                    else np.zeros((0, fsum.shape[1]), fsum.dtype)
+                ),
+                "occupy_sums": (
+                    np.stack(orows) if orows
+                    else np.zeros((0, osum.shape[1]), osum.dtype)
+                ),
+                "ns_sum": (
+                    np.array(nsum[row]) if row is not None
+                    else np.zeros(nsum.shape[1], nsum.dtype)
+                ),
+            }
+            # param CMS: per-slot live-window cell sums [depth, width] —
+            # the sketch is linear, so summing live buckets preserves every
+            # estimate the destination will read
+            pfids: List[int] = []
+            prows: List[np.ndarray] = []
+            if param_rules:
+                pstarts = np.asarray(self._param_state.starts)
+                pcounts = np.asarray(self._param_state.counts)
+                age = now - pstarts
+                live = (age >= 0) & (age < self.param_config.interval_ms)
+                for r in param_rules:
+                    entry = self._param_rules.get(r.flow_id)
+                    if entry is None:
+                        continue
+                    pfids.append(int(r.flow_id))
+                    prows.append(pcounts[entry[0], live].sum(axis=0))
+            doc["param_fids"] = pfids
+            doc["param_sums"] = (
+                np.stack(prows) if prows
+                else np.zeros(
+                    (0, self.param_config.depth, self.param_config.width),
+                    np.int32,
+                )
+            )
+            return doc
+
+    def import_namespace_state(self, doc: Dict[str, object]) -> None:
+        """Install an :meth:`export_namespace_state` capture into THIS
+        service: load the namespace's rules through the normal reload path
+        (fresh local slots), then fold every shipped sum into the current
+        ring bucket (see :meth:`_fold_into_current`). Token-lossless: the
+        destination's first window sum over an imported row equals the
+        source's last — admission resumes exactly where the source
+        stopped."""
+        from sentinel_tpu.engine.state import EngineState as _ES
+        from sentinel_tpu.engine.state import flow_spec
+
+        namespace = str(doc["namespace"])
+        rules = list(doc["rules"])
+        param_rules = list(doc["param_rules"])
+        with self._rules_mutex:
+            self.load_namespace_rules(namespace, rules)
+            if param_rules:
+                self.load_namespace_param_rules(namespace, param_rules)
+            with self._lock:
+                now = self._engine_now()
+                spec = flow_spec(self.config)
+                flow_ids = [int(f) for f in doc.get("flow_ids", [])]
+                slots = (
+                    np.asarray(
+                        [self._index.slot_of[f] for f in flow_ids], np.int32
+                    )
+                    if flow_ids else None
+                )
+                flow = self._fold_into_current(
+                    self._state.flow, spec, now, slots, doc["flow_sums"]
+                )
+                occupy = self._fold_into_current(
+                    self._state.occupy, spec, now, slots, doc["occupy_sums"]
+                )
+                row = self._index.ns_of.get(namespace)
+                ns = self._fold_into_current(
+                    self._state.ns, spec, now,
+                    None if row is None else [row],
+                    None if row is None else np.asarray(doc["ns_sum"])[None],
+                )
+                self._state = self._place_state(
+                    _ES(flow=flow, occupy=occupy, ns=ns)
+                )
+                pfids = [int(f) for f in doc.get("param_fids", [])]
+                if pfids:
+                    prow = np.asarray(
+                        [self._param_rules[f][0] for f in pfids], np.int32
+                    )
+                    self._param_state = self._fold_into_current(
+                        self._param_state, self.param_config, now, prow,
+                        doc["param_sums"],
+                    )
 
     # -- state snapshot / restore (ha.snapshot backing) ----------------------
     def export_state(self) -> Dict[str, object]:

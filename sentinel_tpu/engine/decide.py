@@ -66,6 +66,13 @@ class TokenStatus(enum.IntEnum):
     # should walk on to the (still-alive) primary. Like OVERLOAD, never
     # produced by the device kernels.
     STANDBY = 9
+    # live-rebalance redirect: the namespace owning this flow is moving (or
+    # has moved) to another token server; ``remaining`` carries the shard-map
+    # epoch and, on the single-request wire path, the frame carries the new
+    # owner's endpoint. Routing clients re-resolve and retry once; the
+    # failover client treats it as proof of life. Like OVERLOAD/STANDBY,
+    # never produced by the device kernels.
+    MOVED = 10
 
 
 class RequestBatch(NamedTuple):

@@ -135,6 +135,19 @@ class FailoverTokenClient(TokenService):
         return False
 
     @staticmethod
+    def _moved_redirect(result) -> bool:
+        """A live-rebalance redirect (MOVED): the namespace is being (or has
+        been) handed to another server. Same whole-batch rule as OVERLOAD."""
+        if isinstance(result, TokenResult):
+            return result.status == TokenStatus.MOVED
+        if isinstance(result, tuple) and len(result) == 3:
+            status = np.asarray(result[0])
+            return status.size > 0 and bool(
+                (status == int(TokenStatus.MOVED)).all()
+            )
+        return False
+
+    @staticmethod
     def _standby_refusal(result) -> bool:
         """An unpromoted warm standby's closed-door refusal (STANDBY). Same
         whole-batch rule as OVERLOAD: every row refused, or it's an
@@ -165,7 +178,14 @@ class FailoverTokenClient(TokenService):
         Unlike OVERLOAD, a STANDBY reply carries no verdict at all, so it
         is never returned — if nothing else answers, the local fallback
         decides (without counting the cluster as exhausted: the standby is
-        alive and about to promote)."""
+        alive and about to promote).
+
+        MOVED replies (live shard rebalancing) are proof of life too: the
+        server is up and telling us the namespace now lives elsewhere. This
+        client has no shard map to follow the redirect with (that is
+        RoutingTokenClient's job), so it records SUCCESS — evicting a
+        healthy server for answering honestly would be wrong — and walks on
+        to the next endpoint, which may be the move's destination."""
         if failed is None:
             failed = lambda r: (
                 r is None
@@ -201,6 +221,12 @@ class FailoverTokenClient(TokenService):
             if self._standby_refusal(result):
                 saw_standby = True
                 ha_metrics().count_fallback("standby_redirect")
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
+            if self._moved_redirect(result):
+                saw_standby = True  # alive, not exhausted — same as STANDBY
+                ha_metrics().count_fallback("moved_redirect")
                 if _clock.now_ms() >= deadline:
                     break
                 continue

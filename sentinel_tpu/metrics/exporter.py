@@ -180,6 +180,21 @@ def render(now_ms: Optional[int] = None) -> str:
         f"sentinel_client_recv_buf_grows_total "
         f"{_client.client_recv_buf_grows_total()}"
     )
+    # DCN-tier aggregation health (import deferred for the same reason)
+    from sentinel_tpu.cluster import namespaces as _namespaces
+
+    lines.append(
+        "# HELP sentinel_assignment_snapshot_errors_total Pod metric "
+        "snapshots that failed (raised or were malformed) during "
+        "cross-pod aggregation."
+    )
+    lines.append(
+        "# TYPE sentinel_assignment_snapshot_errors_total counter"
+    )
+    lines.append(
+        f"sentinel_assignment_snapshot_errors_total "
+        f"{_namespaces.snapshot_error_total()}"
+    )
     return "\n".join(lines) + "\n"
 
 

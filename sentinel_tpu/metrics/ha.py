@@ -39,6 +39,15 @@ class HaMetrics:
         # acked end-to-end delta age, as observed by the sender (wall-clock
         # ms between export_delta's capture and the standby's ACK)
         self._repl_lag_ms = 0.0
+        # live shard rebalancing (cluster.rebalance): event → count, where
+        # event ∈ begin | commit | abort | advise
+        self._rebalance: Dict[str, int] = {}
+        self._rebalance_bytes = 0  # MOVE_STATE payload bytes shipped
+        self._rebalance_redirects = 0  # MOVED verdicts answered
+        # end-to-end move duration (begin → commit ack), wall-clock ms
+        from sentinel_tpu.metrics.histogram import LatencyHistogram
+
+        self._move_ms = LatencyHistogram(lo=1.0, hi=60_000.0)
 
     # -- writers ------------------------------------------------------------
     def count_failover(self, from_endpoint: str, to_endpoint: str,
@@ -69,6 +78,21 @@ class HaMetrics:
         with self._lock:
             self._repl_lag_ms = float(ms)
 
+    def count_rebalance(self, event: str, n: int = 1) -> None:
+        with self._lock:
+            self._rebalance[event] = self._rebalance.get(event, 0) + n
+
+    def add_rebalance_state_bytes(self, n: int) -> None:
+        with self._lock:
+            self._rebalance_bytes += int(n)
+
+    def count_rebalance_redirects(self, n: int = 1) -> None:
+        with self._lock:
+            self._rebalance_redirects += int(n)
+
+    def observe_move_ms(self, ms: float) -> None:
+        self._move_ms.record(float(ms))  # histogram is itself thread-safe
+
     # -- readers ------------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -84,6 +108,12 @@ class HaMetrics:
                     "events": dict(sorted(self._repl.items())),
                     "bytesTotal": self._repl_bytes,
                     "lagMs": self._repl_lag_ms,
+                },
+                "rebalance": {
+                    "events": dict(sorted(self._rebalance.items())),
+                    "stateBytesTotal": self._rebalance_bytes,
+                    "redirectsTotal": self._rebalance_redirects,
+                    "moveMs": self._move_ms.snapshot(),
                 },
             }
 
@@ -166,6 +196,43 @@ class HaMetrics:
         )
         lines.append("# TYPE sentinel_repl_lag_ms gauge")
         lines.append(f"sentinel_repl_lag_ms {repl_lag:g}")
+        with self._lock:
+            rebalance = sorted(self._rebalance.items())
+            reb_bytes = self._rebalance_bytes
+            reb_redirects = self._rebalance_redirects
+        lines.append(
+            "# HELP sentinel_rebalance_moves_total Live namespace-move "
+            "protocol events (begin/commit/abort) and sustained-pressure "
+            "advisories (advise)."
+        )
+        lines.append("# TYPE sentinel_rebalance_moves_total counter")
+        if rebalance:
+            for event, count in rebalance:
+                lines.append(
+                    "sentinel_rebalance_moves_total"
+                    f'{{event="{_escape(event)}"}} {count}'
+                )
+        else:
+            lines.append('sentinel_rebalance_moves_total{event="begin"} 0')
+        lines.append(
+            "# HELP sentinel_rebalance_state_bytes_total MOVE_STATE payload "
+            "bytes shipped during namespace moves."
+        )
+        lines.append("# TYPE sentinel_rebalance_state_bytes_total counter")
+        lines.append(f"sentinel_rebalance_state_bytes_total {reb_bytes}")
+        lines.append(
+            "# HELP sentinel_rebalance_redirects_total MOVED verdicts "
+            "answered for flows of a moving (or moved-away) namespace."
+        )
+        lines.append("# TYPE sentinel_rebalance_redirects_total counter")
+        lines.append(
+            f"sentinel_rebalance_redirects_total {reb_redirects}"
+        )
+        lines.append(self._move_ms.render_prometheus(
+            "sentinel_rebalance_move_duration_ms",
+            "End-to-end namespace move duration (begin to commit ack, "
+            "wall-clock ms).",
+        ))
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -177,6 +244,10 @@ class HaMetrics:
             self._repl.clear()
             self._repl_bytes = 0
             self._repl_lag_ms = 0.0
+            self._rebalance.clear()
+            self._rebalance_bytes = 0
+            self._rebalance_redirects = 0
+            self._move_ms.reset()
 
 
 _SINGLETON = HaMetrics()

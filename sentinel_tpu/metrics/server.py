@@ -36,6 +36,7 @@ VERDICT_NAMES: Dict[int, str] = {
     5: "fail",            # device step failed / degraded
     8: "overload",        # admission refused: queue full / deadline / brownout
     9: "standby",         # unpromoted warm standby refused to decide
+    10: "moved",          # namespace rebalanced away: redirect to new owner
 }
 
 # reasons on the sentinel_server_shed_total counter: every dropped or
@@ -207,6 +208,16 @@ class ServerMetrics:
         key = (verdict, namespace)
         with self._verdict_lock:
             self._verdicts[key] = self._verdicts.get(key, 0) + n
+
+    def verdict_totals_by_namespace(self) -> Dict[str, int]:
+        """Cumulative verdicts served per namespace, all verdict classes
+        summed — the admission gate diffs successive reads to rank the
+        hottest namespaces for its rebalance advisories."""
+        out: Dict[str, int] = {}
+        with self._verdict_lock:
+            for (_verdict, ns), count in self._verdicts.items():
+                out[ns] = out.get(ns, 0) + count
+        return out
 
     def record_verdict_batch(
         self,

@@ -93,6 +93,12 @@ class OverloadConfig:
     sustain_ms: float = 500.0
     # wait_ms hint carried on OVERLOAD verdicts (client backoff guidance)
     retry_hint_ms: int = 5
+    # rebalance advisories: when sustained pressure engages the ladder, name
+    # the hottest namespaces (by verdict rate since the last advisory) so an
+    # operator — or an automated rebalancer — knows what to move off this
+    # server. Rate-limited; 0 disables.
+    advise_top_n: int = 3
+    advise_interval_ms: float = 5_000.0
 
     @classmethod
     def from_config(cls) -> "OverloadConfig":
@@ -131,6 +137,13 @@ class AdmissionController:
         self._next_eval = 0.0
         self._over_since: Optional[float] = None
         self._rng = random.Random(seed)
+        # rebalance advisories (cluster.rebalance): last advice emitted, a
+        # baseline of per-namespace verdict totals to diff rates against,
+        # and an optional listener (e.g. a controller that triggers a move)
+        self.last_advice: Optional[dict] = None
+        self.on_advice = None
+        self._ns_baseline: dict = {}
+        self._next_advise = 0.0
 
     # -- inflight accounting (front doors call these) -----------------------
     def note_enqueued(self, n: int) -> None:
@@ -187,6 +200,59 @@ class AdmissionController:
             self._admit_frac = (
                 min(1.0, bdp / inflight) if inflight > 0 else 1.0
             )
+        if level is not BrownoutLevel.NORMAL:
+            # the ladder engaged on SUSTAINED pressure: this server is
+            # genuinely behind, so advise which namespaces to move away
+            self._maybe_advise(now, level)
+
+    def _maybe_advise(self, now: float, level: BrownoutLevel) -> None:
+        """Emit a ``rebalance-advise`` event naming the hottest namespaces
+        (by verdict rate since the last advisory). Rate-limited to
+        ``advise_interval_ms``; consumed via :attr:`last_advice`, the
+        optional :attr:`on_advice` listener, and the HA metrics surface."""
+        cfg = self.config
+        if cfg.advise_top_n <= 0 or now < self._next_advise:
+            return
+        self._next_advise = now + cfg.advise_interval_ms / 1000.0
+        totals = self._m.verdict_totals_by_namespace()
+        baseline, self._ns_baseline = self._ns_baseline, totals
+        rates = sorted(
+            (
+                (ns, count - baseline.get(ns, 0))
+                for ns, count in totals.items()
+            ),
+            key=lambda kv: kv[1], reverse=True,
+        )
+        hottest = [
+            {"namespace": ns, "verdicts": int(delta)}
+            for ns, delta in rates[: cfg.advise_top_n]
+            if delta > 0
+        ]
+        if not hottest:
+            return
+        advice = {
+            "level": level.name,
+            "namespaces": hottest,
+            "monotonicMs": int(now * 1000.0),
+        }
+        self.last_advice = advice
+        from sentinel_tpu.core.log import record_log
+        from sentinel_tpu.metrics.ha import ha_metrics
+
+        ha_metrics().count_rebalance("advise")
+        record_log.warning(
+            "rebalance-advise: sustained %s pressure; hottest namespaces %s",
+            level.name,
+            ", ".join(
+                f"{e['namespace']}={e['verdicts']}" for e in hottest
+            ),
+        )
+        listener = self.on_advice
+        if listener is not None:
+            try:
+                listener(advice)
+            except Exception:
+                record_log.exception("rebalance-advise listener failed")
 
     def estimated_bdp(self) -> float:
         """max(rate × minRt, floor) — requests the pipeline can hold."""
@@ -243,4 +309,5 @@ class AdmissionController:
                 "admitFrac": round(self._admit_frac, 4),
                 "estimatedBdp": round(self.estimated_bdp(), 1),
                 "enabled": self.config.enabled,
+                "lastAdvice": self.last_advice,
             }

@@ -427,10 +427,15 @@ def cmd_cluster_client_fetch_config(params, body):
 )
 def cmd_cluster_server_stats(params, body):
     """JSON twin of the ``sentinel_server_*`` Prometheus section — the
-    dashboard/command-center view of the serving pipeline."""
+    dashboard/command-center view of the serving pipeline, plus the HA
+    rebalance block (move protocol events, shipped state bytes, redirect
+    counts) so the dashboard sees live shard moves next to the pipeline."""
+    from sentinel_tpu.metrics.ha import ha_metrics
     from sentinel_tpu.metrics.server import server_metrics
 
-    return server_metrics().snapshot()
+    out = server_metrics().snapshot()
+    out["rebalance"] = ha_metrics().snapshot()["rebalance"]
+    return out
 
 
 @command_mapping(

@@ -1,0 +1,665 @@
+"""Elastic-fleet live rebalancing (cluster/rebalance.py).
+
+Covers the two-phase drain-and-move protocol end to end: the shard-map
+epoch fence, the slim state codec, MOVED masking + lossless abort at the
+service, real moves between two live front doors, chaos kills at every
+protocol step (exactly-one-owner + bit-equal counters on the survivor),
+the routing client's swap/redirect behavior, the failover client's
+MOVED-is-proof-of-life rule, admission-gate rebalance advisories, and the
+snapshot-aggregation error accounting.
+"""
+
+import threading
+import time
+import zlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from sentinel_tpu import chaos
+from sentinel_tpu.cluster.rebalance import (
+    MoveCoordinator,
+    MoveTarget,
+    ShardMap,
+    ShardMapPublisher,
+    decode_move_state_blob,
+    encode_move_state_blob,
+)
+from sentinel_tpu.cluster.routing import RoutingTokenClient
+from sentinel_tpu.cluster.token_service import (
+    ClusterParamFlowRule,
+    DefaultTokenService,
+    TokenResult,
+)
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.metrics.ha import ha_metrics
+
+# wide, slow-rotating window: the whole module finishes well inside one
+# bucket, so natural expiry can never perturb a bit-equality assertion
+_CFG = EngineConfig(
+    max_flows=64, max_namespaces=8, batch_size=64,
+    bucket_ms=5000, n_buckets=2,
+)
+
+
+def _rule(fid, qps, ns):
+    return ClusterFlowRule(fid, qps, ThresholdMode.GLOBAL, ns)
+
+
+def _sums(doc):
+    """export_namespace_state doc → {flow_id: scalar window sum}. Rows are
+    per-row sum vectors; reducing each to one float makes the comparison
+    order-free across services with different slot layouts."""
+    rows = np.asarray(doc["flow_sums"], dtype=np.float64)
+    return {
+        fid: float(rows[i].sum()) for i, fid in enumerate(doc["flow_ids"])
+    }
+
+
+# -- shard map + codec (pure) -------------------------------------------------
+def test_shard_map_assign_bumps_epoch_and_roundtrips():
+    m0 = ShardMap()
+    m1 = m0.assign("ns-a", "10.0.0.1:1111")
+    m2 = m1.assign("ns-b", "10.0.0.2:2222")
+    assert (m0.epoch, m1.epoch, m2.epoch) == (0, 1, 2)
+    assert m1.endpoint_of == {"ns-a": "10.0.0.1:1111"}  # m0 untouched
+    assert not m0.endpoint_of
+    back = ShardMap.from_doc(m2.to_doc())
+    assert back.epoch == 2 and dict(back.endpoint_of) == dict(m2.endpoint_of)
+
+
+def test_shard_map_publisher_fences_stale_epochs():
+    pub = ShardMapPublisher()
+    seen = []
+    pub.listen(lambda m: seen.append(m.epoch if m else None))
+    assert pub.publish(ShardMap(2, {"a": "h:1"}))
+    assert not pub.publish(ShardMap(2, {"a": "h:9"}))  # same epoch
+    assert not pub.publish(ShardMap(1, {"a": "h:9"}))  # older
+    assert pub.current().epoch == 2
+    assert pub.current().endpoint_of["a"] == "h:1"
+    assert 2 in seen and seen.count(2) == 1
+
+
+def test_move_state_blob_roundtrip():
+    doc = {
+        "namespace": "codec",
+        "wall_ms": 123456,
+        "interval_ms": 1000,
+        "rules": [_rule(5, 10.0, "codec")],
+        "param_rules": [
+            ClusterParamFlowRule(6, 4.0, ((2, 8.0),), "codec")
+        ],
+        "flow_ids": [5],
+        "flow_sums": np.array([3.0], np.float32),
+        "occupy_sums": np.array([1.0], np.float32),
+        "ns_sum": np.array([4.0], np.float32),
+        "param_fids": [6],
+        "param_sums": np.arange(6, dtype=np.float32).reshape(2, 3),
+    }
+    out = decode_move_state_blob(encode_move_state_blob(doc))
+    assert out["namespace"] == "codec"
+    assert out["wall_ms"] == 123456 and out["interval_ms"] == 1000
+    assert out["rules"] == doc["rules"]
+    assert out["param_rules"] == doc["param_rules"]
+    assert out["flow_ids"] == [5] and out["param_fids"] == [6]
+    for key in ("flow_sums", "occupy_sums", "ns_sum", "param_sums"):
+        assert np.array_equal(out[key], doc[key]), key
+
+
+@pytest.mark.parametrize(
+    "blob",
+    [
+        b"",
+        b"not even zlib",
+        zlib.compress(b"not json"),
+        zlib.compress(b'{"version": 99}'),  # wrong version
+        zlib.compress(b'{"version": 1, "namespace": "x"}'),  # missing keys
+    ],
+)
+def test_move_state_blob_rejects_malformed(blob):
+    with pytest.raises(ValueError):
+        decode_move_state_blob(blob)
+
+
+# -- service-level MOVED masking + lossless abort -----------------------------
+@pytest.fixture(scope="module")
+def svc():
+    return DefaultTokenService(_CFG)
+
+
+def test_begin_move_masks_flows_and_abort_is_lossless(svc):
+    svc.load_namespace_rules("mv", [_rule(11, 100.0, "mv")])
+    for _ in range(5):
+        assert svc.request_token(11).ok
+    doc0 = svc.export_namespace_state("mv")
+    svc.begin_move("mv", "10.0.0.9:1234", 3)
+    r = svc.request_token(11)
+    assert r.status == TokenStatus.MOVED
+    assert r.remaining == 3  # shard-map epoch rides the remaining field
+    assert r.endpoint == "10.0.0.9:1234"
+    assert svc.moved_redirect(11) == ("10.0.0.9:1234", 3)
+    # idempotent re-begin to the same destination (coordinator retry) ...
+    svc.begin_move("mv", "10.0.0.9:1234", 3)
+    # ... but a second claimant is a split brain and must be refused
+    with pytest.raises(ValueError):
+        svc.begin_move("mv", "10.9.9.9:1", 4)
+    svc.abort_move("mv")
+    doc1 = svc.export_namespace_state("mv")
+    assert np.array_equal(doc0["flow_sums"], doc1["flow_sums"])
+    assert np.array_equal(doc0["ns_sum"], doc1["ns_sum"])
+    assert svc.moved_redirect(11) is None
+    assert svc.request_token(11).ok
+
+
+def test_export_import_preserves_window_sums(svc):
+    svc.load_namespace_rules("xp", [_rule(21, 100.0, "xp")])
+    for _ in range(7):
+        assert svc.request_token(21).ok
+    doc = svc.export_namespace_state("xp")
+    other = DefaultTokenService(_CFG)
+    other.import_namespace_state(doc)
+    got = other.export_namespace_state("xp")
+    assert [r.flow_id for r in got["rules"]] == [21]
+    assert _sums(got)[21] == pytest.approx(_sums(doc)[21])
+    assert float(np.asarray(got["ns_sum"]).sum()) == pytest.approx(
+        float(np.asarray(doc["ns_sum"]).sum())
+    )
+    # the destination continues the window, it does not restart it
+    assert _sums(got)[21] >= 7.0
+
+
+def test_move_target_stages_without_mutating(svc):
+    """MOVE_STATE only stages; an abort (or session death) discards the
+    claim and the service never sees the document."""
+    src = DefaultTokenService(_CFG)
+    src.load_namespace_rules("st", [_rule(31, 50.0, "st")])
+    blob_doc = src.export_namespace_state("st")
+    target = MoveTarget(svc)
+    sess = target.connection()
+    assert target._begin(sess.session_id, "st", 7, "peer:1") == 0  # OK
+    assert target._stage(
+        sess.session_id, 7, encode_move_state_blob(blob_doc)
+    ) == 0
+    assert target.status()["staged"][0]["hasState"]
+    sess.closed()  # connection drops pre-commit → staging must die
+    assert not target.status()["staged"]
+    assert not svc.export_namespace_state("st")["rules"]
+
+
+# -- two live servers: real moves through the front doors ---------------------
+@pytest.fixture(scope="module")
+def fleet():
+    from sentinel_tpu.cluster.server import TokenServer
+
+    svc_src = DefaultTokenService(_CFG)
+    svc_dst = DefaultTokenService(_CFG)
+    srv_src = TokenServer(svc_src, port=0)
+    srv_dst = TokenServer(svc_dst, port=0)
+    srv_src.start()
+    srv_dst.start()
+    f = SimpleNamespace(
+        svc_src=svc_src,
+        svc_dst=svc_dst,
+        srv_src=srv_src,
+        srv_dst=srv_dst,
+        src_ep=f"127.0.0.1:{srv_src.port}",
+        dst_ep=f"127.0.0.1:{srv_dst.port}",
+    )
+    yield f
+    chaos.disarm()  # belt and braces: a failed test must not leak chaos
+    srv_src.stop()
+    srv_dst.stop()
+
+
+def _client(fleet, ep, ns):
+    from sentinel_tpu.cluster.client import TokenClient
+
+    host, _, port = ep.rpartition(":")
+    return TokenClient(host, int(port), timeout_ms=1000, namespace=ns)
+
+
+def test_live_move_hands_off_counters_and_redirects(fleet):
+    fid = 101
+    fleet.svc_src.load_namespace_rules("w1", [_rule(fid, 100.0, "w1")])
+    pub = ShardMapPublisher()
+    coord = MoveCoordinator(
+        fleet.svc_src, self_endpoint=fleet.src_ep, publisher=pub
+    )
+    c = _client(fleet, fleet.src_ep, "w1")
+    try:
+        for _ in range(5):
+            assert c.request_token(fid).ok
+        doc0 = fleet.svc_src.export_namespace_state("w1")
+        assert coord.move_namespace("w1", fleet.dst_ep), coord.last_error
+        assert pub.current().endpoint_of["w1"] == fleet.dst_ep
+        # stale client: the source answers MOVED carrying the new epoch and
+        # the destination endpoint in the response trailer
+        r = c.request_token(fid)
+        assert r.status == TokenStatus.MOVED
+        assert r.remaining == pub.current().epoch
+        assert r.endpoint == fleet.dst_ep
+        # the destination owns the namespace WITH the spent window
+        got = fleet.svc_dst.export_namespace_state("w1")
+        assert _sums(got)[fid] == pytest.approx(_sums(doc0)[fid])
+        c2 = _client(fleet, fleet.dst_ep, "w1")
+        try:
+            assert c2.request_token(fid).status in (
+                TokenStatus.OK, TokenStatus.BLOCKED,
+            )
+        finally:
+            c2.close()
+        coord.release("w1")
+        assert c.request_token(fid).status == TokenStatus.NO_RULE_EXISTS
+    finally:
+        c.close()
+
+
+@pytest.mark.parametrize("step", ["begin", "state", "commit"])
+def test_move_killed_at_each_step_leaves_one_owner(fleet, step):
+    """Connection death at every protocol step: the move fails, the source
+    remains the SOLE owner with bit-equal counters, the destination stages
+    nothing, and a request issued while the namespace is frozen STILL
+    resolves (MOVED — never a hang, never an exception)."""
+    fid = {"begin": 111, "state": 112, "commit": 113}[step]
+    ns = f"s_{step}"
+    fleet.svc_src.load_namespace_rules(ns, [_rule(fid, 100.0, ns)])
+    c = _client(fleet, fleet.src_ep, ns)
+    inflight = []
+
+    def hook(s):
+        if s == step:
+            if s != "begin":  # frozen from begin_move on: probe the mask
+                inflight.append(c.request_token(fid))
+            raise ConnectionResetError(f"chaos: killed at {s}")
+
+    pub = ShardMapPublisher()
+    coord = MoveCoordinator(
+        fleet.svc_src, self_endpoint=fleet.src_ep, publisher=pub,
+        on_step=hook,
+    )
+    try:
+        for _ in range(3):
+            assert c.request_token(fid).ok
+        doc0 = fleet.svc_src.export_namespace_state(ns)
+        assert not coord.move_namespace(ns, fleet.dst_ep)
+        assert "ConnectionResetError" in coord.last_error
+        # exactly one owner: the source, with bit-equal counters
+        doc1 = fleet.svc_src.export_namespace_state(ns)
+        assert np.array_equal(doc0["flow_sums"], doc1["flow_sums"])
+        assert np.array_equal(doc0["ns_sum"], doc1["ns_sum"])
+        assert not fleet.svc_dst.export_namespace_state(ns)["rules"]
+        assert not fleet.srv_dst.move_target.status()["staged"]
+        assert pub.current().epoch == 0  # a failed move publishes nothing
+        # in-flight request during the frozen window resolved as a redirect
+        if step != "begin":
+            assert [r.status for r in inflight] == [TokenStatus.MOVED]
+        # the source serves again immediately
+        assert c.request_token(fid).status in (
+            TokenStatus.OK, TokenStatus.BLOCKED,
+        )
+    finally:
+        c.close()
+
+
+def test_move_aborts_on_dropped_frame_then_retries_clean(fleet):
+    """chaos frame_drop eats the MOVE_BEGIN at the destination door: the
+    coordinator's ack timeout aborts the move losslessly, and a clean retry
+    on the SAME coordinator succeeds (the abort left no debris)."""
+    fid, ns = 103, "w3"
+    fleet.svc_src.load_namespace_rules(ns, [_rule(fid, 100.0, ns)])
+    c = _client(fleet, fleet.src_ep, ns)
+    pub = ShardMapPublisher()
+    coord = MoveCoordinator(
+        fleet.svc_src, self_endpoint=fleet.src_ep, publisher=pub,
+        ack_timeout_s=0.5,
+    )
+    try:
+        for _ in range(2):
+            assert c.request_token(fid).ok
+        doc0 = fleet.svc_src.export_namespace_state(ns)
+        chaos.arm("frame_drop:n=1", seed=11)
+        try:
+            ok = coord.move_namespace(ns, fleet.dst_ep)
+            dropped = chaos.fired().get("frame_drop", 0)
+        finally:
+            chaos.disarm()
+        assert not ok and dropped == 1
+        doc1 = fleet.svc_src.export_namespace_state(ns)
+        assert np.array_equal(doc0["flow_sums"], doc1["flow_sums"])
+        assert not fleet.svc_dst.export_namespace_state(ns)["rules"]
+        assert c.request_token(fid).status in (
+            TokenStatus.OK, TokenStatus.BLOCKED,
+        )
+        assert coord.move_namespace(ns, fleet.dst_ep), coord.last_error
+        assert _sums(fleet.svc_dst.export_namespace_state(ns))[fid] > 0
+        coord.release(ns)
+    finally:
+        c.close()
+
+
+def test_move_commits_under_device_stall_with_live_traffic(fleet):
+    """A stalling device mid-move: every concurrent request resolves (no
+    raise), the move still commits, and the routing client converges on the
+    destination within one epoch bump."""
+    from sentinel_tpu.ha import (
+        FallbackAction,
+        FallbackRule,
+        LocalFallbackPolicy,
+    )
+
+    fid, ns = 104, "w4"
+    fleet.svc_src.load_namespace_rules(ns, [_rule(fid, 1000.0, ns)])
+    pub = ShardMapPublisher()
+    coord = MoveCoordinator(
+        fleet.svc_src, self_endpoint=fleet.src_ep, publisher=pub
+    )
+    host_s, _, port_s = fleet.src_ep.rpartition(":")
+    host_d, _, port_d = fleet.dst_ep.rpartition(":")
+    rc = RoutingTokenClient(
+        timeout_ms=1000,
+        namespace_of={fid: ns},
+        pod_of={ns: fleet.src_ep},
+        endpoints={
+            fleet.src_ep: (host_s, int(port_s)),
+            fleet.dst_ep: (host_d, int(port_d)),
+        },
+        fallback=LocalFallbackPolicy(
+            [FallbackRule(fid, FallbackAction.BLOCK)]
+        ),
+        shard_maps=pub,
+    )
+    epoch0 = rc.epoch
+    move = {}
+
+    def _mover():
+        move["ok"] = coord.move_namespace(ns, fleet.dst_ep)
+
+    try:
+        assert rc.request_token(fid).ok
+        chaos.arm("device_stall:ms=50,n=8", seed=3)
+        mover = threading.Thread(target=_mover)
+        mover.start()
+        raised = 0
+        statuses = []
+        for _ in range(30):
+            try:
+                statuses.append(rc.request_token(fid).status)
+            except Exception:
+                raised += 1
+            time.sleep(0.01)
+        mover.join(timeout=30)
+        chaos.disarm()
+        assert move.get("ok"), coord.last_error
+        assert raised == 0
+        assert len(statuses) == 30  # every request resolved to a verdict
+        assert _sums(fleet.svc_dst.export_namespace_state(ns))[fid] > 0
+        assert rc.epoch - epoch0 == 1  # converged within ONE epoch bump
+        assert rc.request_token(fid).status in (
+            TokenStatus.OK, TokenStatus.BLOCKED,
+        )
+        coord.release(ns)
+    finally:
+        chaos.disarm()
+        rc.close()
+
+
+# -- routing client: swap race + fences ---------------------------------------
+class _StubPodClient:
+    """client_factory stand-in recording close ordering for the swap-race
+    regression: retired clients must only be closed AFTER the new routing
+    state is visible to readers."""
+
+    owner = None  # class attr: the RoutingTokenClient under test
+
+    def __init__(self, host, port, timeout_ms=20, namespace="default"):
+        self.port = port
+        self.closed = False
+        self.closed_while_live = False
+
+    def request_token(self, fid, acquire=1, prioritized=False):
+        return TokenResult(TokenStatus.OK, remaining=self.port)
+
+    def ping(self, namespace=None):
+        return True
+
+    def close(self):
+        if (
+            _StubPodClient.owner is not None
+            and self in _StubPodClient.owner._clients.values()
+        ):
+            self.closed_while_live = True
+        self.closed = True
+
+
+def test_routing_update_closes_retired_clients_after_swap():
+    rc = RoutingTokenClient(
+        namespace_of={1: "ns"},
+        pod_of={"ns": "pod0"},
+        endpoints={"pod0": ("h", 1)},
+        client_factory=_StubPodClient,
+    )
+    _StubPodClient.owner = rc
+    try:
+        assert rc.request_token(1).remaining == 1  # materializes pod0
+        old = rc._clients["pod0"]
+        rc.update(
+            pod_of={"ns": "pod1"}, endpoints={"pod1": ("h", 2)}
+        )
+        assert old.closed and not old.closed_while_live
+        assert rc.request_token(1).remaining == 2
+    finally:
+        _StubPodClient.owner = None
+        rc.close()
+
+
+def test_routing_update_swap_is_atomic_under_concurrent_readers():
+    """Hammer update() against readers: every request resolves and no
+    retired client is ever closed while still routable."""
+    rc = RoutingTokenClient(
+        namespace_of={1: "ns"},
+        pod_of={"ns": "pod0"},
+        endpoints={"pod0": ("h", 1)},
+        client_factory=_StubPodClient,
+    )
+    _StubPodClient.owner = rc
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                r = rc.request_token(1)
+                assert r.remaining in (1, 2)
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for i in range(200):
+            pod = "pod0" if i % 2 == 0 else "pod1"
+            port = 1 if i % 2 == 0 else 2
+            rc.update(pod_of={"ns": pod}, endpoints={pod: ("h", port)})
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+    finally:
+        stop.set()
+        _StubPodClient.owner = None
+        rc.close()
+
+
+def test_routing_epoch_fence_on_maps_and_learned_moves():
+    rc = RoutingTokenClient(
+        namespace_of={1: "ns"},
+        pod_of={"ns": "old:1"},
+        endpoints={"old:1": ("old", 1)},
+        client_factory=_StubPodClient,
+    )
+    try:
+        assert rc.apply_shard_map(ShardMap(3, {"ns": "new:2"}))
+        assert rc.epoch == 3
+        # stale pushes (≤ current epoch) never roll a route back
+        assert not rc.apply_shard_map(ShardMap(3, {"ns": "older:9"}))
+        assert not rc.apply_shard_map(ShardMap(2, {"ns": "older:9"}))
+        assert rc._state.pod_of["ns"] == "new:2"
+        # MOVED-learned single routes obey the same fence
+        assert not rc._learn_move("ns", "older:9", 3)
+        assert rc._learn_move("ns", "newest:7", 4)
+        assert rc.epoch == 4 and rc._state.pod_of["ns"] == "newest:7"
+        # an unparseable endpoint in a newer map must not clobber the route
+        assert rc.apply_shard_map(ShardMap(5, {"ns": "garbage"}))
+        assert rc.epoch == 5 and rc._state.pod_of["ns"] == "newest:7"
+    finally:
+        rc.close()
+
+
+# -- failover client: MOVED is proof of life ----------------------------------
+class _MovedOrOkClient:
+    def __init__(self, host, port, timeout_ms=20, namespace="default"):
+        self.host = host
+
+    def request_token(self, fid, acquire=1, prioritized=False):
+        if self.host == "moved":
+            return TokenResult(
+                TokenStatus.MOVED, remaining=9, endpoint="dst:1"
+            )
+        return TokenResult(TokenStatus.OK, remaining=42)
+
+    def close(self):
+        pass
+
+
+def test_failover_treats_moved_as_proof_of_life():
+    from sentinel_tpu.ha.failover import FailoverTokenClient
+
+    fc = FailoverTokenClient(
+        [("moved", 1), ("alive", 2)],
+        client_factory=_MovedOrOkClient,
+        failure_threshold=1,
+    )
+    before = ha_metrics().snapshot()["fallback"].get("moved_redirect", 0)
+    try:
+        # walks past the MOVED endpoint to the one that answers
+        r = fc.request_token(1)
+        assert r.status == TokenStatus.OK and r.remaining == 42
+        # with threshold=1 a single recorded FAILURE would evict; the MOVED
+        # endpoint must still be in rotation (it recorded SUCCESS)
+        assert fc._members[0].health.allows_request()
+        after = ha_metrics().snapshot()["fallback"].get("moved_redirect", 0)
+        assert after == before + 1
+    finally:
+        fc.close()
+
+
+def test_failover_all_moved_degrades_to_fallback_without_eviction():
+    from sentinel_tpu.ha.failover import FailoverTokenClient
+
+    fc = FailoverTokenClient(
+        [("moved", 1), ("moved", 2)],
+        client_factory=_MovedOrOkClient,
+        failure_threshold=1,
+    )
+    try:
+        r = fc.request_token(1)
+        # MOVED carries no verdict; the local fallback answers instead
+        assert r.status != TokenStatus.MOVED
+        assert all(m.health.allows_request() for m in fc._members)
+    finally:
+        fc.close()
+
+
+# -- admission gate: rebalance advisories -------------------------------------
+def test_sustained_pressure_emits_rebalance_advise():
+    from sentinel_tpu.metrics.server import ServerMetrics
+    from sentinel_tpu.overload.admission import (
+        AdmissionController,
+        BrownoutLevel,
+        OverloadConfig,
+    )
+
+    m = ServerMetrics()
+    m.count_verdict("pass", "hot", 500)
+    m.count_verdict("pass", "lukewarm", 40)
+    m.count_verdict("block", "cold", 3)
+    ac = AdmissionController(
+        OverloadConfig(
+            headroom_shed=0.0, min_bdp=0.0, sustain_ms=0.0,
+            recheck_ms=0.0, advise_interval_ms=0.0, advise_top_n=2,
+        ),
+        metrics=m,
+    )
+    heard = []
+    ac.on_advice = heard.append
+    ac.note_enqueued(8)
+    assert ac.level() is not BrownoutLevel.NORMAL
+    advice = ac.last_advice
+    assert advice is not None and heard == [advice]
+    named = [e["namespace"] for e in advice["namespaces"]]
+    assert named == ["hot", "lukewarm"]  # top-N by verdict delta
+    assert advice["namespaces"][0]["verdicts"] == 500
+    assert advice["level"] == ac.snapshot()["levelName"]
+    assert ac.snapshot()["lastAdvice"] is advice
+
+
+def test_advise_disabled_with_top_n_zero():
+    from sentinel_tpu.metrics.server import ServerMetrics
+    from sentinel_tpu.overload.admission import (
+        AdmissionController,
+        BrownoutLevel,
+        OverloadConfig,
+    )
+
+    m = ServerMetrics()
+    m.count_verdict("pass", "hot", 100)
+    ac = AdmissionController(
+        OverloadConfig(
+            headroom_shed=0.0, min_bdp=0.0, sustain_ms=0.0,
+            recheck_ms=0.0, advise_top_n=0,
+        ),
+        metrics=m,
+    )
+    ac.note_enqueued(8)
+    assert ac.level() is not BrownoutLevel.NORMAL
+    assert ac.last_advice is None
+
+
+# -- snapshot aggregation error accounting ------------------------------------
+def test_aggregate_snapshots_skips_bad_pods_and_counts_them():
+    from sentinel_tpu.cluster.namespaces import (
+        aggregate_snapshots,
+        reset_snapshot_errors_for_tests,
+        snapshot_error_total,
+    )
+
+    reset_snapshot_errors_for_tests()
+
+    def unreachable():
+        raise ConnectionError("pod down")
+
+    out = aggregate_snapshots([
+        {1: {"pass": 2.0}},
+        unreachable,  # fetch raises → skipped, counted
+        lambda: {1: {"pass": 3.0}, 2: {"block": 1.0}},
+        {1: "not-a-mapping"},  # malformed payload → skipped, counted
+    ])
+    # bad pods contribute NOTHING; good pods still sum
+    assert out[1]["pass"] == pytest.approx(5.0)
+    assert out[2]["block"] == pytest.approx(1.0)
+    assert snapshot_error_total() == 2
+
+
+def test_exporter_renders_rebalance_and_snapshot_error_series():
+    from sentinel_tpu.metrics import exporter
+
+    body = exporter.render()
+    assert "sentinel_assignment_snapshot_errors_total" in body
+    assert "sentinel_rebalance_moves_total" in body
+    assert "sentinel_rebalance_state_bytes_total" in body
+    assert "sentinel_rebalance_redirects_total" in body
+    assert "sentinel_rebalance_move_duration_ms" in body

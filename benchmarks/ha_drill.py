@@ -360,6 +360,60 @@ def run_overload_drill(seconds: float = 2.5, probe_timeout_ms: int = 500):
     }
 
 
+def measure_param_delta_bytes(
+    n_values: int = 3000,
+    chunk: int = 60,
+) -> dict:
+    """Per-tick param replication wire cost, slim vs fat, on identical
+    traffic: two in-process services — one with the SF slim twin enabled
+    (deltas ship ``param_slim`` rows), one with ``slim_width=0`` (deltas
+    ship full fat rows) — absorb the same value stream, then each exports
+    one delta through the real wire codec (``encode_delta_blob``). The
+    slim blob's bytes are fed to ``ha_metrics().add_repl_bytes`` so
+    ``sentinel_repl_bytes_total`` shows what a slim-shipping tick costs.
+    Returns ``{"fat": int, "slim": int, "ratio": float}``; the drill gates
+    on ratio ≥ 4 (docs/SKETCHES.md)."""
+    import numpy as np
+
+    from sentinel_tpu.cluster.token_service import (
+        ClusterParamFlowRule,
+        DefaultTokenService,
+    )
+    from sentinel_tpu.engine import EngineConfig
+    from sentinel_tpu.engine.param import ParamConfig
+    from sentinel_tpu.ha import replication as R
+    from sentinel_tpu.metrics.ha import ha_metrics
+
+    cfg = EngineConfig(max_flows=16, max_namespaces=4, batch_size=64)
+    rng = np.random.default_rng(0x5A15A)
+    vals = rng.integers(-2 ** 63, 2 ** 63 - 1, size=n_values, dtype=np.int64)
+    sizes = {}
+    for label, slim_width in (("slim", 256), ("fat", 0)):
+        svc = DefaultTokenService(
+            cfg,
+            param_config=ParamConfig(
+                max_param_rules=32, impl="jax", slim_width=slim_width
+            ),
+        )
+        svc.load_param_rules(
+            [ClusterParamFlowRule(flow_id=5, count=1e9),
+             ClusterParamFlowRule(flow_id=6, count=1e9)]
+        )
+        svc.replication_enable()
+        for fid in (5, 6):
+            for off in range(0, n_values, chunk):
+                svc.request_params_token(
+                    fid, 1, [int(h) for h in vals[off:off + chunk]]
+                )
+        sizes[label] = len(R.encode_delta_blob(svc.export_delta()))
+    ha_metrics().add_repl_bytes(sizes["slim"])
+    return {
+        "fat": sizes["fat"],
+        "slim": sizes["slim"],
+        "ratio": round(sizes["fat"] / max(sizes["slim"], 1), 2),
+    }
+
+
 def run_replication_drill(
     count: float = 300.0,
     repl_interval_ms: float = 100.0,
@@ -389,7 +443,10 @@ def run_replication_drill(
     - the promoted standby actually BLOCKS (proof it inherited the
       half-spent window rather than starting fresh);
     - ``sentinel_repl_lag_ms`` and the delta counters are live on both
-      metrics surfaces.
+      metrics surfaces;
+    - per-tick param replication bytes: SF slim deltas come in ≥4× under
+      fat-row deltas for identical traffic (``measure_param_delta_bytes``),
+      recorded in the artifact and ``sentinel_repl_bytes_total``.
     """
     from sentinel_tpu.engine import TokenStatus
     from sentinel_tpu.ha import (
@@ -531,8 +588,13 @@ def run_replication_drill(
                 standby_blocks += 1
         total_admitted = admitted_fill + admitted_post
         # staleness budget: what one lost ship interval can re-admit, at
-        # the measured fill rate (+1 in-flight batch of slack)
-        budget = int(fill_rate * repl_interval_ms / 1000.0) + 2
+        # the measured fill rate (+1 in-flight batch of slack). The slack
+        # used to be +2 when a full fat-sketch delta could stretch the
+        # sender's effective cadence past repl_interval_ms under load; SF
+        # slim deltas (sketch/slim.py) cut the param payload ≥4×, so one
+        # batch of slack is enough — a looser gate would hide a sender
+        # falling back to fat shipping.
+        budget = int(fill_rate * repl_interval_ms / 1000.0) + 1
         over_admission = max(0, int(total_admitted - count))
         if converge_ms is None:
             failures.append("standby never served after the kill")
@@ -568,8 +630,24 @@ def run_replication_drill(
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+    # per-tick param replication wire cost, slim vs fat, on identical
+    # in-process traffic — the measurement that justifies the tightened
+    # one-batch staleness slack above
+    try:
+        param_delta_bytes = measure_param_delta_bytes()
+    except Exception as e:
+        param_delta_bytes = {"fat": 0, "slim": 0, "ratio": 0.0}
+        failures.append(f"param delta byte measure failed: {e!r}")
+    else:
+        if param_delta_bytes["ratio"] < 4.0:
+            failures.append(
+                f"slim param deltas only {param_delta_bytes['ratio']:.1f}x "
+                f"smaller than fat (need >= 4x): "
+                f"{param_delta_bytes['slim']}B vs {param_delta_bytes['fat']}B"
+            )
     return {
         "window_tokens": count,
+        "param_delta_bytes": param_delta_bytes,
         "rule_qps": rule_qps,
         "repl_interval_ms": repl_interval_ms,
         "fill_rate_vps": round(fill_rate, 1) if fill_rate else None,
@@ -853,7 +931,10 @@ def main() -> None:
             f"(budget {rep['staleness_budget']}), standby promoted and "
             f"served in {rep['promote_convergence_ms']}ms, "
             f"{rep['standby_blocks']} post-promotion blocks, "
-            f"repl lag gauge live={rep['repl_lag_gauge_live']}"
+            f"repl lag gauge live={rep['repl_lag_gauge_live']}, "
+            f"param delta bytes slim {rep['param_delta_bytes']['slim']}B "
+            f"vs fat {rep['param_delta_bytes']['fat']}B "
+            f"({rep['param_delta_bytes']['ratio']}x)"
         )
     if "rebalance" in doc:
         reb = doc["rebalance"]

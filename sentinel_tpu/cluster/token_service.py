@@ -294,6 +294,18 @@ class DefaultTokenService(TokenService):
         self._param_state = make_param_state(self.param_config)
         self._param_rules: Dict[int, Tuple[int, float, Dict[int, float]]] = {}
         self._param_free = list(range(self.param_config.max_param_rules - 1, -1, -1))
+        # sketch observability (sentinel_sketch_* series + the `sketch`
+        # block of clusterServerStats): the process-wide ServerMetrics pulls
+        # through a weakref so a dead service never pins memory; the most
+        # recently constructed service is the one scraped
+        import weakref
+
+        _self = weakref.ref(self)
+        _SM.register_sketch_provider(
+            lambda: (lambda s: s.sketch_stats() if s is not None else {})(
+                _self()
+            )
+        )
         # concurrent (semaphore) mode — host-side by design, see
         # sentinel_tpu.cluster.concurrent
         from sentinel_tpu.cluster.concurrent import ConcurrencyManager
@@ -501,7 +513,7 @@ class DefaultTokenService(TokenService):
             # replication sender into a full-snapshot resync
             self._state_gen += 1
             if self._dirty is not None:
-                self._dirty = {"flow": set(), "param": set()}
+                self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
 
     def load_namespace_rules(
         self, namespace: str, rules: List[ClusterFlowRule]
@@ -661,8 +673,18 @@ class DefaultTokenService(TokenService):
             # bucket (shape drift, ladder change) — visible, not silent.
             log_cluster("warmup_step_compiles", count=compiles)
             idx = hash_indices(
-                np.zeros(1, np.int64), self.param_config.depth, self.param_config.width
+                np.zeros(1, np.int64),
+                self.param_config.depth,
+                self.param_config.cell_width,
             )
+            idx_slim = None
+            if self.param_config.slim_enabled:
+                from sentinel_tpu.sketch.slim import slim_indices
+
+                si = slim_indices(self.param_config, np.zeros(1, np.int64))
+                idx_slim = jnp.asarray(
+                    np.broadcast_to(si, (8, si.shape[1]))
+                )
             n_pad = 8  # matches request_params_token's minimum padded shape
             param_decide(
                 self.param_config,
@@ -673,6 +695,7 @@ class DefaultTokenService(TokenService):
                 jnp.zeros(n_pad, jnp.float32),
                 jnp.zeros(n_pad, bool),  # nothing valid → state unchanged
                 jnp.int32(now),
+                idx_slim=idx_slim,
             )
 
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
@@ -1119,8 +1142,14 @@ class DefaultTokenService(TokenService):
                 if fid not in live:
                     slot, _, _ = self._param_rules.pop(fid)
                     self._param_free.append(slot)
+                    # clear the whole sketch row: fat cells (for SALSA the
+                    # zeroed int16 cells are unmerged zeros, so the merge
+                    # state clears with them), the slim twin row, and the
+                    # slot's merge counter
                     self._param_state = self._param_state._replace(
-                        counts=self._param_state.counts.at[slot].set(0)
+                        counts=self._param_state.counts.at[slot].set(0),
+                        slim=self._param_state.slim.at[slot].set(0),
+                        merges=self._param_state.merges.at[slot].set(0),
                     )
             for rule in rules:
                 existing = self._param_rules.get(rule.flow_id)
@@ -1136,7 +1165,7 @@ class DefaultTokenService(TokenService):
             # invalidate any delta collected against the old generation
             self._state_gen += 1
             if self._dirty is not None:
-                self._dirty = {"flow": set(), "param": set()}
+                self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
 
     def load_namespace_param_rules(
         self, namespace: str, rules: List[ClusterParamFlowRule]
@@ -1183,7 +1212,7 @@ class DefaultTokenService(TokenService):
             slot, count, items = entry
             hashes = np.asarray(list(param_hashes), dtype=np.int64)
             idx = hash_indices(
-                hashes, self.param_config.depth, self.param_config.width
+                hashes, self.param_config.depth, self.param_config.cell_width
             )
             n = hashes.shape[0]
             # pad to a power of two: param_decide's shapes are baked into its
@@ -1192,6 +1221,14 @@ class DefaultTokenService(TokenService):
             n_pad = max(8, 1 << (n - 1).bit_length())
             pad = n_pad - n
             idx = np.pad(idx, ((0, pad), (0, 0)))
+            idx_slim = None
+            if self.param_config.slim_enabled:
+                from sentinel_tpu.sketch.slim import slim_indices
+
+                idx_slim = jnp.asarray(np.pad(
+                    slim_indices(self.param_config, hashes),
+                    ((0, pad), (0, 0)),
+                ))
             thresholds = np.array(
                 [items.get(int(h), count) for h in hashes], dtype=np.float32
             )
@@ -1208,6 +1245,7 @@ class DefaultTokenService(TokenService):
                 jnp.asarray(thresholds),
                 jnp.asarray(valid),
                 jnp.int32(now),
+                idx_slim=idx_slim,
             )
             if self._dirty is not None:
                 self._dirty["param"].add(int(slot))
@@ -1423,14 +1461,21 @@ class DefaultTokenService(TokenService):
                     else np.zeros(nsum.shape[1], nsum.dtype)
                 ),
             }
-            # param CMS: per-slot live-window cell sums [depth, width] —
-            # the sketch is linear, so summing live buckets preserves every
-            # estimate the destination will read
+            # param sketch: per-slot live-window cell sums [depth, cells] —
+            # summed over DECODED cells (sketch.decoded_counts_np), so the
+            # wire document is plain int sums whatever the in-memory
+            # encoding (int32 cms or int16 SALSA pairs). The sketch is
+            # linear over decoded values, so summing live buckets preserves
+            # every estimate the destination will read.
+            from sentinel_tpu.sketch import decoded_counts_np
+
             pfids: List[int] = []
             prows: List[np.ndarray] = []
             if param_rules:
                 pstarts = np.asarray(self._param_state.starts)
-                pcounts = np.asarray(self._param_state.counts)
+                pcounts = decoded_counts_np(
+                    self.param_config, self._param_state.counts
+                )
                 age = now - pstarts
                 live = (age >= 0) & (age < self.param_config.interval_ms)
                 for r in param_rules:
@@ -1438,13 +1483,16 @@ class DefaultTokenService(TokenService):
                     if entry is None:
                         continue
                     pfids.append(int(r.flow_id))
-                    prows.append(pcounts[entry[0], live].sum(axis=0))
+                    prows.append(
+                        pcounts[entry[0], live].sum(axis=0).astype(np.int64)
+                    )
             doc["param_fids"] = pfids
             doc["param_sums"] = (
                 np.stack(prows) if prows
                 else np.zeros(
-                    (0, self.param_config.depth, self.param_config.width),
-                    np.int32,
+                    (0, self.param_config.depth,
+                     self.param_config.cell_width),
+                    np.int64,
                 )
             )
             return doc
@@ -1494,13 +1542,25 @@ class DefaultTokenService(TokenService):
                 )
                 pfids = [int(f) for f in doc.get("param_fids", [])]
                 if pfids:
+                    from sentinel_tpu.sketch import fold_param_sums
+
                     prow = np.asarray(
                         [self._param_rules[f][0] for f in pfids], np.int32
                     )
-                    self._param_state = self._fold_into_current(
-                        self._param_state, self.param_config, now, prow,
+                    self._param_state = fold_param_sums(
+                        self.param_config, self._param_state, now, prow,
                         doc["param_sums"],
                     )
+                    # the fold lands in the FAT sketch only — the slim twin
+                    # never saw the source's touches. Mark the rows for a
+                    # one-shot fat shipment so a delta-fed standby doesn't
+                    # miss the moved-in window (moves are rare; one fat row
+                    # per moved rule, not per tick).
+                    if self._dirty is not None:
+                        self._dirty["param"].update(int(r) for r in prow)
+                        self._dirty.setdefault("param_fat", set()).update(
+                            int(r) for r in prow
+                        )
 
     # -- state snapshot / restore (ha.snapshot backing) ----------------------
     def export_state(self) -> Dict[str, object]:
@@ -1540,7 +1600,14 @@ class DefaultTokenService(TokenService):
                 "ns": _win(self._state.ns),
                 "param": {
                     "starts": np.asarray(self._param_state.starts),
+                    # fat cells ship RAW (bit-exact restore — for SALSA the
+                    # in-band merge encoding rides inside the int16 cells),
+                    # plus the slim twin, its authority flags, and the
+                    # per-slot merge counters
                     "counts": np.asarray(self._param_state.counts),
+                    "slim": np.asarray(self._param_state.slim),
+                    "slim_auth": np.asarray(self._param_state.slim_auth),
+                    "merges": np.asarray(self._param_state.merges),
                 },
             }
 
@@ -1589,6 +1656,15 @@ class DefaultTokenService(TokenService):
                              self._param_state.counts)
                 p_s = _check("param.starts", state["param"]["starts"],
                              self._param_state.starts)
+                # slim/merge keys are tolerated absent (pre-sketch-subsystem
+                # snapshots) — they default to zeros of this service's
+                # geometry
+                p_slim = state["param"].get("slim")
+                if p_slim is not None:
+                    p_slim = _check("param.slim", p_slim,
+                                    self._param_state.slim)
+                p_auth = state["param"].get("slim_auth")
+                p_merges = state["param"].get("merges")
             self.load_rules(
                 rules,
                 ns_max_qps=float(state["ns_max_qps"]),
@@ -1614,20 +1690,41 @@ class DefaultTokenService(TokenService):
                     old = old_ns.get(name)
                     if old is not None:
                         new_ns_c[new] = ns_c[old]
-                # param sketch rows remap via the param slot maps
+                # param sketch rows remap via the param slot maps (fat row,
+                # slim row, and merge counter move together; the [B] global
+                # slim-authority flags copy verbatim)
                 old_pslot = state["param_slot_of"]
                 new_p_c = np.zeros_like(p_c)
+                new_p_slim = np.zeros(
+                    self._param_state.slim.shape,
+                    np.asarray(self._param_state.slim).dtype,
+                )
+                new_p_merges = np.zeros(
+                    self._param_state.merges.shape, np.int32
+                )
                 for fid, (new, _, _) in self._param_rules.items():
                     old = old_pslot.get(fid)
                     if old is not None:
                         new_p_c[new] = p_c[old]
+                        if p_slim is not None:
+                            new_p_slim[new] = p_slim[old]
+                        if p_merges is not None:
+                            new_p_merges[new] = np.asarray(p_merges)[old]
                 self._state = self._place_state(_ES(
                     flow=_WS(jnp.asarray(flow_s), jnp.asarray(new_flow_c)),
                     occupy=_WS(jnp.asarray(occ_s), jnp.asarray(new_occ_c)),
                     ns=_WS(jnp.asarray(ns_s), jnp.asarray(new_ns_c)),
                 ))
                 self._param_state = self._param_state._replace(
-                    starts=jnp.asarray(p_s), counts=jnp.asarray(new_p_c),
+                    starts=jnp.asarray(p_s),
+                    counts=jnp.asarray(new_p_c),
+                    slim=jnp.asarray(new_p_slim),
+                    slim_auth=(
+                        jnp.asarray(np.asarray(p_auth, bool))
+                        if p_auth is not None
+                        else jnp.zeros_like(self._param_state.slim_auth)
+                    ),
+                    merges=jnp.asarray(new_p_merges),
                 )
                 # resume the snapshot's engine timeline: wall − epoch keeps
                 # advancing, so windows older than interval_ms expire on the
@@ -1640,7 +1737,7 @@ class DefaultTokenService(TokenService):
         Idempotent; until called the dispatch paths skip the bookkeeping."""
         with self._lock:
             if self._dirty is None:
-                self._dirty = {"flow": set(), "param": set()}
+                self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
 
     def replication_disable(self) -> None:
         with self._lock:
@@ -1671,7 +1768,8 @@ class DefaultTokenService(TokenService):
                 raise RuntimeError("replication tracking not enabled")
             flow_slots = sorted(self._dirty["flow"])
             param_slots = sorted(self._dirty["param"])
-            self._dirty = {"flow": set(), "param": set()}
+            param_fat_slots = sorted(self._dirty.get("param_fat", ()))
+            self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
             now = self._engine_now()  # pins the epoch, runs a due rebase
             delta: Dict[str, object] = {
                 "gen": int(self._state_gen),
@@ -1715,7 +1813,29 @@ class DefaultTokenService(TokenService):
                     s: fid for fid, (s, _, _) in self._param_rules.items()
                 }
                 delta["param_fids"] = [int(prev[s]) for s in param_slots]
-                delta["param_counts"] = host_rows(self._param_state.counts, pr)
+                if self.param_config.slim_enabled:
+                    # SF-sketch split: the every-tick wire document ships
+                    # the SLIM twin rows, not the fat update sketch —
+                    # that's the sentinel_repl_bytes_total cut (the fat
+                    # rows still ship in full snapshots for bit-exact
+                    # bootstrap). Rows a MOVE import just folded are the
+                    # exception: their mass exists only in the fat sketch,
+                    # so they ride along once, keyed separately.
+                    delta["param_slim"] = host_rows(
+                        self._param_state.slim, pr
+                    )
+                    if param_fat_slots:
+                        fr = np.asarray(param_fat_slots, np.int32)
+                        delta["param_fat_fids"] = [
+                            int(prev[s]) for s in param_fat_slots
+                        ]
+                        delta["param_counts"] = host_rows(
+                            self._param_state.counts, fr
+                        )
+                else:
+                    delta["param_counts"] = host_rows(
+                        self._param_state.counts, pr
+                    )
             return delta
 
     def apply_replication_delta(self, delta: Dict[str, object]) -> None:
@@ -1803,25 +1923,73 @@ class DefaultTokenService(TokenService):
             ))
             pstate = _rotate(self._param_state, delta["param_starts"])
             pcounts = pstate.counts
-            param_fids = delta.get("param_fids")
-            if param_fids:
+            pslim, pauth = pstate.slim, pstate.slim_auth
+            # mirror the ring rotation on the slim twin too: a rotated
+            # column's slim cells describe a dead window — zero them and
+            # drop the bucket's authority flag
+            pchanged = (
+                np.asarray(self._param_state.starts)
+                != np.asarray(delta["param_starts"])
+            )
+            if pchanged.any():
+                keep = jnp.asarray((~pchanged).astype(np.int32))
+                pslim = pslim * keep.reshape(1, -1, 1, 1).astype(pslim.dtype)
+                pauth = pauth & jnp.asarray(~pchanged)
+
+            def _prows(fids):
                 rows = []
-                for fid in param_fids:
+                for fid in fids:
                     entry = self._param_rules.get(int(fid))
                     if entry is None:
                         raise ValueError(
                             f"delta names unknown param rule {fid}"
                         )
                     rows.append(entry[0])
-                pr = jnp.asarray(np.asarray(rows, np.int32))
-                pcounts = pcounts.at[pr].set(
-                    jnp.asarray(delta["param_counts"])
-                )
+                return jnp.asarray(np.asarray(rows, np.int32))
+
+            param_fids = delta.get("param_fids")
+            if param_fids:
+                if "param_slim" in delta:
+                    # SF split: deltas carry slim twin rows. Landing any
+                    # makes every live bucket slim-authoritative — the
+                    # decide path then serves fat + slim, which
+                    # double-counts at most one snapshot-to-delta gap
+                    # (over-estimate, the safe direction) and converges to
+                    # fat-only as the flagged buckets rotate off the ring.
+                    pr = _prows(param_fids)
+                    pslim = pslim.at[pr].set(
+                        jnp.asarray(delta["param_slim"])
+                    )
+                    pauth = jnp.ones_like(pauth)
+                    fat_fids = delta.get("param_fat_fids")
+                    if fat_fids:
+                        fr = _prows(fat_fids)
+                        pcounts = pcounts.at[fr].set(
+                            jnp.asarray(delta["param_counts"])
+                        )
+                elif "param_counts" in delta:
+                    pr = _prows(param_fids)
+                    pcounts = pcounts.at[pr].set(
+                        jnp.asarray(delta["param_counts"])
+                    )
             self._param_state = self._param_state._replace(
                 starts=jnp.asarray(delta["param_starts"]), counts=pcounts,
+                slim=pslim, slim_auth=pauth,
             )
 
     # -- introspection (FetchClusterMetricCommandHandler analog) ------------
+    def sketch_stats(self) -> Dict[str, object]:
+        """Host snapshot of the param-sketch observability block: variant,
+        fat/slim HBM bytes, SALSA merge counters. Pulled by the process-wide
+        ``ServerMetrics`` on every scrape and by ``clusterServerStats``."""
+        from sentinel_tpu.engine.param import resolve_param_impl
+        from sentinel_tpu.sketch import sketch_stats as _sketch_stats
+
+        with self._lock:
+            stats = _sketch_stats(self.param_config, self._param_state)
+        stats["impl"] = resolve_param_impl(self.param_config.impl)
+        return stats
+
     def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:
         from sentinel_tpu.engine.state import ClusterEvent, flow_spec
         from sentinel_tpu.stats import window as W

@@ -33,18 +33,25 @@ _FIN1 = np.uint64(0xBF58476D1CE4E5B9)
 _FIN2 = np.uint64(0x94D049BB133111EB)
 
 
-def hash_indices(value_hashes: np.ndarray, depth: int, width: int) -> np.ndarray:
-    """``[N] int64 -> [N, depth] int32`` CMS cell indices (host, vectorized)."""
+def hash_indices(
+    value_hashes: np.ndarray, depth: int, width: int, salt: int = 0
+) -> np.ndarray:
+    """``[N] int64 -> [N, depth] int32`` CMS cell indices (host, vectorized).
+
+    One broadcast over a ``[depth]`` lane-constant vector — this runs on the
+    host for every param batch, so no per-depth Python loop. ``salt`` offsets
+    the lane constants so an auxiliary sketch (the SF slim twin) draws its
+    lanes from a disjoint part of the splitmix sequence; ``salt=0`` is
+    byte-identical to the original per-depth loop.
+    """
     h = value_hashes.astype(np.uint64)
-    out = np.empty((h.shape[0], depth), dtype=np.int32)
     with np.errstate(over="ignore"):
-        for d in range(depth):
-            x = h + np.uint64(d + 1) * _MIX
-            x = (x ^ (x >> np.uint64(30))) * _FIN1
-            x = (x ^ (x >> np.uint64(27))) * _FIN2
-            x = x ^ (x >> np.uint64(31))
-            out[:, d] = (x % np.uint64(width)).astype(np.int32)
-    return out
+        lane = np.arange(salt + 1, salt + depth + 1, dtype=np.uint64) * _MIX
+        x = h[:, None] + lane[None, :]
+        x = (x ^ (x >> np.uint64(30))) * _FIN1
+        x = (x ^ (x >> np.uint64(27))) * _FIN2
+        x = x ^ (x >> np.uint64(31))
+        return (x % np.uint64(width)).astype(np.int32)
 
 
 class ParamConfig(NamedTuple):
@@ -62,27 +69,58 @@ class ParamConfig(NamedTuple):
     # VERDICT r4 concern about a blind selector). SENTINEL_PARAM_IMPL=
     # jax|pallas overrides the probe for deployments that pin a choice.
     impl: str = "auto"
+    # "cms" = plain int32 count-min (the seed); "salsa" = self-adjusting
+    # int16 counters (sketch/salsa.py, arXiv:2102.12531): 2× the cells at
+    # the same HBM bytes, neighboring cells merging into double-width
+    # logical counters on saturation.
+    sketch: str = "cms"
+    # SF-sketch slim twin geometry (sketch/slim.py, arXiv:1701.04148):
+    # updates go to the fat sketch above, a [P, B, slim_depth, slim_width]
+    # int32 twin is maintained incrementally and is what replication deltas
+    # ship. slim_width=0 disables the twin (deltas ship fat rows).
+    slim_depth: int = 2
+    slim_width: int = 256
 
     @property
     def interval_ms(self) -> int:
         return self.bucket_ms * self.n_buckets
 
+    @property
+    def cell_width(self) -> int:
+        """Host hash width: SALSA packs 2× int16 cells into the int32
+        footprint, so its index space is ``2*width``."""
+        return self.width * (2 if self.sketch == "salsa" else 1)
+
+    @property
+    def slim_enabled(self) -> bool:
+        return self.slim_depth > 0 and self.slim_width > 0
+
 
 class ParamState(NamedTuple):
     starts: jax.Array  # [B] int32 engine-ms (shared ring, as stats.window)
-    counts: jax.Array  # [P, B, depth, width] int32
+    counts: jax.Array  # fat: [P, B, depth, width] int32 (cms)
+    #                        [P, B, depth, 2*width] int16 (salsa)
+    slim: jax.Array  # [P, B, slim_depth, slim_width] int32 SF slim twin
+    slim_auth: jax.Array  # [B] bool — buckets whose slim rows arrived via a
+    #     replication delta and must contribute to estimates (standby only;
+    #     cleared as buckets rotate, so a promoted standby converges to
+    #     fat-only serving within one window)
+    merges: jax.Array  # [P] int32 cumulative SALSA pair merges (metrics)
 
 
 NEVER = jnp.int32(-(2**30))
 
 
 def make_param_state(config: ParamConfig) -> ParamState:
+    P, B = config.max_param_rules, config.n_buckets
+    fat_dtype = jnp.int16 if config.sketch == "salsa" else jnp.int32
     return ParamState(
-        starts=jnp.full((config.n_buckets,), NEVER, jnp.int32),
-        counts=jnp.zeros(
-            (config.max_param_rules, config.n_buckets, config.depth, config.width),
-            jnp.int32,
-        ),
+        starts=jnp.full((B,), NEVER, jnp.int32),
+        counts=jnp.zeros((P, B, config.depth, config.cell_width), fat_dtype),
+        slim=jnp.zeros((P, B, config.slim_depth, config.slim_width),
+                       jnp.int32),
+        slim_auth=jnp.zeros((B,), bool),
+        merges=jnp.zeros((P,), jnp.int32),
     )
 
 
@@ -95,16 +133,49 @@ def param_decide(
     threshold: jax.Array,
     valid: jax.Array,
     now: jax.Array,
+    idx_slim: jax.Array = None,
 ) -> Tuple[ParamState, jax.Array, jax.Array]:
-    """Dispatch on ``config.impl`` — see :func:`_param_decide_jax`."""
+    """Dispatch on ``config.sketch`` × ``config.impl``.
+
+    The fat-sketch cores share one contract (see :func:`_param_decide_jax`);
+    the SF slim twin is composed *around* whichever core runs, in three
+    steps that keep every kernel slim-agnostic: (1) roll the slim ring and
+    compute the per-request slim estimate over delta-authoritative buckets,
+    (2) run the core with the threshold reduced by that estimate (identical
+    admissions to adding it to the fat estimate), (3) scatter-max the
+    post-update fat current-bucket estimate into the slim twin. Callers
+    that pass ``idx_slim=None`` (probes, micro-benchmarks) skip the twin
+    entirely — on a primary the twin is then simply not maintained.
+    """
     impl = resolve_param_impl(config.impl)
-    if impl == "pallas":
-        return _param_decide_pallas(
-            config, state, rule_slot, idx, acquire, threshold, valid, now
+    if config.sketch == "salsa":
+        from sentinel_tpu.sketch.salsa import (
+            salsa_decide_jax,
+            salsa_decide_pallas,
         )
-    return _param_decide_jax(
-        config, state, rule_slot, idx, acquire, threshold, valid, now
+
+        core = salsa_decide_pallas if impl == "pallas" else salsa_decide_jax
+    elif config.sketch == "cms":
+        core = _param_decide_pallas if impl == "pallas" else _param_decide_jax
+    else:
+        raise ValueError(
+            f"unknown param sketch {config.sketch!r}; use 'cms'|'salsa'"
+        )
+    if idx_slim is None or not config.slim_enabled:
+        return core(config, state, rule_slot, idx, acquire, threshold, valid,
+                    now)
+    from sentinel_tpu.sketch.slim import slim_poststep, slim_prestep
+
+    slim, slim_auth, est_slim = slim_prestep(
+        config, state, rule_slot, idx_slim, now
     )
+    state = state._replace(slim=slim, slim_auth=slim_auth)
+    thr = jnp.asarray(threshold, jnp.float32) - est_slim.astype(jnp.float32)
+    state2, admit, est_fat = core(
+        config, state, rule_slot, idx, acquire, thr, valid, now
+    )
+    slim2 = slim_poststep(config, state2, rule_slot, idx, idx_slim, valid, now)
+    return state2._replace(slim=slim2), admit, est_fat + est_slim
 
 
 _AUTO_IMPL: dict = {}  # backend platform → probed choice (process-cached)
@@ -211,7 +282,7 @@ def _param_decide_pallas(
         interpret=jax.default_backend() != "tpu",
     )
     counts = jnp.transpose(planes.reshape(B, D, P, W), (2, 0, 1, 3))
-    return ParamState(starts=starts, counts=counts), admit, est
+    return state._replace(starts=starts, counts=counts), admit, est
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -291,4 +362,4 @@ def _param_decide_jax(
         safe_slot[:, None], cur_idx, d_ar, idx
     ].add(upd_vals, mode="drop")
 
-    return ParamState(starts=starts, counts=counts), admit, estimate
+    return state._replace(starts=starts, counts=counts), admit, estimate

@@ -78,6 +78,7 @@ _ARRAY_KEYS = frozenset(
     {
         "flow_starts", "occupy_starts", "ns_starts", "param_starts",
         "flow_counts", "occupy_counts", "ns_counts", "param_counts",
+        "param_slim",  # SF slim-twin rows: the param payload when slim is on
     }
 )
 

@@ -116,7 +116,8 @@ _COUNTER_HELP = """\
 
 def render(now_ms: Optional[int] = None) -> str:
     """Prometheus text exposition: per-resource window gauges + cumulative
-    counters + the token server's ``sentinel_server_*`` section."""
+    counters + the token server's ``sentinel_server_*`` section (which
+    carries the ``sentinel_sketch_*`` param-sketch series)."""
     _ensure_counters_registered()
     now = _clock.now_ms() if now_ms is None else now_ms
     lines = [_HELP.rstrip("\n")]

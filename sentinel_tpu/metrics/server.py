@@ -136,6 +136,12 @@ class ServerMetrics:
         self._copy_lock = threading.Lock()
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._gauge_lock = threading.Lock()
+        # sketch observability: the live token service registers a zero-arg
+        # provider returning sketch.sketch_stats() (variant, fat/slim bytes,
+        # merge counters). Most recent registration wins — same model as a
+        # replacement server's gauges.
+        self._sketch_provider: Optional[Callable[[], dict]] = None
+        self._sketch_lock = threading.Lock()
 
     # -- fused dispatch counters --------------------------------------------
     def record_fused(self, depth: int) -> None:
@@ -292,6 +298,25 @@ class ServerMetrics:
                 out[name] = 0.0  # a dying server's reader must not 500 a scrape
         return out
 
+    # -- sketch provider ----------------------------------------------------
+    def register_sketch_provider(self, fn: Callable[[], dict]) -> None:
+        """Install the zero-arg reader for the param-sketch stats block
+        (``sentinel_tpu.sketch.sketch_stats`` shape). The most recently
+        constructed service wins; providers return ``{}`` once their
+        service is gone."""
+        with self._sketch_lock:
+            self._sketch_provider = fn
+
+    def sketch_stats(self) -> dict:
+        with self._sketch_lock:
+            fn = self._sketch_provider
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:
+            return {}  # a torn-down service's reader must not 500 a scrape
+
     # -- snapshots ----------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON shape served by the ``clusterServerStats`` command — the
@@ -311,6 +336,7 @@ class ServerMetrics:
             "intakeShards": {
                 str(k): v for k, v in sorted(self.shard_totals().items())
             },
+            "sketch": self.sketch_stats(),
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -432,6 +458,34 @@ class ServerMetrics:
                         f'sentinel_server_{mname}{{shard="{shard}"}} '
                         f"{vals[skey]:g}"
                     )
+        sketch = self.sketch_stats()
+        lines.append(
+            "# HELP sentinel_sketch_merges_total SALSA counter-pair merges "
+            "in the param sketch, by rule slot (cumulative)."
+        )
+        lines.append("# TYPE sentinel_sketch_merges_total counter")
+        by_slot = sketch.get("mergesBySlot") or {}
+        if by_slot:
+            for slot, count in sorted(
+                (int(s), int(c)) for s, c in by_slot.items()
+            ):
+                lines.append(
+                    f'sentinel_sketch_merges_total{{slot="{slot}"}} {count}'
+                )
+        else:
+            # zero-sample so the series exists before the first merge (or on
+            # the cms variant, which never merges)
+            lines.append('sentinel_sketch_merges_total{slot="0"} 0')
+        for mname, skey, help_text in (
+            ("sentinel_sketch_slim_bytes_total", "slimBytes",
+             "HBM bytes held by the SF slim twin of the param sketch "
+             "(what per-tick replication deltas ship)."),
+            ("sentinel_sketch_fat_bytes_total", "fatBytes",
+             "HBM bytes held by the fat (update) param sketch."),
+        ):
+            lines.append(f"# HELP {mname} {help_text}")
+            lines.append(f"# TYPE {mname} gauge")
+            lines.append(f"{mname} {int(sketch.get(skey, 0) or 0)}")
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -492,6 +546,8 @@ class ServerMetrics:
             self._shards.clear()
         with self._copy_lock:
             self._copy_bytes = 0
+        with self._sketch_lock:
+            self._sketch_provider = None
         self._rate.reset()
 
 

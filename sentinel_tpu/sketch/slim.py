@@ -1,0 +1,136 @@
+"""SF-sketch slim twin of the fat param sketch (arXiv:1701.04148).
+
+The fat sketch (plain CMS or SALSA, ``engine.param`` / ``sketch.salsa``)
+takes every update; this module maintains a much smaller *query* twin —
+``slim[P, B, slim_depth, slim_width]`` int32 — that replication deltas ship
+instead of the fat rows (``token_service.export_delta``). The twin is built
+incrementally: whenever a value is touched, the fat sketch's post-update
+current-bucket estimate for that value is scatter-**max**'d into the
+value's slim cells. Because a value's true count only grows when it is
+touched, and the fat estimate at touch time is already an upper bound, every
+slim cell holds ``max`` over its colliding values of an upper bound — the
+windowed slim estimate (min over slim lanes of the live-bucket sums) never
+undercounts. See docs/SKETCHES.md for the full argument.
+
+A standby applies slim rows from deltas and flags those buckets
+*slim-authoritative* (``ParamState.slim_auth``). Its decide path then serves
+``fat_estimate + slim_estimate(auth buckets)``: the fat part covers its own
+(bootstrap-snapshot) history, the slim part covers what the primary admitted
+since — double-counting the overlap of one snapshot-to-delta gap at most,
+which errs in the safe (over-estimate) direction and washes out as the
+flagged buckets rotate off the ring within one window.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Lane-constant offset for the twin's host-side hash derivation: slim lanes
+# come from a disjoint part of the splitmix sequence than any plausible fat
+# depth, so a fat-lane collision does not imply a slim-lane collision.
+SLIM_SALT = 64
+
+
+def slim_indices(config, value_hashes: np.ndarray) -> np.ndarray:
+    """``[N] int64 -> [N, slim_depth] int32`` twin cell indices (host)."""
+    from sentinel_tpu.engine.param import hash_indices
+
+    return hash_indices(
+        value_hashes, config.slim_depth, config.slim_width, salt=SLIM_SALT
+    )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def slim_prestep(
+    config, state, rule_slot, idx_slim, now
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Roll the slim ring for the current bucket and return the per-request
+    slim estimate over delta-authoritative live buckets.
+
+    ``-> (slim', slim_auth', est_slim[N] int32)``. On a primary every
+    ``slim_auth`` flag is False and ``est_slim`` is all zeros — the decide
+    outcome is bit-identical to a slim-less build.
+    """
+    now = jnp.asarray(now, jnp.int32)
+    B = config.n_buckets
+    cur_idx = (now // config.bucket_ms) % B
+    cur_start = now - now % config.bucket_ms
+    stale = state.starts[cur_idx] != cur_start
+    # mirror the fat roll: a stale current bucket is a NEW window bucket —
+    # zero its slim column and drop its authority flag
+    slim = jnp.where(
+        (jnp.arange(B)[None, :, None, None] == cur_idx) & stale,
+        0,
+        state.slim,
+    )
+    slim_auth = jnp.where(
+        (jnp.arange(B) == cur_idx) & stale, False, state.slim_auth
+    )
+
+    starts = state.starts.at[cur_idx].set(cur_start)
+    age = now - starts
+    bucket_ok = (age >= 0) & (age < config.interval_ms)  # [B]
+    use = bucket_ok & slim_auth  # [B]
+
+    safe_slot = jnp.where(rule_slot >= 0, rule_slot, 0)
+    ds_ar = jnp.arange(config.slim_depth)[None, :]  # [1, Ds]
+
+    def gather_sum(b):
+        per_d = slim[safe_slot[:, None], b, ds_ar, idx_slim]  # [N, Ds]
+        return per_d * use[b].astype(jnp.int32)
+
+    sums = sum(gather_sum(b) for b in range(B))  # [N, Ds]
+    est_slim = jnp.min(sums, axis=1)  # [N]
+    est_slim = jnp.where(rule_slot >= 0, est_slim, 0)
+    return slim, slim_auth, est_slim
+
+
+@partial(jax.jit, static_argnames=("config",))
+def slim_poststep(config, state, rule_slot, idx, idx_slim, valid, now):
+    """Scatter-max the fat sketch's post-update current-bucket estimate of
+    each touched value into the value's slim cells. ``state`` is the
+    post-core state (fat already updated, starts already rolled)."""
+    from sentinel_tpu.sketch import gather_current_estimate
+
+    now = jnp.asarray(now, jnp.int32)
+    cur_idx = (now // config.bucket_ms) % config.n_buckets
+    est_cur = gather_current_estimate(config, state.counts, rule_slot, idx,
+                                      cur_idx)  # [N] int32
+    live = valid & (rule_slot >= 0)
+    safe_slot = jnp.where(rule_slot >= 0, rule_slot, 0)
+    ds_ar = jnp.arange(config.slim_depth)[None, :]
+    vals = jnp.where(live, est_cur, 0)[:, None].repeat(config.slim_depth, 1)
+    return state.slim.at[
+        safe_slot[:, None], cur_idx, ds_ar, idx_slim
+    ].max(vals, mode="drop")
+
+
+def slim_estimate_np(config, state, value_hashes: np.ndarray,
+                     now: int) -> np.ndarray:
+    """Host-side windowed slim estimate (parity harness / drills): min over
+    slim lanes of the live-bucket sums, ignoring authority flags — this
+    queries the twin as a standalone sketch."""
+    idx = slim_indices(config, value_hashes)  # [N, Ds]
+    starts = np.asarray(state.starts)
+    slim = np.asarray(state.slim)  # [P, B, Ds, Ws]
+    age = int(now) - starts
+    live = (age >= 0) & (age < config.interval_ms)  # [B]
+    # windowed per-lane sums for slot 0 ... caller picks the slot
+    return idx, starts, slim, live
+
+
+def slim_query_np(config, state, slot: int, value_hashes: np.ndarray,
+                  now: int) -> np.ndarray:
+    """``[N] int64 -> [N] int64`` standalone slim estimates for one slot."""
+    idx, _starts, slim, live = slim_estimate_np(
+        config, state, value_hashes, now
+    )
+    row = slim[int(slot)]  # [B, Ds, Ws]
+    winsum = (row * live[:, None, None]).sum(axis=0)  # [Ds, Ws]
+    per_d = winsum[np.arange(config.slim_depth)[None, :], idx]  # [N, Ds]
+    return per_d.min(axis=1)

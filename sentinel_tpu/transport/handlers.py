@@ -423,13 +423,17 @@ def cmd_cluster_client_fetch_config(params, body):
 
 @command_mapping(
     "clusterServerStats",
-    "token-server pipeline stats: verdict counters, stage histograms, gauges",
+    "token-server pipeline stats: verdict counters, stage histograms, "
+    "gauges, param-sketch block",
 )
 def cmd_cluster_server_stats(params, body):
     """JSON twin of the ``sentinel_server_*`` Prometheus section — the
     dashboard/command-center view of the serving pipeline, plus the HA
     rebalance block (move protocol events, shipped state bytes, redirect
-    counts) so the dashboard sees live shard moves next to the pipeline."""
+    counts) so the dashboard sees live shard moves next to the pipeline.
+    The ``sketch`` block mirrors ``sentinel_sketch_*``: the param sketch's
+    variant, fat/slim HBM bytes, and SALSA merge counters per rule slot
+    (docs/SKETCHES.md)."""
     from sentinel_tpu.metrics.ha import ha_metrics
     from sentinel_tpu.metrics.server import server_metrics
 

@@ -170,6 +170,44 @@ def test_export_import_preserves_window_sums(svc):
     assert _sums(got)[21] >= 7.0
 
 
+def test_param_sketch_move_blob_roundtrip_bit_equal_verdict(manual_clock):
+    """MOVE with a SALSA + slim param plane: the exported namespace doc —
+    decoded fat window sums through the real blob codec — must fold into
+    the destination so its next param verdict is bit-equal to the
+    source's, merged pairs included (the fold re-encodes, keeping the
+    in-band merge marks and the one-sided guarantee)."""
+    from sentinel_tpu.engine.param import ParamConfig
+
+    pc = ParamConfig(
+        max_param_rules=8, depth=2, width=32, sketch="salsa", impl="jax"
+    )
+    src = DefaultTokenService(_CFG, param_config=pc)
+    dst = DefaultTokenService(_CFG, param_config=pc)
+    src.load_namespace_param_rules(
+        "pm", [ClusterParamFlowRule(flow_id=61, count=1e9, namespace="pm")]
+    )
+    rng = np.random.default_rng(0x5A15A)
+    vals = rng.integers(-2 ** 63, 2 ** 63 - 1, size=16, dtype=np.int64)
+    stream = vals[rng.integers(0, 16, size=400)]
+    for off in range(0, 400, 50):
+        src.request_params_token(
+            61, 1024, [int(h) for h in stream[off:off + 50]]
+        )
+    assert int(np.asarray(src._param_state.merges).sum()) > 0, (
+        "stream too cold to exercise the merge path"
+    )
+    doc = decode_move_state_blob(
+        encode_move_state_blob(src.export_namespace_state("pm"))
+    )
+    dst.import_namespace_state(doc)
+    for value in (int(stream[0]), int(vals[-1])):
+        r_src = src.request_params_token(61, 1, [value])
+        r_dst = dst.request_params_token(61, 1, [value])
+        assert (r_src.status, r_src.remaining) == (
+            r_dst.status, r_dst.remaining
+        )
+
+
 def test_move_target_stages_without_mutating(svc):
     """MOVE_STATE only stages; an abort (or session death) discards the
     claim and the service never sees the document."""

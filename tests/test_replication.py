@@ -161,6 +161,67 @@ class TestDeltaApply:
         s = standby.metrics_snapshot()
         assert p[1]["pass_qps"] == s[1]["pass_qps"] > 0
 
+    def _param_service(self, slim_width=256):
+        from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
+        from sentinel_tpu.engine.param import ParamConfig
+
+        svc = DefaultTokenService(
+            CFG,
+            param_config=ParamConfig(
+                max_param_rules=8, impl="jax", slim_width=slim_width
+            ),
+        )
+        svc.load_param_rules([ClusterParamFlowRule(flow_id=9, count=5.0)])
+        return svc
+
+    def test_param_slim_delta_carries_enforcement(self, manual_clock):
+        """Deltas ship the SF slim twin, not fat rows — and the slim rows
+        alone must carry enforcement: a value the primary exhausted AFTER
+        the bootstrap snapshot must be blocked on the promoted standby."""
+        from sentinel_tpu.engine.param import ParamConfig  # noqa: F401
+
+        primary = self._param_service()
+        standby = self._param_service()
+        primary.replication_enable()
+        standby.import_state(
+            R.decode_snapshot_blob(
+                R.encode_snapshot_blob(primary.export_state())
+            )
+        )
+        hot = 0x7E57_C0DE
+        blocked = False
+        for _ in range(30):
+            if primary.request_params_token(9, 1, [hot]).status \
+                    == TokenStatus.BLOCKED:
+                blocked = True
+        assert blocked, "primary never exhausted the param threshold"
+        delta = R.decode_delta_blob(R.encode_delta_blob(primary.export_delta()))
+        assert "param_slim" in delta and "param_counts" not in delta
+        standby.apply_replication_delta(delta)
+        # fat counters on the standby are still snapshot-stale (all zero);
+        # the slim rows shipped in the delta must block on their own
+        r = standby.request_params_token(9, 1, [hot])
+        assert r.status == TokenStatus.BLOCKED
+
+    def test_param_slim_delta_bytes_4x_under_fat(self, manual_clock):
+        """Identical traffic, identical dirty slots: the slim-twin delta
+        blob must come in ≥4× under the fat-row delta blob (the per-tick
+        replication cost the SF split exists to cut)."""
+        import numpy as np
+
+        rng = np.random.default_rng(SEED)
+        vals = rng.integers(-2 ** 63, 2 ** 63 - 1, size=1500, dtype=np.int64)
+        sizes = {}
+        for label, slim_width in (("slim", 256), ("fat", 0)):
+            svc = self._param_service(slim_width=slim_width)
+            svc.replication_enable()
+            for off in range(0, len(vals), 60):
+                svc.request_params_token(
+                    9, 1, [int(h) for h in vals[off:off + 60]]
+                )
+            sizes[label] = len(R.encode_delta_blob(svc.export_delta()))
+        assert sizes["fat"] >= 4 * sizes["slim"], sizes
+
     @pytest.mark.parametrize("standby_devices", [1, 4])
     def test_mesh_primary_delta_converges(self, standby_devices):
         """PR-7 sharded replication: a mesh-backed primary's export_delta

@@ -323,6 +323,59 @@ class TestShardedSnapshotRoundTrip:
         svc.close()
         standby.close()
 
+    @pytest.mark.parametrize("standby_devices", [1, 4])
+    def test_param_sketch_state_survives_snapshot(
+        self, mesh, standby_devices, manual_clock
+    ):
+        """The param sketch — SALSA merge state (in-band int16 encoding)
+        AND the SF slim twin + its authority flags — must land bit-for-bit
+        on a standby with a different mesh shape, and the standby's next
+        param verdict must be bit-equal to the primary's."""
+        from sentinel_tpu.cluster.token_service import (
+            ClusterParamFlowRule,
+            DefaultTokenService,
+        )
+        from sentinel_tpu.engine.param import ParamConfig
+
+        pc = ParamConfig(
+            max_param_rules=8, depth=2, width=32, sketch="salsa", impl="jax"
+        )
+        svc = DefaultTokenService(CFG, mesh=mesh, param_config=pc)
+        # wide-open threshold: admissions must flow or nothing saturates
+        svc.load_param_rules([ClusterParamFlowRule(flow_id=3, count=1e9)])
+        rng = np.random.default_rng(3)
+        vals = rng.integers(-2 ** 63, 2 ** 63 - 1, size=16, dtype=np.int64)
+        stream = vals[rng.integers(0, 16, size=600)]
+        for off in range(0, 600, 50):
+            svc.request_params_token(
+                3, 1024, [int(h) for h in stream[off:off + 50]]
+            )
+        assert int(np.asarray(svc._param_state.merges).sum()) > 0, (
+            "stream too cold to exercise the merge path"
+        )
+        snap = svc.export_state()
+        standby_mesh = (
+            None if standby_devices == 1
+            else make_flow_mesh(jax.devices()[:standby_devices])
+        )
+        standby = DefaultTokenService(
+            CFG, mesh=standby_mesh, param_config=pc
+        )
+        standby.import_state(snap)
+        for field in ("starts", "counts", "slim", "slim_auth", "merges"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(standby._param_state, field)),
+                np.asarray(getattr(svc._param_state, field)),
+                err_msg=field,
+            )
+        hot, cold = int(stream[0]), int(vals[-1])
+        for value in (hot, cold):
+            r_p = svc.request_params_token(3, 1, [value])
+            r_s = standby.request_params_token(3, 1, [value])
+            assert (r_p.status, r_p.remaining) == (r_s.status, r_s.remaining)
+        svc.close()
+        standby.close()
+
 
 class TestMeshBackedService:
     """DefaultTokenService(mesh=...) — a pod's chips serving together

@@ -107,7 +107,7 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                  serve_buckets=(4096, 16384), native: bool = True,
                  port: int = 0, n_dispatchers: int = 2,
                  fuse_depth: int = 4, intake_shards: int = 1,
-                 mesh_devices: int = 0):
+                 mesh_devices: int = 0, shm_dir=None):
     """Service (100k rules — the headline's problem size) + front door.
 
     ``mesh_devices > 0`` backs the service with a flow-sharded mesh over
@@ -166,6 +166,7 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                     service, host="127.0.0.1", port=port,
                     max_batch=max_batch, n_dispatchers=n_dispatchers,
                     fuse_depth=fuse_depth, intake_shards=intake_shards,
+                    shm_dir=shm_dir,
                 )
                 front_door = "native-epoll"
         except Exception:
@@ -179,13 +180,15 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
 
 def run_closed(port: int, clients: int = 4, batch: int = 2048,
                pipeline: int = 2, seconds: float = 6.0,
-               n_flows: int = 100_000) -> dict:
+               n_flows: int = 100_000, shm_dir=None) -> dict:
+    transport = ("--transport", "shm", "--shm-dir", shm_dir) \
+        if shm_dir else ()
     t0 = time.perf_counter()
     docs = _spawn_clients(
         [
             ("--port", port, "--mode", "closed", "--batch", batch,
              "--pipeline", pipeline, "--seconds", seconds,
-             "--flows", n_flows, "--seed", k)
+             "--flows", n_flows, "--seed", k, *transport)
             for k in range(clients)
         ],
         timeout_s=seconds * 4 + 120,
@@ -408,7 +411,11 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                     (stages.get(nm) or {}).get("sum") or 0.0
                     for nm in names
                 )
-                return round(min(total / (wall_ms * lanes), 1.0), 4)
+                # clamp to [0, 1]: the door/stage counters are relaxed
+                # atomics read without a consistent snapshot (see
+                # Frontdoor.stats()), so a diff racing a live lane can
+                # land a hair outside the window
+                return round(min(max(total / (wall_ms * lanes), 0.0), 1.0), 4)
 
             c["fusion"] = {
                 "fused_frames_total": stage_metrics.fused_frames_total,
@@ -431,9 +438,9 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                     intake_shards if front_door == "native-epoll" else None
                 ),
                 "shard_occupancy": {
-                    k: round(min(
-                        (v.get("busy_ms") or 0.0) / wall_ms, 1.0
-                    ), 4)
+                    k: round(min(max(
+                        (v.get("busy_ms") or 0.0) / wall_ms, 0.0
+                    ), 1.0), 4)
                     for k, v in sorted(shard_snap.items())
                 },
                 "shard_pulls": {
@@ -590,6 +597,268 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
     }
 
 
+def _cpu_self_s() -> float:
+    """This process's consumed CPU seconds (user+sys, all threads) — the
+    in-process server/door side of the host-cost ledger."""
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return ru.ru_utime + ru.ru_stime
+
+
+def _us_pcts(us: np.ndarray) -> dict:
+    return {
+        "p50_us": round(float(np.percentile(us, 50)), 2),
+        "p90_us": round(float(np.percentile(us, 90)), 2),
+        "p99_us": round(float(np.percentile(us, 99)), 2),
+        "max_us": round(float(us.max()), 2),
+    } if us.size else {}
+
+
+def shm_echo_rtt(batch: int = 1, iters: int = 20_000) -> dict:
+    """Raw ring transport round trip: C echo loop behind the door, C
+    send+spin-recv loop in the client, both in THIS process — no Python,
+    no codecs, no device inside the timed region. The per-iteration RTTs
+    are the co-located door's latency claim; the CPU delta over the run is
+    the shm transport's host-cost floor (both sides included)."""
+    import shutil
+    import tempfile
+
+    from sentinel_tpu.cluster import protocol as P
+    from sentinel_tpu.native.lib import ShmDoor, ShmRingClient
+
+    d = tempfile.mkdtemp(prefix="sentinel-shm-rtt-")
+    door = ShmDoor(d)
+    door.echo_start()
+    ids = (np.arange(batch, dtype=np.int64) % 1024)
+    frame = P.encode_batch_request(1, ids)
+    ring = ShmRingClient(d, n_slots=16)
+    try:
+        ring.rtt_probe(frame, iters=min(2000, iters))  # warmup
+        s0 = door.stats()
+        cpu0, t0 = _cpu_self_s(), time.perf_counter()
+        ns = ring.rtt_probe(frame, iters=iters)
+        wall = time.perf_counter() - t0
+        cpu = _cpu_self_s() - cpu0
+        s1 = door.stats()
+    finally:
+        ring.close()
+        door.echo_stop()
+        door.stop()
+        shutil.rmtree(d, ignore_errors=True)
+    us = np.asarray(ns, np.float64) / 1e3
+    frames = max(int(us.size), 1)
+    # doorbell amortization evidence: futex rings per frame on the server
+    # side (counter deltas clamp at zero — relaxed atomics, see stats())
+    doorbells = max(s1["shm_doorbells"] - s0["shm_doorbells"], 0)
+    return {
+        "rows_per_frame": batch,
+        "iters": int(us.size),
+        "rtt": _us_pcts(us),
+        "cpu_us_per_frame": round(cpu / frames * 1e6, 3),
+        "cpu_us_per_verdict": round(cpu / (frames * batch) * 1e6, 4),
+        "server_doorbells_per_frame": round(doorbells / frames, 4),
+        "wall_s": round(wall, 3),
+    }
+
+
+def door_echo_cost(kind: str, batch: int, frames_per_sec: float,
+                   seconds: float = 4.0, window: int = 128) -> dict:
+    """Per-verdict host cost of ONE front door behind its pure-C echo loop
+    (``sn_fd_echo_start`` / ``sn_shm_echo_start`` — the identical wait→
+    all-GRANTED-submit loop, compiled) — no token service, no device step,
+    no Python on the serving side. What differs between a tcp and an shm
+    run is exactly the transport: epoll + recv/send syscalls + kernel
+    copies + client socket framing (tcp) vs. ring memcpys and an
+    occasionally-rung futex doorbell (shm); the wire decode/encode is the
+    same C codec in both doors, and the client is the same
+    ``serve_client.py`` open-loop driver.
+
+    ``frames_per_sec`` picks the regime: offer beyond the door's capacity
+    and the in-flight window cap turns the run into a closed loop
+    ``window`` deep (saturation — doorbells amortize over slot bursts);
+    offer a trickle and every frame travels alone (paced — each one pays
+    the full wake/sleep round). ``server_cpu`` is this process's rusage
+    delta (the door side); the client reports its own CPU."""
+    import shutil
+    import tempfile
+
+    from sentinel_tpu.native.lib import Frontdoor, ShmDoor
+
+    d = None
+    if kind == "shm":
+        d = tempfile.mkdtemp(prefix="sentinel-shm-cost-")
+        door = ShmDoor(d)
+        port = 0
+    else:
+        door = Frontdoor("127.0.0.1", 0)
+        port = door.port
+    door.echo_start()
+    transport = ("--transport", "shm", "--shm-dir", d) if d else ()
+    try:
+        cpu0 = _cpu_self_s()
+        docs = _spawn_clients(
+            [
+                ("--port", port, "--mode", "open", "--batch", batch,
+                 "--rate", frames_per_sec * batch, "--seconds", seconds,
+                 "--flows", 1024, "--window", window, "--seed", 0,
+                 *transport)
+            ],
+            timeout_s=seconds * 4 + 120,
+        )
+        server_cpu = _cpu_self_s() - cpu0
+        stats = door.stats()
+    finally:
+        door.echo_stop()
+        door.stop()
+        if d:
+            shutil.rmtree(d, ignore_errors=True)
+    frames = sum(doc["frames_sent"] for doc in docs)
+    verdicts = sum(doc["verdicts_ok"] for doc in docs)
+    client_cpu = sum(doc.get("cpu_s") or 0.0 for doc in docs)
+    out = {
+        "transport": kind,
+        "rows_per_frame": batch,
+        "offered_frames_per_sec": round(frames_per_sec),
+        "frames": frames,
+        "achieved_frames_per_sec": round(frames / max(seconds, 1e-9)),
+        "verdicts": verdicts,
+        "frames_dropped": sum(doc["frames_dropped"] for doc in docs),
+        "frames_lost": sum(doc["frames_lost"] for doc in docs),
+        "server_cpu_s": round(server_cpu, 4),
+        "client_cpu_s": round(client_cpu, 4),
+        "server_cpu_us_per_frame": round(
+            server_cpu / max(frames, 1) * 1e6, 4
+        ),
+        "server_cpu_us_per_verdict": round(
+            server_cpu / max(verdicts, 1) * 1e6, 4
+        ),
+        "total_host_cpu_us_per_verdict": round(
+            (server_cpu + client_cpu) / max(verdicts, 1) * 1e6, 4
+        ),
+    }
+    if kind == "shm":
+        # syscall-amortization evidence: futexes actually rung per frame
+        out["doorbells_per_frame"] = round(
+            stats["shm_doorbells"] / max(stats["frames_in"], 1), 4
+        )
+        out["polls_per_frame"] = round(
+            stats["shm_polls"] / max(stats["frames_in"], 1), 4
+        )
+    return out
+
+
+def intake_matrix(shards=(1, 2, 4), seconds: float = 3.0,
+                  n_flows: int = 10_000) -> list:
+    """Closed-loop served rate for every intake-shard count × transport
+    cell, each against a fresh full server (same process, so kernel
+    compiles are warm after the first cell). On hosts with fewer cores
+    than shards the cells share one core — the artifact records
+    ``host_cores`` so a flat column reads as the core ceiling it is, not
+    as a sharding defect."""
+    import shutil
+    import tempfile
+
+    cells = []
+    for s in shards:
+        for transport in ("tcp", "shm"):
+            d = tempfile.mkdtemp(prefix="sentinel-shm-mx-") \
+                if transport == "shm" else None
+            service, server, front_door = build_server(
+                n_flows=n_flows, max_batch=4096, serve_buckets=(1024, 4096),
+                native=True, n_dispatchers=2, fuse_depth=4,
+                intake_shards=s, shm_dir=d,
+            )
+            try:
+                c = run_closed(
+                    server.port, clients=2, batch=4096, pipeline=4,
+                    seconds=seconds, n_flows=n_flows, shm_dir=d,
+                )
+            finally:
+                server.stop()
+                service.close()
+                if d:
+                    shutil.rmtree(d, ignore_errors=True)
+            cells.append({
+                "intake_shards": s,
+                "transport": transport,
+                "front_door": front_door,
+                "verdicts_per_sec": c["verdicts_per_sec"],
+                "p50_ms": c["p50_ms"],
+                "p99_ms": c["p99_ms"],
+                "errors": c["errors"],
+            })
+    return cells
+
+
+def shm_measure(seconds: float = 6.0, sidecar_batch: int = 16,
+                bulk_batch: int = 4096, matrix_shards=(1, 2, 4)) -> dict:
+    """The co-located-door artifact: ring RTT distribution, per-verdict
+    host cost vs a SAME-RUN TCP control, and the intake-shard matrix.
+
+    Host cost compares the two doors behind the identical pure-C echo loop
+    in two regimes per frame shape: **saturated** (offered load far past
+    the door, so the client's in-flight window turns the run into a deep
+    closed loop — the doorbell futex amortizes over slot bursts and the
+    shm door approaches its zero-syscall steady state) and **paced** (a
+    trickle, every frame travels alone and pays the full wake/sleep
+    round). The headline ``door_cost_ratio`` is the server-side CPU per
+    verdict, tcp/shm, at saturation: the door is what this PR replaced,
+    the client-side codec work is the same protocol.py code over either
+    transport by construction, and saturation is where a co-located
+    sidecar fleet actually operates when it matters."""
+    rtt_1 = shm_echo_rtt(batch=1)
+    rtt_sidecar = shm_echo_rtt(batch=sidecar_batch)
+    # offered frames/s per (shape, regime): saturated offers well past the
+    # measured 1-core echo ceiling (~30-60k f/s small frames, ~5-15k bulk);
+    # paced sits far below it
+    offers = {
+        "sidecar": {"saturated": 150_000, "paced": 4_000},
+        "bulk": {"saturated": 25_000, "paced": 800},
+    }
+    cost = {}
+    for b_name, b in (("sidecar", sidecar_batch), ("bulk", bulk_batch)):
+        block = {"rows_per_frame": b}
+        for regime, fps in offers[b_name].items():
+            window = 128 if regime == "saturated" else 8
+            tcp = door_echo_cost("tcp", batch=b, frames_per_sec=fps,
+                                 seconds=seconds, window=window)
+            shm = door_echo_cost("shm", batch=b, frames_per_sec=fps,
+                                 seconds=seconds, window=window)
+            a, bb = (tcp["server_cpu_us_per_verdict"],
+                     shm["server_cpu_us_per_verdict"])
+            at, bt = (tcp["total_host_cpu_us_per_verdict"],
+                      shm["total_host_cpu_us_per_verdict"])
+            block[regime] = {
+                "tcp": tcp,
+                "shm": shm,
+                "door_cost_ratio": round(a / bb, 2) if bb else None,
+                "total_host_cpu_ratio": round(at / bt, 2) if bt else None,
+            }
+        cost[b_name] = block
+    matrix = intake_matrix(shards=matrix_shards)
+
+    def _vps(s, tr):
+        return next(
+            (c["verdicts_per_sec"] for c in matrix
+             if c["intake_shards"] == s and c["transport"] == tr), None,
+        )
+
+    scaling = {
+        tr: round(_vps(max(matrix_shards), tr) / _vps(1, tr), 3)
+        for tr in ("tcp", "shm")
+        if _vps(1, tr) and _vps(max(matrix_shards), tr)
+    }
+    return {
+        "ring_rtt_1row": rtt_1,
+        "ring_rtt_sidecar": rtt_sidecar,
+        "host_cost": cost,
+        "intake_matrix": matrix,
+        "intake_scaling_at_max_shards": scaling,
+        "host_cores": os.cpu_count(),
+    }
+
+
 def main() -> None:
     import argparse
 
@@ -612,7 +881,33 @@ def main() -> None:
     ap.add_argument("--clients", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--pipeline", type=int, default=None)
+    ap.add_argument("--shm", action="store_true",
+                    help="measure the co-located shared-memory ring door: "
+                         "ring RTT distribution, per-verdict host cost vs "
+                         "a same-run TCP control, and the intake-shard × "
+                         "transport matrix. Writes shm-door-<ts>.json")
     args = ap.parse_args()
+    if args.shm:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from sentinel_tpu.native.lib import shm_available
+
+        if not shm_available():
+            print("shm door not built; nothing to measure", file=sys.stderr)
+            sys.exit(2)
+        doc = shm_measure()
+        line = json.dumps(doc, indent=2)
+        print(line)
+        d = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "results"
+        )
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(
+                d, f"shm-door-{time.strftime('%Y%m%d-%H%M%S')}.json"),
+                "w") as f:
+            f.write(line + "\n")
+        return
     closed_kw = {
         k: v for k, v in (
             ("clients", args.clients), ("batch", args.batch),

@@ -54,6 +54,62 @@ def _connect(port: int) -> socket.socket:
     return sock
 
 
+class _TcpChan:
+    """One pipelined channel over TCP loopback (a socket + frame splitter)."""
+
+    def __init__(self, port: int):
+        self.sock = _connect(port)
+        self.frames = P.FrameReader()
+
+    def send(self, frame: bytes) -> None:
+        self.sock.sendall(frame)
+
+    def recv(self):
+        return _recv_frames(self.sock, self.frames)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _ShmChan:
+    """One pipelined channel over the shared-memory ring door: its own
+    SPSC segment (one producer = this thread), so pipeline threads never
+    contend on a ring. Ring depth covers the open-loop in-flight window."""
+
+    def __init__(self, shm_dir: str, n_slots: int = 64):
+        from sentinel_tpu.native.lib import ShmRingClient
+
+        self.ring = ShmRingClient(shm_dir, n_slots=n_slots)
+
+    def send(self, frame: bytes) -> None:
+        if not self.ring.send_frame(frame, timeout_ms=10_000):
+            raise ConnectionError("shm request ring full past timeout")
+
+    def recv(self):
+        out = []
+        while not out:
+            payload = self.ring.recv_payload(timeout_ms=10_000)
+            if payload is None:
+                raise ConnectionError("shm recv timeout")
+            if P.peek_type(payload) != P.MsgType.BATCH_FLOW:
+                continue
+            xid, status, _rem, _wait = P.decode_batch_response(payload)
+            out.append((xid, int((status == 0).sum()), len(status)))
+        return out
+
+    def close(self) -> None:
+        self.ring.close()
+
+
+def _make_chan(transport: str, port: int, shm_dir):
+    if transport == "shm":
+        return _ShmChan(shm_dir)
+    return _TcpChan(port)
+
+
 def _recv_frames(sock: socket.socket, frames: P.FrameReader, want_xid=None):
     """Block until at least one BATCH_FLOW response arrives; return list of
     (xid, n_ok, n) per decoded frame."""
@@ -71,7 +127,8 @@ def _recv_frames(sock: socket.socket, frames: P.FrameReader, want_xid=None):
 
 
 def run_closed(port: int, batch: int, pipeline: int, seconds: float,
-               n_flows: int, seed: int) -> dict:
+               n_flows: int, seed: int, transport: str = "tcp",
+               shm_dir=None) -> dict:
     rng = np.random.default_rng(seed)
     totals = []
     rtts: list = []
@@ -82,15 +139,14 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
         n_ok = n_err = 0
         local_rtt = []
         try:
-            sock = _connect(port)
-            frames = P.FrameReader()
+            chan = _make_chan(transport, port, shm_dir)
             # per-thread generator: np.random.Generator is not thread-safe
             t_rng = np.random.default_rng([seed, t])
             flow_ids = t_rng.integers(0, n_flows, size=batch)
             xid = t * 1_000_000 + 1
             # warmup round trip (connection + compiled-shape route)
-            sock.sendall(P.encode_batch_request(xid, flow_ids))
-            _recv_frames(sock, frames)
+            chan.send(P.encode_batch_request(xid, flow_ids))
+            chan.recv()
         except (ConnectionError, socket.timeout, OSError):
             # a failed warmup must be VISIBLE as an error, never a silent
             # zero-verdict thread (the artifact shape this file once
@@ -110,8 +166,8 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
             xid += 1
             t0 = time.perf_counter()
             try:
-                sock.sendall(P.encode_batch_request(xid, flow_ids))
-                _recv_frames(sock, frames)
+                chan.send(P.encode_batch_request(xid, flow_ids))
+                chan.recv()
             except (ConnectionError, socket.timeout, OSError):
                 n_err += batch
                 break
@@ -119,7 +175,7 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
             n_ok += batch
         t_meas1 = time.perf_counter()
         try:
-            sock.close()
+            chan.close()
         except OSError:
             pass
         with lock:
@@ -130,10 +186,12 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
     threads = [
         threading.Thread(target=pump, args=(t,)) for t in range(pipeline)
     ]
+    cpu0 = time.process_time()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    cpu_s = time.process_time() - cpu0
     # denominator = full span from the first thread's measurement start to
     # the last thread's end: warmup time is excluded, and staggered windows
     # can only UNDERstate the concurrent rate, never inflate it (summing
@@ -154,8 +212,20 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
         # usable nonzero denominator, not round a guard down to 0.0
         "wall_s": max(round(wall, 3), 0.001),
         "start_skew_s": round(start_skew, 3),
+        # this process's CPU over the pump phase (one warmup frame per
+        # thread included — noise next to the measured frames). The door
+        # host-cost comparison sums this with the server-side rusage.
+        "cpu_s": round(cpu_s, 4),
         "rtt_ms": [round(float(x), 4) for x in np.sort(rtt_ms)],
     }
+
+
+def _pow2_at_least(n: int) -> int:
+    """Smallest power of two >= max(n, 16) (ring slot-count constraint)."""
+    p = 16
+    while p < n:
+        p *= 2
+    return p
 
 
 def open_loop_schedule(batch: int, rate: float, seconds: float):
@@ -168,11 +238,15 @@ def open_loop_schedule(batch: int, rate: float, seconds: float):
 
 
 def run_open(port: int, batch: int, rate: float, seconds: float,
-             n_flows: int, seed: int, window: int) -> dict:
+             n_flows: int, seed: int, window: int, transport: str = "tcp",
+             shm_dir=None) -> dict:
     """Open-loop: offered load is ``rate`` verdicts/s as batch frames."""
     rng = np.random.default_rng(seed)
-    sock = _connect(port)
-    frames = P.FrameReader()
+    shm = transport == "shm"
+    if shm:
+        chan = _ShmChan(shm_dir, n_slots=_pow2_at_least(window))
+    else:
+        chan = _TcpChan(port)
     flow_ids = rng.integers(0, n_flows, size=batch)
     dt, n_frames = open_loop_schedule(batch, rate, seconds)
     sent_at: dict = {}
@@ -181,7 +255,18 @@ def run_open(port: int, batch: int, rate: float, seconds: float,
     ok = [0]
     done = threading.Event()
 
+    def _account(payload, t_now: float) -> None:
+        if P.peek_type(payload) != P.MsgType.BATCH_FLOW:
+            return
+        xid, status, _r, _w = P.decode_batch_response(payload)
+        with lock:
+            t0 = sent_at.pop(xid, None)
+        if t0 is not None:
+            rtts.append(t_now - t0)
+            ok[0] += int((status == 0).sum())
+
     def reader() -> None:
+        sock, frames = chan.sock, chan.frames
         try:
             while True:
                 data = sock.recv(65536)
@@ -189,24 +274,40 @@ def run_open(port: int, batch: int, rate: float, seconds: float,
                     return
                 t_now = time.perf_counter()
                 for payload in frames.feed(data):
-                    if P.peek_type(payload) != P.MsgType.BATCH_FLOW:
-                        continue
-                    xid, status, _r, _w = P.decode_batch_response(payload)
-                    with lock:
-                        t0 = sent_at.pop(xid, None)
-                    if t0 is not None:
-                        rtts.append(t_now - t0)
-                        ok[0] += int((status == 0).sum())
-                    with lock:
-                        if done.is_set() and not sent_at:
-                            return
+                    _account(payload, t_now)
+                with lock:
+                    if done.is_set() and not sent_at:
+                        return
         except (ConnectionError, OSError):
             return
 
-    rt = threading.Thread(target=reader, daemon=True)
+    stop_reader = threading.Event()
+
+    def reader_shm() -> None:
+        # the ring recv has a real timeout, so the shutdown poll replaces
+        # the TCP reader's close-on-EOF exit path. stop_reader is the hard
+        # exit: the main thread must NOT close the ring (which frees the
+        # native client) until this thread has left recv_payload, so it
+        # joins us first and the flag bounds how long that takes.
+        try:
+            while not stop_reader.is_set():
+                payload = chan.ring.recv_payload(timeout_ms=100)
+                if payload is None:
+                    with lock:
+                        if done.is_set() and not sent_at:
+                            return
+                    continue
+                _account(payload, time.perf_counter())
+        except (ConnectionError, OSError):
+            return
+
+    cpu0 = time.process_time()
+    rt = threading.Thread(
+        target=reader_shm if shm else reader, daemon=True
+    )
     # warmup frame (compiled-shape route); its response carries an unknown
     # xid, so the reader absorbs and ignores it — not timed
-    sock.sendall(P.encode_batch_request(999_999_999, flow_ids))
+    chan.send(P.encode_batch_request(999_999_999, flow_ids))
     rt.start()
     dropped = 0
     sent = 0
@@ -225,8 +326,10 @@ def run_open(port: int, batch: int, rate: float, seconds: float,
         with lock:
             sent_at[xid] = time.perf_counter()
         try:
-            sock.sendall(P.encode_batch_request(xid, flow_ids))
+            chan.send(P.encode_batch_request(xid, flow_ids))
         except (ConnectionError, OSError):
+            with lock:
+                sent_at.pop(xid, None)
             break
         sent += 1
     send_wall = time.perf_counter() - t0
@@ -240,11 +343,24 @@ def run_open(port: int, batch: int, rate: float, seconds: float,
         time.sleep(0.01)
     with lock:
         lost = len(sent_at)
-    try:
-        sock.close()
-    except OSError:
-        pass
-    rt.join(timeout=2.0)
+    stop_reader.set()
+    if shm:
+        # reader first, close second: closing the ring frees the native
+        # client, and a reader still parked inside recv_payload would wake
+        # into freed memory. The 100ms recv timeout bounds the join.
+        rt.join(timeout=5.0)
+        try:
+            chan.close()
+        except OSError:
+            pass
+    else:
+        # TCP is the opposite order: the reader blocks in sock.recv with
+        # no timeout, so closing the socket is what unblocks it
+        try:
+            chan.close()
+        except OSError:
+            pass
+        rt.join(timeout=2.0)
     rtt_ms = np.sort(np.asarray(rtts) * 1e3) if rtts else np.empty(0)
     if rtt_ms.size > MAX_RTT_SAMPLES:
         rtt_ms = np.sort(rng.choice(rtt_ms, MAX_RTT_SAMPLES, replace=False))
@@ -254,6 +370,7 @@ def run_open(port: int, batch: int, rate: float, seconds: float,
         "frames_dropped": dropped,
         "frames_lost": lost,
         "verdicts_ok": int(ok[0]),
+        "cpu_s": round(time.process_time() - cpu0, 4),
         "send_wall_s": round(send_wall, 3),
         "achieved_send_rate": round(sent * batch / max(send_wall, 1e-9)),
         "rtt_ms": [round(float(x), 4) for x in rtt_ms],
@@ -264,6 +381,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--transport", choices=("tcp", "shm"), default="tcp")
+    ap.add_argument("--shm-dir", default=None,
+                    help="shared-memory ring directory (transport=shm)")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--pipeline", type=int, default=2)
     ap.add_argument("--seconds", type=float, default=5.0)
@@ -272,12 +392,16 @@ def main() -> None:
     ap.add_argument("--window", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    if args.transport == "shm" and not args.shm_dir:
+        ap.error("--transport shm requires --shm-dir")
     if args.mode == "closed":
         out = run_closed(args.port, args.batch, args.pipeline, args.seconds,
-                         args.flows, args.seed)
+                         args.flows, args.seed, transport=args.transport,
+                         shm_dir=args.shm_dir)
     else:
         out = run_open(args.port, args.batch, args.rate, args.seconds,
-                       args.flows, args.seed, args.window)
+                       args.flows, args.seed, args.window,
+                       transport=args.transport, shm_dir=args.shm_dir)
     print(json.dumps(out), flush=True)
 
 

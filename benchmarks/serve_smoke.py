@@ -92,8 +92,54 @@ def _xid_probe(port: int, n_flows: int, frames: int = 24,
     }
 
 
+def _xid_probe_shm(shm_dir: str, n_flows: int, frames: int = 24,
+                   batch: int = 1024) -> dict:
+    """The pipelined xid-exactness gate over the shm ring door: publish
+    ``frames`` distinct-xid requests without draining, then drain — every
+    xid exactly once with its row count (same contract as the TCP probe)."""
+    import numpy as np
+
+    from sentinel_tpu.cluster import protocol as P
+    from sentinel_tpu.native.lib import ShmRingClient
+
+    rng = np.random.default_rng(7)
+    # ring deep enough to hold the whole pipelined burst of requests
+    ring = ShmRingClient(shm_dir, n_slots=64)
+    sent = {}
+    got = {}
+    try:
+        for k in range(frames):
+            xid = 0x5EED0000 + k
+            ids = rng.integers(0, n_flows, size=batch).astype(np.int64)
+            sent[xid] = batch
+            if not ring.send_frame(P.encode_batch_request(xid, ids),
+                                   timeout_ms=10_000):
+                break
+        while len(got) < frames:
+            payload = ring.recv_payload(timeout_ms=10_000)
+            if payload is None:
+                break
+            if P.peek_type(payload) != P.MsgType.BATCH_FLOW:
+                continue
+            xid, status, _rem, _wait = P.decode_batch_response(payload)
+            got[xid] = got.get(xid, 0) + len(status)
+    finally:
+        ring.close()
+    mismatches = sorted(
+        x for x in set(sent) | set(got) if sent.get(x) != got.get(x)
+    )
+    return {
+        "frames_sent": frames,
+        "frames_answered": len(got),
+        "xid_mismatches": [hex(x) for x in mismatches],
+        "exact": not mismatches,
+    }
+
+
 def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
-              mesh_devices: int = 0) -> dict:
+              mesh_devices: int = 0, transport: str = "tcp") -> dict:
+    import tempfile
+
     from benchmarks.serve_bench import (
         build_server,
         force_virtual_cpu_devices,
@@ -107,29 +153,51 @@ def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
 
         jax.config.update("jax_platforms", "cpu")
 
+    shm_dir = None
+    if transport == "shm":
+        shm_dir = tempfile.mkdtemp(prefix="sentinel-shm-smoke-")
     n_flows = 10_000
     service, server, front_door = build_server(
         n_flows=n_flows, max_batch=4096, serve_buckets=(1024, 4096),
         native=True, n_dispatchers=2, fuse_depth=4,
         intake_shards=intake_shards, mesh_devices=mesh_devices,
+        shm_dir=shm_dir,
     )
+    shm_teardown_clean = None
     try:
+        if shm_dir is not None and front_door != "native-epoll":
+            raise RuntimeError(
+                "--transport shm needs the native front door "
+                "(native library not built?)"
+            )
         from sentinel_tpu.metrics.server import server_metrics
 
         sm = server_metrics()
         sm.reset()
         closed = run_closed(
             server.port, clients=2, batch=4096, pipeline=4,
-            seconds=seconds, n_flows=n_flows,
+            seconds=seconds, n_flows=n_flows, shm_dir=shm_dir,
         )
         fused = sm.fused_frames_total
         depth = sm.fused_depth.snapshot()
-        xid = _xid_probe(server.port, n_flows)
+        if shm_dir is not None:
+            xid = _xid_probe_shm(shm_dir, n_flows)
+        else:
+            xid = _xid_probe(server.port, n_flows)
     finally:
         server.stop()
         service.close()
+        if shm_dir is not None:
+            # clean segment teardown: every client unlinked its ring file
+            # (or the server reclaimed it); an orphan .ring is a leak
+            shm_teardown_clean = [
+                f for f in os.listdir(shm_dir) if f.endswith(".ring")
+            ] == []
     return {
-        "front_door": front_door,
+        "front_door": (
+            front_door + "+shm" if shm_dir is not None else front_door
+        ),
+        "transport": transport,
         "intake_shards": intake_shards,
         "mesh_devices": mesh_devices or None,
         "verdicts_per_sec": closed["verdicts_per_sec"],
@@ -140,6 +208,7 @@ def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
         "fused_frames_total": fused,
         "fused_depth_max": depth.get("max"),
         "xid_probe": xid,
+        "shm_teardown_clean": shm_teardown_clean,
         "seconds": seconds,
     }
 
@@ -163,11 +232,40 @@ def main() -> int:
                          "active under the mesh), not the single-shard "
                          "rate floor — N shards time-slicing one CI core "
                          "are legitimately slower")
+    ap.add_argument("--transport", choices=("tcp", "shm"), default="tcp",
+                    help="run the closed loop over the shared-memory ring "
+                         "door instead of TCP. Gates CORRECTNESS (zero "
+                         "client errors, xid exactness over the ring, clean "
+                         "segment teardown), not the TCP rate floor")
     args = ap.parse_args()
 
     doc = run_smoke(seconds=args.seconds, intake_shards=args.intake_shards,
-                    mesh_devices=args.mesh_devices)
+                    mesh_devices=args.mesh_devices, transport=args.transport)
     print(json.dumps(doc, indent=2))
+
+    if args.transport == "shm":
+        failures = []
+        if doc["errors"]:
+            failures.append(f"{doc['errors']} client-observed errors")
+        if not doc["verdicts_ok"]:
+            failures.append("zero verdicts served through the shm door")
+        if not doc["xid_probe"]["exact"]:
+            failures.append(
+                f"xid probe mismatches: {doc['xid_probe']['xid_mismatches']}"
+            )
+        if not doc["shm_teardown_clean"]:
+            failures.append(
+                "segment teardown leaked .ring files after server stop"
+            )
+        if failures:
+            for f_ in failures:
+                print(f"SHM SMOKE FAIL: {f_}", file=sys.stderr)
+            return 1
+        print(
+            f"SHM SMOKE OK: {doc['verdicts_per_sec']} verdicts/s over the "
+            f"ring door, p99 {doc['p99_ms']}ms, xid exact, teardown clean"
+        )
+        return 0
 
     if args.mesh_devices:
         failures = []

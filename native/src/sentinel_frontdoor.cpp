@@ -131,6 +131,10 @@ struct Frontdoor {
   std::thread io;
   std::atomic<bool> stopping{false};
 
+  // transport echo (bench/tests only) — see sn_fd_echo_start
+  std::thread echo;
+  std::atomic<bool> echo_stop{false};
+
   std::mutex mu;
   std::condition_variable cv;  // signaled when arena/control non-empty
 
@@ -589,6 +593,10 @@ SN_EXPORT int32_t sn_fd_port(void *h) {
 SN_EXPORT void sn_fd_stop(void *h) {
   auto *s = static_cast<Frontdoor *>(h);
   s->stopping.store(true, std::memory_order_release);
+  if (s->echo.joinable()) {
+    s->echo_stop.store(true, std::memory_order_release);
+    s->echo.join();
+  }
   wake(s);
   if (s->io.joinable()) s->io.join();
   // listen/epoll fds are IO-thread-only, closable once it has joined (and
@@ -798,10 +806,53 @@ SN_EXPORT void sn_fd_close_conn(void *h, int32_t fd, int32_t gen) {
   wake(s);
 }
 
+// Each value is an independently monotonic relaxed atomic; the four loads
+// are NOT one consistent snapshot (the IO thread may bump frames_in between
+// loads). Documented contract: consumers treat each counter as its own
+// monotonic series and clamp cross-counter deltas at zero.
 SN_EXPORT void sn_fd_stats(void *h, uint64_t *out4) {
   auto *s = static_cast<Frontdoor *>(h);
   out4[0] = s->frames_in.load(std::memory_order_relaxed);
   out4[1] = s->requests_in.load(std::memory_order_relaxed);
   out4[2] = s->bytes_in.load(std::memory_order_relaxed);
   out4[3] = s->bytes_out.load(std::memory_order_relaxed);
+}
+
+// --- transport echo (bench/tests only) -----------------------------------
+
+// Pure-C echo loop: wait_batch -> all-GRANTED submit, no Python in the
+// round trip. The TCP mirror of sn_shm_echo_start, so the two doors'
+// per-frame host cost can be compared transport-against-transport with an
+// identical serving loop behind each.
+SN_EXPORT void sn_fd_echo_start(void *h) {
+  auto *s = static_cast<Frontdoor *>(h);
+  if (s->echo.joinable()) return;
+  s->echo_stop.store(false, std::memory_order_release);
+  s->echo = std::thread([s, h] {
+    constexpr int32_t kMaxN = 65536, kMaxF = 4096;
+    std::vector<int64_t> ids(kMaxN);
+    std::vector<int32_t> counts(kMaxN), f_fd(kMaxF), f_gen(kMaxF),
+        f_xid(kMaxF), f_n(kMaxF), rem(kMaxN), wait(kMaxN, 0);
+    std::vector<uint8_t> prios(kMaxN), f_type(kMaxF);
+    std::vector<int8_t> status(kMaxN, 0);  // GRANTED
+    int32_t nf = 0;
+    while (!s->echo_stop.load(std::memory_order_acquire)) {
+      int32_t n = sn_fd_wait_batch(h, 5, ids.data(), counts.data(),
+                                   prios.data(), kMaxN, f_fd.data(),
+                                   f_gen.data(), f_xid.data(), f_n.data(),
+                                   f_type.data(), kMaxF, &nf);
+      if (n <= 0) continue;
+      for (int32_t i = 0; i < n; ++i) rem[i] = counts[i];
+      sn_fd_submit(h, nf, f_fd.data(), f_gen.data(), f_xid.data(),
+                   f_n.data(), f_type.data(), status.data(), rem.data(),
+                   wait.data());
+    }
+  });
+}
+
+SN_EXPORT void sn_fd_echo_stop(void *h) {
+  auto *s = static_cast<Frontdoor *>(h);
+  if (!s->echo.joinable()) return;
+  s->echo_stop.store(true, std::memory_order_release);
+  s->echo.join();
 }

@@ -94,8 +94,13 @@ class EnvoyRlsRuleManager:
     """``EnvoyRlsRuleManager.java``: converts + publishes RLS rules into the
     token service; keeps the flow-id → (rule, descriptor) map for responses."""
 
-    def __init__(self, service: DefaultTokenService):
+    def __init__(self, service: DefaultTokenService, publish: bool = True):
+        # publish=False: keep only the flow-id → descriptor map (the RLS
+        # response metadata) without pushing flow rules into the service —
+        # co-located mode, where the backing service is a remote token
+        # server that owns its own rule set
         self._service = service
+        self._publish = publish
         self._lock = threading.Lock()
         self._by_id: Dict[int, Tuple[str, RlsDescriptor]] = {}
 
@@ -121,7 +126,8 @@ class EnvoyRlsRuleManager:
                 )
         with self._lock:
             self._by_id = by_id
-        self._service.load_rules(flow_rules)
+        if self._publish:
+            self._service.load_rules(flow_rules)
 
     def lookup(self, flow_id: int) -> Optional[Tuple[str, RlsDescriptor]]:
         with self._lock:
@@ -249,6 +255,34 @@ class RlsService:
         ok_n = sum(1 for st in statuses if st.code == CODE_OK)
         server_metrics().count_rls(domain, ok_n, len(statuses) - ok_n)
         return RlsVerdict(CODE_OVER_LIMIT if blocked else CODE_OK, statuses)
+
+
+def co_located_rls(
+    shm_dir: str,
+    timeout_ms: int = 20,
+    namespace: str = "rls",
+    failure_mode: Optional[str] = None,
+    spin_us: Optional[int] = None,
+):
+    """Opt-in co-located mode: an RLS sidecar sharing a host with a
+    ``NativeTokenServer(shm_dir=...)`` rides the shared-memory ring door
+    instead of TCP loopback — zero syscalls per verdict batch on the
+    steady state.
+
+    Returns ``(rls, rules, client)``. The rule manager is created with
+    ``publish=False``: the token server owns the flow rules (load them
+    there); ``rules.load_rules(...)`` here only builds the descriptor map
+    RLS responses need for limit metadata. Close the returned ``client``
+    to unlink the segment.
+    """
+    from sentinel_tpu.cluster.shm_client import ShmTokenClient
+
+    client = ShmTokenClient(
+        shm_dir, timeout_ms=timeout_ms, namespace=namespace,
+        spin_us=spin_us,
+    )
+    rules = EnvoyRlsRuleManager(client, publish=False)
+    return RlsService(client, rules, failure_mode), rules, client
 
 
 # -- protobuf wire codec (hand-rolled; messages are tiny and frozen) --------

@@ -332,6 +332,10 @@ class StagingPool:
 
     Counters: ``reused`` / ``built`` expose the recycle rate — a healthy
     steady state reuses nearly always (``built`` ≈ the concurrency depth).
+    ``outstanding`` counts blocks acquired but not yet released — the
+    leak detector: once a server's lanes quiesce it must equal the number
+    of blocks lanes legitimately hold (one per intake lane), or a
+    shed/abandon path lost a block.
     """
 
     def __init__(self, factory, capacity: int = 16):
@@ -343,9 +347,11 @@ class StagingPool:
         self._lock = threading.Lock()
         self.reused = 0
         self.built = 0
+        self.outstanding = 0
 
     def acquire(self):
         with self._lock:
+            self.outstanding += 1
             if self._free:
                 self.reused += 1
                 return self._free.pop()
@@ -356,6 +362,10 @@ class StagingPool:
         if block is None:
             return
         with self._lock:
+            # outstanding decrements even when the block is dropped past
+            # capacity: the lifecycle audit tracks acquire/release pairing,
+            # not freelist residency
+            self.outstanding -= 1
             if len(self._free) < self.capacity:
                 self._free.append(block)
 

@@ -91,10 +91,24 @@ class NativeTokenServer:
         promote_after_ms: Optional[float] = None,
         replicate_to: Optional[Sequence] = None,
         repl_interval_ms: Optional[float] = None,
+        shm_dir: Optional[str] = None,
+        shm_spin_us: Optional[int] = None,
     ):
         from sentinel_tpu.native.lib import Frontdoor  # raises if unbuilt
 
         self._Frontdoor = Frontdoor
+        # opt-in shared-memory ring door for co-located sidecar clients:
+        # one extra intake lane pulls from the ring poller and drains into
+        # the SAME dispatch semaphore, so the fusion ladder fuses the union
+        # of TCP and shm bursts; replies scatter-encode straight into each
+        # client's response ring (zero syscalls steady-state)
+        self.shm_dir = shm_dir
+        self.shm_spin_us = shm_spin_us
+        self._shm_door = None
+        if shm_dir is not None:
+            from sentinel_tpu.native.lib import ShmDoor  # raises if stale
+
+            self._ShmDoor = ShmDoor
         self.service = service
         self.host = host
         self.port = port
@@ -197,6 +211,8 @@ class NativeTokenServer:
             promote_after_ms=self.promote_after_ms,
             replicate_to=self.replicate_to,
             repl_interval_ms=self.repl_interval_ms,
+            shm_dir=self.shm_dir,
+            shm_spin_us=self.shm_spin_us,
         )
 
     @property
@@ -244,9 +260,13 @@ class NativeTokenServer:
         # opportunity); reply queue depth caps device-step in-flight count.
         # The semaphore counts queued pulls across ALL shard queues so the
         # device lane blocks on one primitive instead of polling N queues.
+        # the shm door (when enabled) is one more intake lane with its own
+        # shard queue at index intake_shards — the device lane's union
+        # drain and sentinel accounting see it as just another shard
+        n_lanes = self.intake_shards + (1 if self.shm_dir is not None else 0)
         self._shard_qs = [
             queue.Queue(maxsize=max(2, 2 * self.fuse_depth))
-            for _ in range(self.intake_shards)
+            for _ in range(n_lanes)
         ]
         self._dispatch_q = self._shard_qs[0]
         self._dispatch_sem = threading.Semaphore(0)
@@ -260,7 +280,7 @@ class NativeTokenServer:
         self._staging = StagingPool(
             self._alloc_staging_block,
             capacity=2 * self.fuse_depth + self.n_dispatchers
-            + self.intake_shards + 2,
+            + n_lanes + 2,
         )
         # door 0 binds the requested port (possibly 0 → ephemeral); the
         # remaining shards bind the LEARNED concrete port via SO_REUSEPORT
@@ -274,6 +294,14 @@ class NativeTokenServer:
                 self._Frontdoor(self.host, self.port,
                                 arena_cap=self.arena_cap)
             )
+        if self.shm_dir is not None:
+            kw = {}
+            if self.shm_spin_us is not None:
+                kw["spin_us"] = self.shm_spin_us
+            self._shm_door = self._ShmDoor(
+                self.shm_dir, arena_cap=self.arena_cap, **kw
+            )
+            doors.append(self._shm_door)  # control loop + stats cover it
         self._doors = doors
         self._door = doors[0]
         if self.idle_ttl_s:
@@ -287,6 +315,17 @@ class NativeTokenServer:
             )
             for i in range(self.intake_shards)
         ]
+        if self._shm_door is not None:
+            # shard index intake_shards: its pulls/occupancy surface under
+            # the per-shard intake series like any TCP shard's
+            lanes.append(
+                threading.Thread(
+                    target=self._intake_loop,
+                    args=(self.intake_shards, self._shm_door,
+                          self._shard_qs[self.intake_shards]),
+                    name="sentinel-native-intake-shm", daemon=True,
+                )
+            )
         lanes.append(
             threading.Thread(
                 target=self._device_loop, name="sentinel-native-device",
@@ -332,6 +371,20 @@ class NativeTokenServer:
                 len(addrs) for addrs in self.connections.snapshot().values()
             ),
         }
+        if self._shm_door is not None:
+            def _ring_occupancy(door=self._shm_door):
+                try:
+                    st = door.stats()
+                except Exception:
+                    return 0.0
+                total = st.get("shm_req_slots_total", 0)
+                return st.get("shm_req_slots_used", 0) / total if total else 0.0
+
+            self._gauge_fns["shm_ring_occupancy"] = _ring_occupancy
+            # counter series (sentinel_server_shm_{polls,doorbells,
+            # ring_full}_total) render from the door's relaxed atomics via
+            # this provider — each independently monotonic, no snapshot
+            _SM.register_shm_provider(self._shm_stats_provider)
         for name, fn in self._gauge_fns.items():
             _SM.register_gauge(name, fn)
         if self.metrics_port is not None:
@@ -361,6 +414,21 @@ class NativeTokenServer:
             "(%d intake shards, %d dispatchers)",
             self.host, self.port, self.intake_shards, self.n_dispatchers,
         )
+
+    def _shm_stats_provider(self) -> dict:
+        door = self._shm_door
+        if door is None:
+            return {}
+        try:
+            st = door.stats()
+        except Exception:
+            return {}
+        return {
+            "polls": st.get("shm_polls", 0),
+            "doorbells": st.get("shm_doorbells", 0),
+            "ring_full": st.get("shm_ring_full", 0),
+            "segments": st.get("shm_segments", 0),
+        }
 
     def _alloc_staging_block(self) -> dict:
         """One intake decode block: row arrays sized for the largest pull
@@ -426,6 +494,40 @@ class NativeTokenServer:
                 self._abandon.set()
                 t.join(timeout=2)
         self._lane_threads = []
+        # staging-leak audit (abandoned shutdown): a dead or abandoned lane
+        # can strand pulls inside the shard/reply queues — nobody will
+        # answer them, but their staging blocks must still go back to the
+        # pool or the freelist never quiesces. Lanes are joined, so a
+        # nowait drain here sees every stranded item.
+        pool = self._staging
+        if pool is not None:
+            stranded = []
+            for q in self._shard_qs:
+                while True:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is not self._SENTINEL:
+                        stranded.append(item)
+            for pull in stranded:
+                n = len(pull[0])
+                self.overload.note_done(n)
+                _SM.count_shed("lane_abandon", n)
+                pool.release(pull[6])
+            if self._reply_q is not None:
+                while True:
+                    try:
+                        item = self._reply_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is self._SENTINEL:
+                        continue
+                    pulls, lengths, _mat = item
+                    self.overload.note_done(sum(lengths))
+                    _SM.count_shed("lane_abandon", sum(lengths))
+                    for p in pulls:
+                        pool.release(p[6])
         self._stop.set()
         for d in self._doors:
             d.stop()
@@ -439,6 +541,7 @@ class NativeTokenServer:
         self._staging = None
         self._doors = []
         self._door = None
+        self._shm_door = None
         # the door closed every socket without emitting CTRL_CLOSE (the
         # control thread is already down), so deregister the clients here —
         # a restart would otherwise inherit phantom connections that keep
@@ -1024,7 +1127,11 @@ class NativeTokenServer:
         return P.FlowResponse(req.xid, req.msg_type, int(TokenStatus.FAIL))
 
     def stats(self) -> dict:
-        """Door counters, summed across the intake shards."""
+        """Door counters, summed across the intake shards. Every summand
+        is an independently monotonic relaxed atomic read without pausing
+        the IO threads, so the result is NOT a consistent cross-counter
+        snapshot — each key is its own monotonic series; derived deltas
+        between two calls must be clamped at zero."""
         doors = list(self._doors)
         if not doors:
             return {}

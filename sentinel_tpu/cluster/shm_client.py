@@ -1,0 +1,193 @@
+"""Token client over the shared-memory ring front door (co-located).
+
+``ShmTokenClient`` is ``TokenClient`` with the socket swapped for one
+mmap'd SPSC ring pair (``native/src/sentinel_shm.cpp``): same xid
+correlation, pending-promise map, pipelined batch chunking, deadline
+stamping, reconnect backoff ladder and chaos hooks — the request methods
+are inherited verbatim and only the transport layer (connect / send /
+read loop / teardown) is replaced. A co-located sidecar (Envoy RLS, a
+per-host agent) gets token verdicts without the TCP loopback's
+syscall+copy tax: the steady state is two memcpys and zero syscalls per
+batch (the futex doorbell only rings when the peer advertised it went to
+sleep).
+
+Teardown is the one structural difference from TCP: the native client
+handle is freed by ``sn_shm_client_destroy``, so the reader thread —
+which blocks inside ``sn_shm_client_recv`` — must be the thread that
+closes it. ``close()``/``_drop_ring`` only *detach* the ring; the reader
+notices within one recv timeout, closes the segment, and exits.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from sentinel_tpu import chaos
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.client import (
+    RECONNECT_JITTER,
+    TokenClient,
+    _count_recv,
+)
+from sentinel_tpu.core.log import record_log
+from sentinel_tpu.native.lib import ShmRingClient
+
+# reader poll granularity: only teardown latency, never a batching stall
+# (the C recv spins/parks on the ring and returns the moment a response
+# publishes; this bounds how long a detached reader lingers)
+_READER_POLL_MS = 100
+
+
+class ShmTokenClient(TokenClient):
+    """``TokenClient`` API over one shm ring segment in ``shm_dir``.
+
+    ``shm_dir`` must be the directory a ``NativeTokenServer(shm_dir=...)``
+    door is serving on this host. Connection failures (no live door, door
+    restarted) follow the TCP client's lazy-reconnect contract: requests
+    return FAIL/None immediately and the next attempt re-creates a fresh
+    segment under the same exponential backoff ladder.
+    """
+
+    def __init__(self, shm_dir: str, timeout_ms: int = 20,
+                 namespace: str = "default", slot_payload: int = 65536,
+                 n_slots: int = 16, spin_us: Optional[int] = None):
+        super().__init__(f"shm:{shm_dir}", -1, timeout_ms, namespace)
+        self.shm_dir = shm_dir
+        self.slot_payload = slot_payload
+        self.n_slots = n_slots
+        self.spin_us = spin_us
+        self._ring: Optional[ShmRingClient] = None
+
+    # -- transport layer (everything above this rides the superclass) -------
+    def _ensure_connected(self) -> bool:
+        if self._ring is not None:
+            return True
+        with self._state_lock:
+            if self._ring is not None:
+                return True
+            now = time.monotonic()
+            if now - self._last_connect_attempt < self._reconnect_delay_s:
+                return False
+            self._last_connect_attempt = now
+            try:
+                # raises RuntimeError (propagated: permanent, the native
+                # lib lacks the shm door) vs ConnectionRefusedError/OSError
+                # (transient: no live server — backoff and retry)
+                ring = ShmRingClient(
+                    self.shm_dir, slot_payload=self.slot_payload,
+                    n_slots=self.n_slots, spin_us=self.spin_us,
+                )
+            except OSError as e:
+                self._consecutive_failures += 1
+                k = min(self._consecutive_failures, 16)
+                self._reconnect_delay_s = min(
+                    self._reconnect_base_s * (2 ** (k - 1)),
+                    self._reconnect_max_s,
+                ) * (1.0 + RECONNECT_JITTER * random.random())
+                if self._consecutive_failures <= 3:
+                    record_log.warning(
+                        "shm token door unreachable (%d consecutive): %s",
+                        self._consecutive_failures, e,
+                    )
+                return False
+            self._ring = ring
+            self._consecutive_failures = 0
+            self._reconnect_delay_s = 0.0
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(ring,), daemon=True,
+                name="sentinel-shm-client-reader",
+            )
+            self._reader.start()
+        # handshake outside _state_lock (ping → _send → _ensure_connected
+        # would re-enter); best-effort, same as the TCP client
+        self.ping()
+        return True
+
+    def _drop_ring(self, ring: ShmRingClient) -> None:
+        """Detach (never destroy — the reader owns the native handle's
+        final close) and fail waiters so they fall back immediately."""
+        with self._state_lock:
+            was_active = self._ring is ring
+            if was_active:
+                self._ring = None
+        if was_active:
+            for pending in list(self._pending.values()):
+                pending.event.set()
+
+    def close(self) -> None:
+        ring = self._ring
+        if ring is not None:
+            self._drop_ring(ring)
+        reader = self._reader
+        if reader is not None and reader is not threading.current_thread():
+            # deterministic segment teardown for callers that check: the
+            # reader notices the detach within one poll and unlinks
+            reader.join(timeout=1.0)
+
+    def _read_loop(self, ring: ShmRingClient) -> None:
+        try:
+            while True:
+                try:
+                    payload = ring.recv_payload(timeout_ms=_READER_POLL_MS)
+                except (ConnectionResetError, OSError):
+                    break  # server dropped the segment or died
+                if self._ring is not ring:
+                    break  # detached by close()/reconnect
+                if payload is None:
+                    continue
+                if chaos.ARMED:  # inbound bit-rot injection
+                    payload = chaos.mangle("frame_corrupt", payload)
+                _count_recv(len(payload))
+                try:
+                    if P.peek_type(payload) == P.MsgType.BATCH_FLOW:
+                        xid = int.from_bytes(payload[:4], "big", signed=True)
+                        pending = self._pending.get(xid)
+                        if pending is not None:
+                            pending.response = bytes(payload)
+                            pending.event.set()
+                        continue
+                    rsp = P.decode_response(bytes(payload))
+                except Exception:
+                    # corrupt server bytes degrade to a dropped connection,
+                    # never a dead reader with a traceback (TCP contract)
+                    record_log.warning(
+                        "malformed shm frame from server; dropping segment"
+                    )
+                    break
+                pending = self._pending.get(rsp.xid)
+                if pending is not None:
+                    pending.response = rsp
+                    pending.event.set()
+        finally:
+            self._drop_ring(ring)
+            # sole closer of the native handle; under _send_lock so a
+            # request thread that raced the detach finishes its in-flight
+            # send before the mapping is freed (send_frame then raises on
+            # the cleared handle instead of touching freed memory)
+            with self._send_lock:
+                ring.close()
+
+    def _send(self, data: bytes) -> bool:
+        if not self._ensure_connected():
+            return False
+        ring = self._ring
+        if ring is None:
+            return False
+        if chaos.ARMED:
+            if chaos.should("conn_reset"):  # segment death mid-request
+                self._drop_ring(ring)
+                return False
+            data = chaos.mangle("frame_corrupt", data)  # outbound bit rot
+        try:
+            with self._send_lock:
+                # ring full past the request budget = backpressure, not
+                # death: fail this request (caller falls back) but keep
+                # the segment — the server is draining, just slower than
+                # we produce
+                return ring.send_frame(data, timeout_ms=self.timeout_ms)
+        except (ConnectionResetError, OSError):
+            self._drop_ring(ring)
+            return False

@@ -92,6 +92,7 @@ class ServerMetrics:
     _GAUGE_NAMES = (
         "queue_depth", "inflight_batches", "connections",
         "dispatch_lane_depth", "reply_lane_depth",
+        "shm_ring_occupancy",
     )
 
     def __init__(self):
@@ -142,6 +143,11 @@ class ServerMetrics:
         # replacement server's gauges.
         self._sketch_provider: Optional[Callable[[], dict]] = None
         self._sketch_lock = threading.Lock()
+        # shm front-door observability: the native server registers a
+        # zero-arg provider returning the shm door's poll/doorbell/ring-full
+        # counters (each independently monotonic; no cross-counter snapshot)
+        self._shm_provider: Optional[Callable[[], dict]] = None
+        self._shm_lock = threading.Lock()
 
     # -- fused dispatch counters --------------------------------------------
     def record_fused(self, depth: int) -> None:
@@ -317,6 +323,27 @@ class ServerMetrics:
         except Exception:
             return {}  # a torn-down service's reader must not 500 a scrape
 
+    # -- shm front door provider --------------------------------------------
+    def register_shm_provider(self, fn: Callable[[], dict]) -> None:
+        """Install the zero-arg reader for the shm ring door's counters
+        (``{"polls", "doorbells", "ring_full", "segments"}``). Most recent
+        registration wins; providers return ``{}`` once their door is
+        gone. Values are independently monotonic relaxed atomics — the
+        exporter renders each as its own counter, never arithmetic across
+        them."""
+        with self._shm_lock:
+            self._shm_provider = fn
+
+    def shm_stats(self) -> dict:
+        with self._shm_lock:
+            fn = self._shm_provider
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:
+            return {}  # a torn-down door's reader must not 500 a scrape
+
     # -- snapshots ----------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON shape served by the ``clusterServerStats`` command — the
@@ -337,6 +364,7 @@ class ServerMetrics:
                 str(k): v for k, v in sorted(self.shard_totals().items())
             },
             "sketch": self.sketch_stats(),
+            "shm": self.shm_stats(),
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -486,6 +514,23 @@ class ServerMetrics:
             lines.append(f"# HELP {mname} {help_text}")
             lines.append(f"# TYPE {mname} gauge")
             lines.append(f"{mname} {int(sketch.get(skey, 0) or 0)}")
+        shm = self.shm_stats()
+        for mname, skey, help_text in (
+            ("shm_polls_total", "polls",
+             "Shm ring poller wake-to-idle cycles (spin or futex) "
+             "(cumulative)."),
+            ("shm_doorbells_total", "doorbells",
+             "Futex doorbell rings by co-located shm clients — each one is "
+             "a syscall the steady state avoided elsewhere (cumulative)."),
+            ("shm_ring_full_total", "ring_full",
+             "Response-ring pushes dropped after the bounded wait because "
+             "the client stopped draining (cumulative)."),
+        ):
+            lines.append(f"# HELP sentinel_server_{mname} {help_text}")
+            lines.append(f"# TYPE sentinel_server_{mname} counter")
+            lines.append(
+                f"sentinel_server_{mname} {int(shm.get(skey, 0) or 0)}"
+            )
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -495,6 +540,9 @@ class ServerMetrics:
              "Decoded pulls queued between the intake and device lanes."),
             ("reply_lane_depth",
              "Dispatched batches queued between the device and reply lanes."),
+            ("shm_ring_occupancy",
+             "Fraction of shm request-ring slots occupied across attached "
+             "segments (sampled; 0 when no shm door is serving)."),
         ):
             lines.append(f"# HELP sentinel_server_{name} {help_text}")
             lines.append(f"# TYPE sentinel_server_{name} gauge")
@@ -548,6 +596,8 @@ class ServerMetrics:
             self._copy_bytes = 0
         with self._sketch_lock:
             self._sketch_provider = None
+        with self._shm_lock:
+            self._shm_provider = None
         self._rate.reset()
 
 

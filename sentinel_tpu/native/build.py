@@ -16,6 +16,7 @@ _REPO = os.path.dirname(os.path.dirname(_HERE))
 SOURCES = [
     os.path.join(_REPO, "native", "src", "sentinel_native.cpp"),
     os.path.join(_REPO, "native", "src", "sentinel_frontdoor.cpp"),
+    os.path.join(_REPO, "native", "src", "sentinel_shm.cpp"),
 ]
 OUTPUT = os.path.join(_HERE, "_sentinel_native.so")
 

@@ -113,6 +113,69 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn = getattr(lib, name)
         fn.argtypes = argtypes
         fn.restype = restype
+    # shared-memory ring door (sentinel_shm.cpp) — resolved defensively so
+    # a stale .so built before these exports existed still loads (the TCP
+    # door and kernels keep working; ShmDoor raises with a rebuild hint)
+    shm_sig = {
+        "sn_shm_create": ([ctypes.c_char_p, I64, I32], P),
+        "sn_shm_stop": ([P], None),
+        "sn_shm_destroy": ([P], None),
+        "sn_shm_wait_batch": (
+            [
+                P, I32, ctypes.POINTER(I64), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8), I32, ctypes.POINTER(I32),
+                ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(I32), ctypes.POINTER(ctypes.c_uint8), I32,
+                ctypes.POINTER(I32),
+            ],
+            I32,
+        ),
+        "sn_shm_submit": (
+            [
+                P, I32, ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(I32),
+                ctypes.POINTER(I32),
+            ],
+            None,
+        ),
+        "sn_shm_send": ([P, I32, I32, ctypes.c_char_p, I32], None),
+        "sn_shm_next_control": (
+            [
+                P, ctypes.POINTER(I32), ctypes.POINTER(I32),
+                ctypes.POINTER(ctypes.c_uint8), I32, ctypes.POINTER(I32),
+            ],
+            I32,
+        ),
+        "sn_shm_close_conn": ([P, I32, I32], None),
+        "sn_shm_stats": ([P, ctypes.POINTER(ctypes.c_uint64)], None),
+        "sn_shm_echo_start": ([P], None),
+        "sn_shm_echo_stop": ([P], None),
+        # TCP-door echo mirror, shipped in the same rebuild as the shm
+        # exports — resolved in this defensive block for the same reason
+        "sn_fd_echo_start": ([P], None),
+        "sn_fd_echo_stop": ([P], None),
+        "sn_shm_client_create": ([ctypes.c_char_p, I32, I32, I32], P),
+        "sn_shm_client_destroy": ([P], None),
+        "sn_shm_client_send": ([P, ctypes.c_char_p, I32], I32),
+        "sn_shm_client_recv": (
+            [P, ctypes.POINTER(ctypes.c_uint8), I32, I32], I32
+        ),
+        "sn_shm_client_rtt": (
+            [P, ctypes.c_char_p, I32, I32, ctypes.POINTER(I64)], I32
+        ),
+        "sn_shm_client_fuzz": ([P, ctypes.c_char_p, I32, I32], I32),
+        "sn_shm_client_alive": ([P], I32),
+    }
+    try:
+        for name, (argtypes, restype) in shm_sig.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+        lib._sn_has_shm = True
+    except AttributeError:
+        lib._sn_has_shm = False
     return lib
 
 
@@ -159,6 +222,14 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def shm_available() -> bool:
+    """True when the loaded .so exports the shared-memory ring door (a
+    stale artifact from an older tree loads fine but lacks the exports —
+    rebuild with ``python -m sentinel_tpu.native.build``)."""
+    lib = load()
+    return lib is not None and bool(getattr(lib, "_sn_has_shm", False))
 
 
 def batch_decode_req(payload: bytes):
@@ -585,6 +656,11 @@ class Frontdoor:
         return kind, fd.value, gen.value, payload
 
     def stats(self):
+        """Counters are independently monotonic (relaxed atomics read
+        without a common lock): the dict is NOT a consistent cross-counter
+        snapshot — e.g. ``frames_in`` may already include a frame whose
+        rows are not yet in ``requests_in``. Consumers diffing two reads
+        (bench occupancy math) must clamp derived deltas at zero."""
         import numpy as np
 
         out = np.zeros(4, np.uint64)
@@ -595,6 +671,21 @@ class Frontdoor:
             "frames_in": int(out[0]), "requests_in": int(out[1]),
             "bytes_in": int(out[2]), "bytes_out": int(out[3]),
         }
+
+    def echo_start(self) -> None:
+        """Bench/test helper: a pure-C wait→all-GRANTED-submit loop — the
+        TCP mirror of :meth:`ShmDoor.echo_start`, so both doors' transport
+        host cost is measured behind an identical serving loop."""
+        if not getattr(self._lib, "_sn_has_shm", False):
+            raise RuntimeError(
+                "native library predates the door echo exports — rebuild "
+                "with `python -m sentinel_tpu.native.build`"
+            )
+        self._lib.sn_fd_echo_start(self._h)
+
+    def echo_stop(self) -> None:
+        if getattr(self._lib, "_sn_has_shm", False):
+            self._lib.sn_fd_echo_stop(self._h)
 
     def stop(self) -> None:
         if not self._stopped:
@@ -610,3 +701,321 @@ class Frontdoor:
                 pass
             self._lib.sn_fd_destroy(h)
             self._h = None
+
+
+class ShmDoor:
+    """The shared-memory ring front door (``sentinel_shm.cpp``).
+
+    Same batch contract as :class:`Frontdoor` — ``wait_batch_into`` /
+    ``submit`` / ``submit_many`` / ``next_control`` / ``send`` — so the
+    server's intake, reply, and control lanes drive either door through
+    one code path. The "fd" of a frame is the client segment id; replies
+    are scatter-encoded straight into that client's response ring by the
+    C side. A C++ poller thread (spin-then-sleep on a shared futex
+    doorbell) replaces the epoll IO thread; co-located clients attach by
+    dropping a segment file into ``shm_dir``.
+    """
+
+    CTRL_FRAME, CTRL_OPEN, CTRL_CLOSE = 0, 1, 2
+
+    def __init__(self, shm_dir: str, arena_cap: int = 65536,
+                 spin_us: Optional[int] = None):
+        # Adaptive spin default: on a single-core host the spinner only
+        # burns the peer's timeslice (measured: RTT ~= 2x the spin window),
+        # so go straight to the futex; with spare cores a short spin dodges
+        # the syscall entirely in the steady state.
+        if spin_us is None:
+            spin_us = 0 if (os.cpu_count() or 1) <= 1 else 100
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        if not getattr(lib, "_sn_has_shm", False):
+            raise RuntimeError(
+                "native library predates the shm door — rebuild with "
+                "`python -m sentinel_tpu.native.build`"
+            )
+        self._lib = lib
+        from sentinel_tpu.cluster.protocol import MAX_BATCH_PER_FRAME
+
+        arena_cap = max(arena_cap, MAX_BATCH_PER_FRAME)
+        self._h = lib.sn_shm_create(
+            os.fsencode(shm_dir), arena_cap, int(spin_us)
+        )
+        if not self._h:
+            raise OSError(f"shm door failed to initialize in {shm_dir!r}")
+        self.dir = shm_dir
+        self.arena_cap = arena_cap
+        self.port = -1  # no TCP endpoint; keeps door-agnostic logging sane
+        self._tls = threading.local()
+        self._ctrl_buf = ctypes.create_string_buffer(70000)
+        self._ctrl_lock = threading.Lock()
+        self._stopped = False
+
+    _ptr = Frontdoor._ptr
+    _bufs = Frontdoor._bufs
+    # identical pull/answer surface — the ctypes marshaling only differs in
+    # the export name, so rebind the TCP door's methods over sn_shm_*
+    def wait_batch_into(self, staging: dict, timeout_ms: int = 100,
+                        max_n: Optional[int] = None):
+        from sentinel_tpu.cluster.protocol import MAX_BATCH_PER_FRAME
+
+        cap = int(staging["ids"].shape[0])
+        max_f = int(staging["f_fd"].shape[0])
+        if max_n is None:
+            max_n = cap
+        max_n = min(
+            max(int(max_n), MAX_BATCH_PER_FRAME), cap, self.arena_cap
+        )
+        n_frames = ctypes.c_int32()
+        n = self._lib.sn_shm_wait_batch(
+            self._h, timeout_ms,
+            self._ptr(staging["ids"], ctypes.c_int64),
+            self._ptr(staging["counts"], ctypes.c_int32),
+            self._ptr(staging["prios"], ctypes.c_uint8),
+            max_n,
+            self._ptr(staging["f_fd"], ctypes.c_int32),
+            self._ptr(staging["f_gen"], ctypes.c_int32),
+            self._ptr(staging["f_xid"], ctypes.c_int32),
+            self._ptr(staging["f_n"], ctypes.c_int32),
+            self._ptr(staging["f_type"], ctypes.c_uint8),
+            max_f, ctypes.byref(n_frames),
+        )
+        if n <= 0:
+            return None
+        return n, n_frames.value
+
+    def wait_batch(self, timeout_ms: int = 100, max_n: Optional[int] = None):
+        if max_n is None:
+            max_n = self.arena_cap
+        from sentinel_tpu.cluster.protocol import MAX_BATCH_PER_FRAME
+
+        max_n = min(max(int(max_n), MAX_BATCH_PER_FRAME), self.arena_cap)
+        b = self._bufs()
+        n_frames = ctypes.c_int32()
+        n = self._lib.sn_shm_wait_batch(
+            self._h, timeout_ms,
+            self._ptr(b["ids"], ctypes.c_int64),
+            self._ptr(b["counts"], ctypes.c_int32),
+            self._ptr(b["prios"], ctypes.c_uint8),
+            max_n,
+            self._ptr(b["f_fd"], ctypes.c_int32),
+            self._ptr(b["f_gen"], ctypes.c_int32),
+            self._ptr(b["f_xid"], ctypes.c_int32),
+            self._ptr(b["f_n"], ctypes.c_int32),
+            self._ptr(b["f_type"], ctypes.c_uint8),
+            self.arena_cap, ctypes.byref(n_frames),
+        )
+        if n <= 0:
+            return None
+        k = n_frames.value
+        frames = (
+            b["f_fd"][:k], b["f_gen"][:k], b["f_xid"][:k], b["f_n"][:k],
+            b["f_type"][:k],
+        )
+        return (
+            b["ids"][:n], b["counts"][:n],
+            b["prios"][:n].astype(bool), frames,
+        )
+
+    def submit(self, frames, status, remaining, wait_ms) -> None:
+        import numpy as np
+
+        f_fd, f_gen, f_xid, f_n, f_type = frames
+        f_fd = np.ascontiguousarray(f_fd, np.int32)
+        f_gen = np.ascontiguousarray(f_gen, np.int32)
+        f_xid = np.ascontiguousarray(f_xid, np.int32)
+        f_n = np.ascontiguousarray(f_n, np.int32)
+        f_type = np.ascontiguousarray(f_type, np.uint8)
+        status = np.ascontiguousarray(status, np.int8)
+        remaining = np.ascontiguousarray(remaining, np.int32)
+        wait_ms = np.ascontiguousarray(wait_ms, np.int32)
+        self._lib.sn_shm_submit(
+            self._h, len(f_fd),
+            self._ptr(f_fd, ctypes.c_int32),
+            self._ptr(f_gen, ctypes.c_int32),
+            self._ptr(f_xid, ctypes.c_int32),
+            self._ptr(f_n, ctypes.c_int32),
+            self._ptr(f_type, ctypes.c_uint8),
+            self._ptr(status, ctypes.c_int8),
+            self._ptr(remaining, ctypes.c_int32),
+            self._ptr(wait_ms, ctypes.c_int32),
+        )
+
+    submit_many = Frontdoor.submit_many
+
+    def send(self, fd: int, gen: int, frame: bytes) -> None:
+        # TCP frames carry a 2-byte length prefix; ring slots carry the
+        # payload with the slot len word playing the prefix's role
+        payload = frame[2:]
+        self._lib.sn_shm_send(self._h, fd, gen, payload, len(payload))
+
+    def set_idle_ttl(self, ttl_ms: int) -> None:
+        # liveness is pid-based (the poller sweep), not activity-based
+        pass
+
+    def close_conn(self, fd: int, gen: int) -> None:
+        self._lib.sn_shm_close_conn(self._h, fd, gen)
+
+    def next_control(self):
+        fd = ctypes.c_int32()
+        gen = ctypes.c_int32()
+        ln = ctypes.c_int32()
+        with self._ctrl_lock:
+            kind = self._lib.sn_shm_next_control(
+                self._h, ctypes.byref(fd), ctypes.byref(gen),
+                ctypes.cast(self._ctrl_buf, ctypes.POINTER(ctypes.c_uint8)),
+                len(self._ctrl_buf), ctypes.byref(ln),
+            )
+            if kind < 0:
+                return None
+            payload = (
+                ctypes.string_at(self._ctrl_buf, ln.value)
+                if ln.value > 0 else b""
+            )
+        return kind, fd.value, gen.value, payload
+
+    def stats(self):
+        """Counters are independently monotonic (relaxed atomics): the
+        dict is NOT a consistent cross-counter snapshot. Consumers diffing
+        two reads must clamp derived deltas at zero."""
+        import numpy as np
+
+        out = np.zeros(10, np.uint64)
+        self._lib.sn_shm_stats(self._h, self._ptr(out, ctypes.c_uint64))
+        return {
+            "frames_in": int(out[0]), "requests_in": int(out[1]),
+            "bytes_in": int(out[2]), "bytes_out": int(out[3]),
+            "shm_polls": int(out[4]), "shm_doorbells": int(out[5]),
+            "shm_ring_full": int(out[6]), "shm_segments": int(out[7]),
+            "shm_req_slots_used": int(out[8]),
+            "shm_req_slots_total": int(out[9]),
+        }
+
+    def echo_start(self) -> None:
+        """Bench/test helper: a pure-C wait→all-GRANTED-submit loop, for
+        measuring the raw transport round trip with no Python in it."""
+        self._lib.sn_shm_echo_start(self._h)
+
+    def echo_stop(self) -> None:
+        self._lib.sn_shm_echo_stop(self._h)
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._lib.sn_shm_stop(self._h)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self.stop()
+            except Exception:
+                pass
+            self._lib.sn_shm_destroy(h)
+            self._h = None
+
+
+class ShmRingClient:
+    """Low-level client half of one shm segment (``sn_shm_client_*``).
+
+    Byte-level transport only: callers hand it full wire frames (with the
+    2-byte length prefix, exactly what the TCP socket would carry) and get
+    response payloads back; the prefix is stripped/re-added here so
+    ``cluster.shm_client`` reuses the ``protocol.py`` codecs verbatim.
+    Raises ``ConnectionRefusedError`` when no live door owns ``shm_dir``.
+    """
+
+    def __init__(self, shm_dir: str, slot_payload: int = 65536,
+                 n_slots: int = 16, spin_us: Optional[int] = None):
+        if spin_us is None:  # same adaptive rule as ShmDoor
+            spin_us = 0 if (os.cpu_count() or 1) <= 1 else 50
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        if not getattr(lib, "_sn_has_shm", False):
+            raise RuntimeError(
+                "native library predates the shm door — rebuild with "
+                "`python -m sentinel_tpu.native.build`"
+            )
+        self._lib = lib
+        self._h = lib.sn_shm_client_create(
+            os.fsencode(shm_dir), int(slot_payload), int(n_slots),
+            int(spin_us)
+        )
+        if not self._h:
+            raise ConnectionRefusedError(
+                f"no live shm door in {shm_dir!r}"
+            )
+        self._rbuf = ctypes.create_string_buffer(70000)
+        self._lock = threading.Lock()
+
+    def send_frame(self, frame: bytes, timeout_ms: int = 100) -> bool:
+        """Publish one length-prefixed wire frame. Spins/backs off while
+        the request ring is full, up to ``timeout_ms``. False = give up
+        (ring still full); raises ``ConnectionResetError`` once the server
+        dropped the segment or died."""
+        import time as _time
+
+        payload = frame[2:]
+        deadline = _time.monotonic() + timeout_ms / 1000.0
+        while True:
+            h = self._h
+            if not h:
+                raise ConnectionResetError("shm segment closed")
+            rc = self._lib.sn_shm_client_send(h, payload, len(payload))
+            if rc == 1:
+                return True
+            if rc < 0:
+                raise ConnectionResetError("shm door dropped this segment")
+            if _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.0002)
+
+    def recv_payload(self, timeout_ms: int = 100) -> Optional[bytes]:
+        """One response frame payload (no length prefix), or ``None`` on
+        timeout; raises ``ConnectionResetError`` when the server is gone."""
+        with self._lock:
+            n = self._lib.sn_shm_client_recv(
+                self._h,
+                ctypes.cast(self._rbuf, ctypes.POINTER(ctypes.c_uint8)),
+                len(self._rbuf), int(timeout_ms),
+            )
+            if n > 0:
+                return ctypes.string_at(self._rbuf, n)
+        if n < 0:
+            raise ConnectionResetError("shm door dropped this segment")
+        return None
+
+    def rtt_probe(self, frame: bytes, iters: int = 1000):
+        """Per-iteration transport round-trip times in ns (C-side send +
+        spin-recv loop — no ctypes/codec cost inside the timed region)."""
+        import numpy as np
+
+        payload = frame[2:]
+        out = np.zeros(iters, np.int64)
+        done = self._lib.sn_shm_client_rtt(
+            self._h, payload, len(payload), iters,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return out[:max(done, 0)]
+
+    def fuzz(self, data: bytes, stage: int) -> bool:
+        """Test hook: torn/hostile slot writes (see sn_shm_client_fuzz)."""
+        return bool(
+            self._lib.sn_shm_client_fuzz(self._h, data, len(data), stage)
+        )
+
+    def alive(self) -> bool:
+        return bool(self._lib.sn_shm_client_alive(self._h))
+
+    def close(self) -> None:
+        h = self._h
+        if h:
+            self._h = None
+            self._lib.sn_shm_client_destroy(h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -428,3 +428,17 @@ class TestNativeMixedSoak:
         assert np.asarray(svc._table.ns_max_qps).min() == np.float32(1e12)
         # semaphore fully released after the soak
         assert svc.concurrency.now_calls(9) == 0
+        # freelist quiescence: every staging block acquired on the soak's
+        # shed/deadline/reply paths came back to the pool. Once the lanes
+        # drain, outstanding must equal exactly the one block each intake
+        # lane holds while idle — anything above is a leaked block
+        pool = server._staging
+        n_lanes = len(server._shard_qs)
+        deadline = time.monotonic() + 5.0
+        while pool.outstanding > n_lanes and time.monotonic() < deadline:
+            time.sleep(0.02)  # in-flight replies still releasing
+        assert pool.outstanding == n_lanes, (
+            f"staging leak: {pool.outstanding} outstanding, "
+            f"{n_lanes} intake lanes (built={pool.built}, "
+            f"reused={pool.reused})"
+        )

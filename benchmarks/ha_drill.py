@@ -36,6 +36,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 DRILL_FLOW = 42
+WARM_FLOW = 7
 N_FALLBACK_PROBES = 400
 
 
@@ -57,10 +58,15 @@ def _serve_forever(args) -> None:
         EngineConfig(
             max_flows=64, max_namespaces=4, batch_size=64,
             bucket_ms=args.bucket_ms,
-        )
+        ),
+        lease_ttl_ms=int(args.lease_ttl_ms),
     )
+    # WARM_FLOW carries an effectively-unbounded rule so drills can warm
+    # jit-compiled paths (decide, lease grant) without touching the finite
+    # DRILL_FLOW window the over-admission gates measure
     svc.load_rules(
-        [ClusterFlowRule(DRILL_FLOW, args.count, ThresholdMode.GLOBAL)]
+        [ClusterFlowRule(DRILL_FLOW, args.count, ThresholdMode.GLOBAL),
+         ClusterFlowRule(WARM_FLOW, 1e9, ThresholdMode.GLOBAL)]
     )
     server = TokenServer(
         svc, port=0, metrics_port=0,
@@ -876,6 +882,217 @@ def run_rebalance_drill(
     }
 
 
+def run_lease_drill(
+    count: float = 300.0,
+    repl_interval_ms: float = 100.0,
+    promote_after_ms: float = 1000.0,
+    bucket_ms: int = 700,
+    drive_rate: float = 200.0,
+    lease_ttl_ms: float = 4000.0,
+    lease_want: int = 60,
+):
+    """Lease crash drill: SIGKILL the primary WITH LEASES OUTSTANDING and
+    verify the wire-rev-5 over-admission bound.
+
+    Charge-at-grant is the accounting that makes the bound provable: the
+    full delegated slice lands in the window's LEASED column at grant time
+    and replicates like any other event, so the promoted standby counts it
+    without ever learning a lease existed. What a crash can lose is at most
+    the unreplicated part of that charge — hence the gate::
+
+        total admitted (fill + client-local + post-promotion)
+            <= window count + outstanding-lease sum at the kill
+
+    The drill fills half the window, waits for a post-fill delta ship (so
+    wire-admission staleness is zero and the lease term is isolated),
+    grants one lease, scrapes ``sentinel_lease_outstanding_tokens`` as the
+    bound, SIGKILLs the primary, drains the client's lease slice locally
+    (RPC-free — the primary is dead and admission continues), then drives
+    the promoted standby until it blocks. Every request must resolve; the
+    lease client must degrade to wire verdicts (never raise) once its
+    slice is spent against a dead server."""
+    from sentinel_tpu.cluster.client import TokenClient
+    from sentinel_tpu.engine import TokenStatus
+
+    failures = []
+    window_s = bucket_ms * 10 / 1000.0  # EngineConfig default n_buckets=10
+    rule_qps = count / window_s
+    common = [
+        "--count", str(rule_qps), "--bucket-ms", str(bucket_ms),
+        "--repl-interval-ms", str(repl_interval_ms),
+        "--lease-ttl-ms", str(lease_ttl_ms),
+    ]
+    standby_proc, standby_port, _standby_mport = _spawn_server(
+        extra=common + [
+            "--standby-of", "primary",
+            "--promote-after-ms", str(promote_after_ms),
+        ]
+    )
+    primary_proc, primary_port, primary_mport = _spawn_server(
+        extra=common + ["--replicate-to", f"127.0.0.1:{standby_port}"]
+    )
+    wire = TokenClient("127.0.0.1", primary_port, timeout_ms=200)
+    leaser = TokenClient("127.0.0.1", primary_port, timeout_ms=200,
+                         lease=True, lease_want=lease_want)
+    period = 1.0 / drive_rate
+    admitted_fill = local_admits = standby_admits = standby_blocks = 0
+    outstanding_tokens = 0.0
+    lease_granted = False
+    over_admission = 0
+
+    def _counter(body: str, needle: str) -> float:
+        for line in body.splitlines():
+            if line.startswith(needle):
+                return float(line.split()[-1])
+        return 0.0
+
+    try:
+        # warm every jit path OUTSIDE the measured window, on WARM_FLOW's
+        # unbounded rule: the plain decide kernel and the lease-grant
+        # window sums both compile here, not mid-window
+        warm_deadline = time.monotonic() + 30.0
+        while time.monotonic() < warm_deadline:
+            if wire.request_token(WARM_FLOW).ok:
+                break
+        else:
+            failures.append("primary never served before the kill")
+        warm_lease = TokenClient("127.0.0.1", primary_port, timeout_ms=500,
+                                 lease=True, lease_want=8)
+        try:
+            if not warm_lease.request_token(WARM_FLOW).ok:
+                failures.append("lease warmup on the warm flow failed")
+        finally:
+            warm_lease.close()  # returns the warm slice
+
+        # fill: paced wire admissions to the middle of the window
+        t_fill = time.monotonic()
+        next_t = t_fill
+        while admitted_fill < count / 2:
+            next_t += period
+            time.sleep(max(0.0, next_t - time.monotonic()))
+            if wire.request_token(DRILL_FLOW).ok:
+                admitted_fill += 1
+            if time.monotonic() - t_fill > 5.0:
+                failures.append("fill phase never reached count/2")
+                break
+
+        # quiesce, then wait for one delta ship CAPTURED AFTER the last
+        # fill admission: wire-admission replication staleness is now zero,
+        # so the over-admission gate below isolates the lease term
+        shipped_needle = 'sentinel_repl_deltas_total{event="shipped"}'
+        try:
+            base_shipped = _counter(_scrape(primary_mport), shipped_needle)
+            ship_deadline = time.monotonic() + 3.0
+            while time.monotonic() < ship_deadline:
+                if _counter(_scrape(primary_mport),
+                            shipped_needle) > base_shipped:
+                    break
+                time.sleep(repl_interval_ms / 1000.0 / 2)
+            else:
+                failures.append("no delta shipped after the fill phase")
+        except Exception as e:
+            failures.append(f"primary metrics scrape failed: {e!r}")
+
+        # the lease: one grant, then read the authoritative outstanding sum
+        # off the primary's metrics surface — the crash bound
+        r = leaser.request_token(DRILL_FLOW)
+        if r is not None and r.ok:
+            local_admits += 1
+        lease_granted = leaser.lease_stats().get("granted", 0) >= 1
+        if not lease_granted:
+            failures.append("lease was never granted before the kill")
+        try:
+            outstanding_tokens = _counter(
+                _scrape(primary_mport), "sentinel_lease_outstanding_tokens"
+            )
+        except Exception as e:
+            failures.append(f"outstanding-lease scrape failed: {e!r}")
+        if outstanding_tokens <= 0:
+            failures.append(
+                "primary reported no outstanding lease tokens at the kill"
+            )
+        # give the grant charge one ship interval (not required for the
+        # bound — an unshipped charge IS the lease term — but it makes the
+        # typical run's over-admission land near zero)
+        time.sleep(repl_interval_ms / 1000.0 * 1.5)
+
+        # the kill: leases outstanding, slice half-unspent
+        primary_proc.kill()
+        primary_proc.wait()
+        t_kill = time.monotonic()
+
+        # client-local admission continues against the DEAD primary: this
+        # is exactly the over-admission a crashed grant can cost, and it
+        # must degrade to wire verdicts (never raise) once the slice is
+        # spent or the renew-ahead retires it
+        for _ in range(5 * lease_want):
+            try:
+                r = leaser.request_token(DRILL_FLOW)
+            except Exception as e:
+                failures.append(f"lease client raised post-kill: {e!r}")
+                break
+            if r is None or not r.ok:
+                break
+            local_admits += 1
+
+        # drive the promoted standby until the inherited window blocks
+        standby = TokenClient("127.0.0.1", standby_port, timeout_ms=200)
+        try:
+            next_t = time.monotonic()
+            deadline = t_kill + promote_after_ms / 1000.0 + 2.5
+            while time.monotonic() < deadline:
+                next_t += period
+                time.sleep(max(0.0, next_t - time.monotonic()))
+                try:
+                    r = standby.request_token(DRILL_FLOW)
+                except Exception as e:
+                    failures.append(f"standby request raised: {e!r}")
+                    break
+                if r is None:
+                    continue
+                if r.ok:
+                    standby_admits += 1
+                elif r.status == TokenStatus.BLOCKED:
+                    standby_blocks += 1
+                    if standby_blocks >= 3:
+                        break
+        finally:
+            standby.close()
+        if not standby_blocks:
+            failures.append(
+                "promoted standby never blocked — the window (with its "
+                "lease charge) was not inherited"
+            )
+        total = admitted_fill + local_admits + standby_admits
+        over_admission = max(0, int(total - count))
+        if over_admission > int(outstanding_tokens):
+            failures.append(
+                f"over-admitted {over_admission} tokens, above the "
+                f"outstanding-lease bound of {int(outstanding_tokens)}"
+            )
+    finally:
+        leaser.close()
+        wire.close()
+        for proc in (primary_proc, standby_proc):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    return {
+        "window_tokens": count,
+        "lease_want": lease_want,
+        "lease_ttl_ms": lease_ttl_ms,
+        "lease_granted": lease_granted,
+        "outstanding_tokens_at_kill": int(outstanding_tokens),
+        "admitted_fill": admitted_fill,
+        "local_admits": local_admits,
+        "standby_admits": standby_admits,
+        "standby_blocks": standby_blocks,
+        "over_admission": over_admission,
+        "client_lease_stats": leaser.lease_stats(),
+        "failures": failures,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true",
@@ -887,6 +1104,11 @@ def main() -> None:
                     help="skip the warm-standby replication drill")
     ap.add_argument("--skip-rebalance", action="store_true",
                     help="skip the live shard-rebalance drill")
+    ap.add_argument("--skip-lease", action="store_true",
+                    help="skip the kill-with-leases-outstanding drill")
+    ap.add_argument("--only-lease", action="store_true",
+                    help="run ONLY the lease drill (the CI lease-smoke "
+                         "job's fast path)")
     # child-role flags (used with --serve)
     ap.add_argument("--standby-of", default=None)
     ap.add_argument("--promote-after-ms", type=float, default=None)
@@ -894,6 +1116,7 @@ def main() -> None:
     ap.add_argument("--repl-interval-ms", type=float, default=None)
     ap.add_argument("--count", type=float, default=1e9)
     ap.add_argument("--bucket-ms", type=int, default=100)
+    ap.add_argument("--lease-ttl-ms", type=float, default=500.0)
     args = ap.parse_args()
     if args.serve:
         _serve_forever(args)
@@ -902,6 +1125,24 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
     t0 = time.time()
+    if args.only_lease:
+        doc = {"lease": run_lease_drill()}
+        doc["failures"] = doc["lease"]["failures"]
+        doc["wall_s"] = round(time.time() - t0, 1)
+        print(json.dumps(doc, indent=2))
+        if doc["failures"]:
+            print(f"LEASE DRILL FAILED: {doc['failures']}", file=sys.stderr)
+            sys.exit(1)
+        lease = doc["lease"]
+        print(
+            f"lease drill ok: over-admitted {lease['over_admission']} of "
+            f"{lease['window_tokens']:.0f} window tokens against an "
+            f"outstanding-lease bound of "
+            f"{lease['outstanding_tokens_at_kill']} "
+            f"({lease['local_admits']} client-local admits survived the "
+            f"kill, standby blocked {lease['standby_blocks']}x)"
+        )
+        return
     doc = run_drill(deadline_ms=args.deadline_ms)
     if not args.skip_replication:
         doc["replication"] = run_replication_drill()
@@ -909,6 +1150,9 @@ def main() -> None:
     if not args.skip_rebalance:
         doc["rebalance"] = run_rebalance_drill()
         doc["failures"] = doc["failures"] + doc["rebalance"]["failures"]
+    if not args.skip_lease:
+        doc["lease"] = run_lease_drill()
+        doc["failures"] = doc["failures"] + doc["lease"]["failures"]
     if not args.skip_overload:
         doc["overload"] = run_overload_drill()
         doc["failures"] = doc["failures"] + doc["overload"]["failures"]
@@ -945,6 +1189,16 @@ def main() -> None:
             f"{reb['requests_raised']} raised), abort atomic="
             f"{reb['abort_atomic']}, live move {reb['move_wall_ms']}ms, "
             f"{reb['epochs_crossed']} epoch(s) crossed"
+        )
+    if "lease" in doc:
+        lease = doc["lease"]
+        print(
+            f"lease drill ok: over-admitted {lease['over_admission']} of "
+            f"{lease['window_tokens']:.0f} window tokens against an "
+            f"outstanding-lease bound of "
+            f"{lease['outstanding_tokens_at_kill']} "
+            f"({lease['local_admits']} client-local admits survived the "
+            f"kill, standby blocked {lease['standby_blocks']}x)"
         )
     if "overload" in doc:
         ovl = doc["overload"]

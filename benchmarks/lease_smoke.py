@@ -1,0 +1,119 @@
+"""Lease amortization smoke gate (CI): >=10x fewer RPCs per decision.
+
+One small in-process token server; two closed-loop single-decision runs
+through ``TokenClient`` over the SAME seeded Zipfian flow stream
+(``serve_client.run_lease``): leases off (the PR-10 wire shape — one RPC
+per decision), then leases on (wire rev 5 — hot flows admit from
+client-local slices). The gate is the tentpole's acceptance number::
+
+    rpcs_per_decision(off) / rpcs_per_decision(on) >= 10
+
+on a Zipfian workload (alpha ~= 1.1). Exit code is nonzero on a violated
+gate so CI can run it directly::
+
+    JAX_PLATFORMS=cpu python benchmarks/lease_smoke.py
+
+The SIGKILL half of the lease story (crash over-admission bounded by the
+outstanding-lease sum) is ``ha_drill.py --only-lease``; the CI lease-smoke
+job runs both.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+GATE_RPC_REDUCTION = 10.0
+
+
+def run_smoke(seconds: float = 3.0, n_flows: int = 256, seed: int = 11,
+              alpha: float = 1.1, lease_want: int = 2048,
+              lease_ttl_ms: int = 10_000) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks.serve_client import run_lease
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    # TTL sized to the workload, as a deployment would (docs/PERF.md): a
+    # lease amortizes nothing if it expires between revisits of its flow,
+    # and the tail of even a hot Zipfian stream revisits slowly. 10s covers
+    # the run; the matching over-admission bound is want * outstanding
+    # flows, which the SIGKILL drill (ha_drill --only-lease) gates.
+    svc = DefaultTokenService(
+        EngineConfig(max_flows=n_flows, max_namespaces=4, batch_size=64),
+        lease_ttl_ms=lease_ttl_ms,
+    )
+    svc.load_rules(
+        [ClusterFlowRule(f, 1e9, ThresholdMode.GLOBAL)
+         for f in range(n_flows)],
+        ns_max_qps=1e12,
+    )
+    server = TokenServer(svc, port=0)
+    server.start()
+    failures = []
+    try:
+        off = run_lease(server.port, seconds, n_flows, seed, alpha=alpha,
+                        lease=False, lease_want=lease_want)
+        on = run_lease(server.port, seconds, n_flows, seed, alpha=alpha,
+                       lease=True, lease_want=lease_want)
+    finally:
+        server.stop()
+        svc.close()
+    reduction = off["rpcs_per_decision"] / max(on["rpcs_per_decision"], 1e-9)
+    if off["decisions"] <= 0 or on["decisions"] <= 0:
+        failures.append("a run produced zero decisions")
+    if on["lease_stats"]["granted"] <= 0:
+        failures.append("the lease run never obtained a grant")
+    if reduction < GATE_RPC_REDUCTION:
+        failures.append(
+            f"rpc reduction {reduction:.1f}x below the "
+            f"{GATE_RPC_REDUCTION:.0f}x gate "
+            f"(off {off['rpcs_per_decision']}, on {on['rpcs_per_decision']})"
+        )
+    server_lease = svc.lease_stats()
+    return {
+        "zipf_alpha": alpha,
+        "n_flows": n_flows,
+        "seed": seed,
+        "off": off,
+        "on": on,
+        "rpc_reduction": round(reduction, 1),
+        "gate": GATE_RPC_REDUCTION,
+        "server_lease_stats": server_lease,
+        "failures": failures,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--flows", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--zipf-alpha", type=float, default=1.1)
+    args = ap.parse_args()
+    doc = run_smoke(seconds=args.seconds, n_flows=args.flows,
+                    seed=args.seed, alpha=args.zipf_alpha)
+    print(json.dumps(doc, indent=2))
+    if doc["failures"]:
+        print(f"LEASE SMOKE FAILED: {doc['failures']}", file=sys.stderr)
+        sys.exit(1)
+    print(
+        f"lease smoke ok: {doc['rpc_reduction']}x fewer RPCs/decision "
+        f"(off {doc['off']['rpcs_per_decision']} -> on "
+        f"{doc['on']['rpcs_per_decision']}, local admit rate "
+        f"{doc['on']['local_admit_rate']:.3f})"
+    )
+
+
+if __name__ == "__main__":
+    main()

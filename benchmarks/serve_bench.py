@@ -275,6 +275,41 @@ def operating_point(points) -> dict | None:
     return best
 
 
+def measure_lease(port: int, n_flows: int = 100_000, seconds: float = 4.0,
+                  seed: int = 0, alpha: float = 1.1,
+                  lease_want: int = 2048) -> dict | None:
+    """Per-decision-RPC cost, leases off vs on, on the SAME live server and
+    the SAME Zipfian flow stream (same seed → serve_client replays one
+    sequence). The ``rpc_reduction`` ratio is the wire-rev-5 headline: how
+    many per-decision RPCs the lease protocol deleted. Leases-off runs
+    first so the on-run cannot warm the off-run's flow rows. The stream
+    targets 1024 of the server's flows: a single closed-loop client can
+    keep ~1k leases warm against the production 500ms TTL (the gated
+    controlled-TTL variant is benchmarks/lease_smoke.py); folding a
+    Zipfian stream over all 100k rows would measure TTL churn, not the
+    protocol."""
+    lease_flows = min(n_flows, 1024)
+    common = ("--port", port, "--mode", "lease", "--seconds", seconds,
+              "--flows", lease_flows, "--seed", seed, "--zipf-alpha", alpha,
+              "--lease-want", lease_want)
+    off = _spawn_clients([common], timeout_s=seconds * 4 + 120)
+    on = _spawn_clients([(*common, "--lease")], timeout_s=seconds * 4 + 120)
+    if not off or not on:
+        return None
+    off, on = off[0], on[0]
+    denom = max(on["rpcs_per_decision"], 1e-9)
+    return {
+        "zipf_alpha": alpha,
+        "lease_want": lease_want,
+        "off": off,
+        "on": on,
+        "rpcs_per_decision_off": off["rpcs_per_decision"],
+        "rpcs_per_decision_on": on["rpcs_per_decision"],
+        "rpc_reduction": round(off["rpcs_per_decision"] / denom, 1),
+        "local_admit_rate": on["local_admit_rate"],
+    }
+
+
 def measure_ha(deadline_ms: float = 500.0,
                fallback_probes: int = 400) -> dict:
     """Lightweight in-process failover probe for the bench artifact: two
@@ -465,6 +500,14 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                            2_000_000, 3_000_000)
         curve = run_sweep(server.port, sweep_rates, n_flows=n_flows,
                           deadline_ts=deadline_ts)
+        # lease amortization on the live server: per-decision RPCs with the
+        # rev-5 protocol off vs on, same Zipfian stream. Never aborts the
+        # measurement — a broken probe surfaces as lease=None.
+        try:
+            lease_block = measure_lease(server.port, n_flows=n_flows)
+        except Exception as e:
+            print(f"serve_bench: lease probe failed: {e!r}", file=sys.stderr)
+            lease_block = None
         # same-host service ceiling (no TCP) for the front-door ratio
         rng = np.random.default_rng(0)
         ids = rng.integers(0, n_flows, size=max_batch).astype(np.int64)
@@ -587,6 +630,7 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
             closed["verdicts_per_sec"] / ceiling, 3
         ) if ceiling else None,
         "ha": ha,
+        "lease": lease_block,
         **({"mesh": mesh_block} if mesh_block else {}),
         **({"single_door_baseline": baseline,
             "sharding_speedup": round(

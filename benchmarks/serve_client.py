@@ -220,6 +220,76 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
     }
 
 
+def zipf_flow_sequence(n_flows: int, alpha: float, size: int,
+                       seed: int) -> np.ndarray:
+    """Deterministic BOUNDED-Zipfian flow-id stream: rank k in
+    [1, n_flows] drawn ∝ k^-alpha, flow id = rank - 1. Bounded, not
+    ``rng.zipf`` folded with a modulo: for alpha near 1 the unbounded tail
+    holds most of the mass (>50% of draws past rank 256 at alpha=1.1), and
+    folding it spreads that mass uniformly over the flows — a uniform
+    workload wearing a Zipfian label. The on/off lease comparison replays
+    the SAME stream (same seed), so any RPC difference is the protocol's,
+    not the workload's."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_flows + 1, dtype=np.float64)
+    p = ranks ** -alpha
+    p /= p.sum()
+    return rng.choice(n_flows, size=size, p=p)
+
+
+def run_lease(port: int, seconds: float, n_flows: int, seed: int,
+              alpha: float = 1.1, lease: bool = False,
+              lease_want: int = 256, timeout_ms: int = 200) -> dict:
+    """Single-decision closed loop through ``TokenClient`` over a Zipfian
+    flow stream — the per-decision-RPC measurement (wire rev 5). With
+    ``lease=False`` every decision is one RPC (the PR-10 baseline shape);
+    with ``lease=True`` hot flows admit from client-local lease slices and
+    ``rpcs_per_decision`` records what is left. The warmup decision (jit
+    compile, connection, ping) happens before the RPC counter snapshot so
+    the ratio measures steady state."""
+    from sentinel_tpu.cluster.client import TokenClient
+
+    flows = zipf_flow_sequence(n_flows, alpha, 200_000, seed)
+    client = TokenClient("127.0.0.1", port, timeout_ms=timeout_ms,
+                         lease=lease, lease_want=lease_want)
+    decisions = ok = 0
+    try:
+        client.request_token(int(flows[0]))  # warmup: compile + connect
+        stats0 = client.lease_stats()
+        k = 1
+        t0 = time.perf_counter()
+        stop_at = t0 + seconds
+        while time.perf_counter() < stop_at:
+            r = client.request_token(int(flows[k % flows.size]))
+            k += 1
+            decisions += 1
+            if r is not None and r.ok:
+                ok += 1
+        wall = time.perf_counter() - t0
+        stats1 = client.lease_stats()
+    finally:
+        client.close()
+    rpcs = int(stats1["rpcs"] - stats0["rpcs"])
+    local = int(stats1["local_admits"] - stats0["local_admits"])
+    return {
+        "lease": bool(lease),
+        "zipf_alpha": alpha,
+        "n_flows": n_flows,
+        "decisions": decisions,
+        "verdicts_ok": ok,
+        "wall_s": round(wall, 3),
+        "decisions_per_sec": round(decisions / max(wall, 1e-9)),
+        "rpcs": rpcs,
+        "rpcs_per_decision": round(rpcs / max(decisions, 1), 5),
+        "local_admit_rate": round(local / max(decisions, 1), 5),
+        "lease_stats": {
+            k: int(stats1[k] - stats0.get(k, 0))
+            for k in ("granted", "renewed", "returned", "refused",
+                      "expired", "local_admits", "wire_rows")
+        },
+    }
+
+
 def _pow2_at_least(n: int) -> int:
     """Smallest power of two >= max(n, 16) (ring slot-count constraint)."""
     p = 16
@@ -380,7 +450,8 @@ def run_open(port: int, batch: int, rate: float, seconds: float,
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, required=True)
-    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--mode", choices=("closed", "open", "lease"),
+                    default="closed")
     ap.add_argument("--transport", choices=("tcp", "shm"), default="tcp")
     ap.add_argument("--shm-dir", default=None,
                     help="shared-memory ring directory (transport=shm)")
@@ -391,10 +462,17 @@ def main() -> None:
     ap.add_argument("--rate", type=float, default=100_000.0)
     ap.add_argument("--window", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--zipf-alpha", type=float, default=1.1)
+    ap.add_argument("--lease", action="store_true")
+    ap.add_argument("--lease-want", type=int, default=256)
     args = ap.parse_args()
     if args.transport == "shm" and not args.shm_dir:
         ap.error("--transport shm requires --shm-dir")
-    if args.mode == "closed":
+    if args.mode == "lease":
+        out = run_lease(args.port, args.seconds, args.flows, args.seed,
+                        alpha=args.zipf_alpha, lease=args.lease,
+                        lease_want=args.lease_want)
+    elif args.mode == "closed":
         out = run_closed(args.port, args.batch, args.pipeline, args.seconds,
                          args.flows, args.seed, transport=args.transport,
                          shm_dir=args.shm_dir)

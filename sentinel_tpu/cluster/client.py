@@ -80,9 +80,40 @@ class _Pending:
         self.response: Optional[P.FlowResponse] = None
 
 
+# client-side lease safety margin: stop admitting from a lease at 90% of
+# its TTL so a verdict granted locally is never newer than the server's
+# idea of the lease's life (clock-rate skew over a 500ms TTL is noise,
+# but the margin also absorbs the renew RPC's latency)
+_LEASE_EXPIRY_SAFETY = 0.9
+# renew-ahead point: refresh at ~45% of TTL (or half the tokens spent,
+# whichever comes first) so the replacement slice lands before exhaustion
+_LEASE_RENEW_AT = 0.45
+
+
+class _FlowLease:
+    """One cached wire-rev-5 lease: the client-local admission budget for
+    a flow. ``used`` only grows under the client's lease lock; the renew
+    path retires the object from the cache *first* and reports that final
+    ``used``, so tokens are never spent from a slice after its unused part
+    was credited back (conservation, client side)."""
+
+    __slots__ = ("lease_id", "tokens", "used", "expiry", "renew_at",
+                 "renewing")
+
+    def __init__(self, lease_id: int, tokens: int, used: int,
+                 now: float, ttl_ms: int):
+        self.lease_id = int(lease_id)
+        self.tokens = int(tokens)
+        self.used = int(used)
+        self.expiry = now + ttl_ms * _LEASE_EXPIRY_SAFETY / 1000.0
+        self.renew_at = now + ttl_ms * _LEASE_RENEW_AT / 1000.0
+        self.renewing = False
+
+
 class TokenClient(TokenService):
     def __init__(self, host: str, port: int, timeout_ms: int = 20,
-                 namespace: str = "default"):
+                 namespace: str = "default", lease: bool = False,
+                 lease_want: int = 256, lease_backoff_s: float = 0.1):
         self.host = host
         self.port = port
         self.timeout_ms = timeout_ms
@@ -106,6 +137,25 @@ class TokenClient(TokenService):
         self._reconnect_max_s = SentinelConfig.get_float(
             "sentinel.tpu.client.reconnect.max.s", RECONNECT_MAX_S
         )
+        # wire rev 5 client-local admission: when enabled, hot flows admit
+        # from a cached short-TTL lease instead of one RPC per decision.
+        # The first miss grants synchronously (that RPC replaces the
+        # decision RPC 1:1); renew-ahead refreshes in the background; any
+        # refusal (NOT_LEASABLE, NO_RULE, MOVED, transport failure) backs
+        # the flow off and the caller falls back to the per-request path —
+        # leasing can only remove RPCs, never verdicts.
+        self.lease_enabled = bool(lease)
+        self.lease_want = max(1, int(lease_want))
+        self._lease_backoff_s = float(lease_backoff_s)
+        self._lease_lock = threading.Lock()
+        self._leases: Dict[int, _FlowLease] = {}
+        self._lease_backoff: Dict[int, float] = {}  # flow → retry-after mono
+        self._lease_inflight: set = set()  # flows with a grant/renew RPC out
+        self._lease_counts = {
+            "granted": 0, "renewed": 0, "returned": 0, "refused": 0,
+            "expired": 0, "local_admits": 0, "wire_rows": 0,
+        }
+        self._rpcs = 0  # wire round trips (request/lease/ping/batch chunks)
 
     @property
     def consecutive_failures(self) -> int:
@@ -190,6 +240,7 @@ class TokenClient(TokenService):
                 pending.event.set()
 
     def close(self) -> None:
+        self._return_leases()  # best-effort: unused tokens go back early
         sock = self._sock
         if sock is not None:
             self._drop_connection(sock)
@@ -241,7 +292,15 @@ class TokenClient(TokenService):
                         break
                     payload = view[r + 2 : r + 2 + ln]
                     r += 2 + ln
-                    if P.peek_type(payload) == P.MsgType.BATCH_FLOW:
+                    mtype = P.peek_type(payload)
+                    if mtype in P.LEASE_TYPES:
+                        rsp = P.decode_lease_response(bytes(payload))
+                        pending = self._pending.get(rsp.xid)
+                        if pending is not None:
+                            pending.response = rsp
+                            pending.event.set()
+                        continue
+                    if mtype == P.MsgType.BATCH_FLOW:
                         # copy + store the raw payload; the waiting thread
                         # decodes (spreads the vectorized decode across
                         # callers). Frames whose waiter already gave up
@@ -274,6 +333,12 @@ class TokenClient(TokenService):
 
     # -- TokenService -------------------------------------------------------
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
+        if self.lease_enabled:
+            local = self._lease_admit(int(flow_id), int(acquire))
+            if local is not None:
+                return local
+        with self._lease_lock:
+            self._lease_counts["wire_rows"] += 1
         rsp = self._roundtrip(
             P.FlowRequest(next(self._xid), flow_id, acquire, prioritized)
         )
@@ -283,6 +348,178 @@ class TokenClient(TokenService):
             TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms,
             endpoint=rsp.endpoint,
         )
+
+    # -- wire rev 5: client-local admission ---------------------------------
+    def _lease_admit(self, flow_id: int, acquire: int) -> Optional[TokenResult]:
+        """Admit ``acquire`` tokens from the flow's cached lease, or try to
+        obtain one (the grant/renew RPC replaces this decision's RPC 1:1).
+        ``None`` means no usable lease — the caller takes the per-request
+        wire path, so leasing never loses a verdict."""
+        if acquire <= 0:
+            return None
+        now = time.monotonic()
+        stale = None
+        with self._lease_lock:
+            lease = self._leases.get(flow_id)
+            if lease is not None:
+                if now >= lease.expiry:
+                    del self._leases[flow_id]
+                    self._lease_counts["expired"] += 1
+                elif lease.used + acquire <= lease.tokens:
+                    lease.used += acquire
+                    self._lease_counts["local_admits"] += 1
+                    kick = (
+                        not lease.renewing
+                        and (now >= lease.renew_at
+                             or 2 * lease.used >= lease.tokens)
+                    )
+                    if kick:
+                        lease.renewing = True
+                    remaining = lease.tokens - lease.used
+                    if kick:
+                        self._spawn_renew(flow_id)
+                    return TokenResult(TokenStatus.OK, remaining)
+                elif not lease.renewing:
+                    # exhausted before the renew-ahead fired: retire it and
+                    # renew inline below (credit + regrant, one RPC)
+                    del self._leases[flow_id]
+                    stale = lease
+            if stale is None:
+                if now < self._lease_backoff.get(flow_id, 0.0):
+                    return None
+                if flow_id in self._lease_inflight:
+                    return None  # another thread is granting; go to wire
+            self._lease_inflight.add(flow_id)
+        try:
+            if stale is not None:
+                rsp = self._lease_roundtrip(
+                    P.MsgType.LEASE_RENEW, flow_id,
+                    want=max(acquire, self.lease_want),
+                    lease_id=stale.lease_id, used=stale.used,
+                )
+                return self._install_lease(flow_id, rsp, acquire, "renewed")
+            rsp = self._lease_roundtrip(
+                P.MsgType.LEASE_GRANT, flow_id,
+                want=max(acquire, self.lease_want),
+            )
+            return self._install_lease(flow_id, rsp, acquire, "granted")
+        finally:
+            with self._lease_lock:
+                self._lease_inflight.discard(flow_id)
+
+    def _install_lease(
+        self, flow_id: int, rsp, acquire: int, stat: str
+    ) -> Optional[TokenResult]:
+        """Install a grant/renew response into the cache and admit
+        ``acquire`` from it; ``None`` (fall back to wire) on refusal,
+        transport failure, or a slice too small for this acquire."""
+        now = time.monotonic()
+        with self._lease_lock:
+            if rsp is None or rsp.status != 0 or rsp.tokens <= 0:
+                if rsp is not None:
+                    self._lease_counts["refused"] += 1
+                self._lease_backoff[flow_id] = now + self._lease_backoff_s
+                return None
+            self._lease_backoff.pop(flow_id, None)
+            self._lease_counts[stat] += 1
+            if acquire <= 0:
+                # background renew: install the fresh slice, nothing to admit
+                self._leases[flow_id] = _FlowLease(
+                    rsp.lease_id, rsp.tokens, 0, now, rsp.ttl_ms
+                )
+                return None
+            if rsp.tokens < acquire:
+                # slice smaller than this acquire: keep it for smaller
+                # acquires, decide this one over the wire
+                self._leases[flow_id] = _FlowLease(
+                    rsp.lease_id, rsp.tokens, 0, now, rsp.ttl_ms
+                )
+                return None
+            self._leases[flow_id] = _FlowLease(
+                rsp.lease_id, rsp.tokens, acquire, now, rsp.ttl_ms
+            )
+            self._lease_counts["local_admits"] += 1
+            return TokenResult(TokenStatus.OK, rsp.tokens - acquire)
+
+    def _spawn_renew(self, flow_id: int) -> None:
+        threading.Thread(
+            target=self._renew_flow, args=(flow_id,), daemon=True,
+            name="sentinel-lease-renew",
+        ).start()
+
+    def _renew_flow(self, flow_id: int) -> None:
+        """Background renew-ahead: retire the cached lease FIRST (so no
+        token is spent from it after its unused part is reported), then
+        credit + regrant in one RPC. While the RPC is in flight, admits
+        for the flow fall back to the wire — a bounded, tiny window."""
+        with self._lease_lock:
+            lease = self._leases.pop(flow_id, None)
+            if lease is None:
+                return
+            self._lease_inflight.add(flow_id)
+        try:
+            rsp = self._lease_roundtrip(
+                P.MsgType.LEASE_RENEW, flow_id, want=self.lease_want,
+                lease_id=lease.lease_id, used=lease.used,
+            )
+            self._install_lease(flow_id, rsp, 0, "renewed")
+        finally:
+            with self._lease_lock:
+                self._lease_inflight.discard(flow_id)
+
+    def _lease_roundtrip(
+        self, msg_type, flow_id: int, want: int = 0,
+        lease_id: int = 0, used: int = 0,
+    ):
+        """Correlated lease RPC; returns ``P.LeaseResponse`` or None."""
+        xid = next(self._xid)
+        pending = _Pending()
+        self._pending[xid] = pending
+        try:
+            frame = P.encode_lease_request(
+                xid, msg_type, flow_id, want, lease_id=lease_id, used=used
+            )
+            if not self._send(frame):
+                return None
+            self._count_rpc()
+            if not pending.event.wait(self.timeout_ms / 1000.0):
+                return None
+            rsp = pending.response
+            return rsp if isinstance(rsp, P.LeaseResponse) else None
+        finally:
+            self._pending.pop(xid, None)
+
+    def _return_leases(self) -> None:
+        """Best-effort LEASE_RETURN of every cached lease (close path):
+        unused tokens go back instead of expiring with the window."""
+        if not self.lease_enabled:
+            return
+        with self._lease_lock:
+            leases = list(self._leases.items())
+            self._leases.clear()
+        for flow_id, lease in leases:
+            rsp = self._lease_roundtrip(
+                P.MsgType.LEASE_RETURN, flow_id,
+                lease_id=lease.lease_id, used=lease.used,
+            )
+            if rsp is not None and rsp.status == 0:
+                with self._lease_lock:
+                    self._lease_counts["returned"] += 1
+
+    def _count_rpc(self) -> None:
+        with self._lease_lock:
+            self._rpcs += 1
+
+    def lease_stats(self) -> Dict[str, int]:
+        """Client-side lease counters for the bench artifact: cumulative
+        grant/renew/return/refusal counts, rows admitted locally vs sent
+        over the wire, cached leases, and total wire round trips (the
+        numerator of rpcs_per_decision)."""
+        with self._lease_lock:
+            out = dict(self._lease_counts)
+            out["cached"] = len(self._leases)
+            out["rpcs"] = self._rpcs
+            return out
 
     def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
         rsp = self._roundtrip(
@@ -324,14 +561,93 @@ class TokenClient(TokenService):
 
     def request_batch_arrays(self, flow_ids, counts=None, prios=None,
                              timeout_ms: Optional[int] = None):
-        """Array-in/array-out batched verdicts over BATCH_FLOW frames:
-        (status int8[N], remaining int32[N], wait_ms int32[N]) in request
-        order, or None on send failure/timeout.
+        """Array-in/array-out batched verdicts: (status int8[N], remaining
+        int32[N], wait_ms int32[N]) in request order, or None on send
+        failure/timeout.
 
-        Batches larger than one frame are **pipelined**: every chunk frame
-        is sent before the first response is awaited, so the server's
-        micro-batcher sees them back-to-back and a chunked batch costs one
-        round trip, not one per chunk.
+        With leasing enabled, rows of a flow whose cached lease covers the
+        flow's ENTIRE in-batch demand are admitted locally (zero wire
+        bytes); only the rest ride BATCH_FLOW frames. Lease consumption is
+        rolled back if the wire leg fails, so the None contract still means
+        "nothing was admitted"."""
+        import numpy as np
+
+        if not self.lease_enabled:
+            return self._wire_batch_arrays(flow_ids, counts, prios,
+                                           timeout_ms)
+        flow_ids = np.asarray(flow_ids, dtype=np.int64)
+        n = flow_ids.shape[0]
+        if n == 0:
+            e = np.empty(0, np.int32)
+            return np.empty(0, np.int8), e, e
+        acq = (np.ones(n, np.int64) if counts is None
+               else np.asarray(counts, np.int64))
+        local = np.zeros(n, bool)
+        remaining = np.zeros(n, np.int32)
+        now = time.monotonic()
+        taken = []  # (flow_id, amount, lease) for rollback
+        kicks = []
+        with self._lease_lock:
+            for fid in np.unique(flow_ids):
+                f = int(fid)
+                lease = self._leases.get(f)
+                if lease is None:
+                    continue
+                if now >= lease.expiry:
+                    del self._leases[f]
+                    self._lease_counts["expired"] += 1
+                    continue
+                rows = flow_ids == fid
+                demand = int(acq[rows].sum())
+                # all-or-nothing per flow: a partial cover would need
+                # per-row splits; those rows just ride the wire this time
+                if demand <= 0 or lease.used + demand > lease.tokens:
+                    continue
+                lease.used += demand
+                taken.append((f, demand, lease))
+                local[rows] = True
+                remaining[rows] = lease.tokens - lease.used
+                if not lease.renewing and (
+                    now >= lease.renew_at or 2 * lease.used >= lease.tokens
+                ):
+                    lease.renewing = True
+                    kicks.append(f)
+            n_local = int(local.sum())
+            self._lease_counts["local_admits"] += n_local
+        for f in kicks:
+            self._spawn_renew(f)
+        if n_local == n:
+            return (np.zeros(n, np.int8), remaining, np.zeros(n, np.int32))
+        widx = np.nonzero(~local)[0]
+        out = self._wire_batch_arrays(
+            flow_ids[widx],
+            None if counts is None else np.asarray(counts)[widx],
+            None if prios is None else np.asarray(prios)[widx],
+            timeout_ms,
+        )
+        if out is None:
+            if taken:
+                # un-admit the local rows: the caller retries the whole
+                # batch elsewhere, so nothing may stay spent here
+                with self._lease_lock:
+                    for f, amount, lease in taken:
+                        if self._leases.get(f) is lease:
+                            lease.used -= amount
+                    self._lease_counts["local_admits"] -= n_local
+            return None
+        if n_local == 0:
+            return out
+        status = np.zeros(n, np.int8)
+        wait = np.zeros(n, np.int32)
+        status[widx], remaining[widx], wait[widx] = out
+        return status, remaining, wait
+
+    def _wire_batch_arrays(self, flow_ids, counts=None, prios=None,
+                           timeout_ms: Optional[int] = None):
+        """The BATCH_FLOW wire path. Batches larger than one frame are
+        **pipelined**: every chunk frame is sent before the first response
+        is awaited, so the server's micro-batcher sees them back-to-back
+        and a chunked batch costs one round trip, not one per chunk.
         """
         import numpy as np
 
@@ -361,6 +677,9 @@ class TokenClient(TokenService):
                 )
                 if not self._send(frame):
                     return None
+                self._count_rpc()
+            with self._lease_lock:
+                self._lease_counts["wire_rows"] += n
             status = np.empty(n, np.int8)
             remaining = np.empty(n, np.int32)
             wait = np.empty(n, np.int32)
@@ -436,6 +755,7 @@ class TokenClient(TokenService):
         try:
             if not self._send(P.encode_request(req)):
                 return None
+            self._count_rpc()
             if not pending.event.wait(self.timeout_ms / 1000.0):
                 return None  # timeout → caller falls back (20ms budget blown)
             return pending.response

@@ -68,6 +68,30 @@ destination over the same wire, two-phase:
 - a ``MOVED`` (= 10) status on the single-request response path appends
   the new owner's ``host:port`` endpoint as a UTF-8 trailer; batch rows
   stay fixed-size and carry the shard-map epoch in ``remaining``.
+
+Codec rev 5 — token-lease frames (client-local admission): the token
+service grants a client a short-TTL slice of a flow's window; the client
+admits locally from the lease and reports usage on renew/return. All
+three request types share ONE fixed layout (simpler codec, one fuzz
+surface)::
+
+    | lease_id: int64 | flow_id: int64 | used: int32 | want: int32 |
+
+- ``LEASE_GRANT``: ``lease_id``/``used`` are 0; ``want`` is the token
+  count requested.
+- ``LEASE_RENEW``: reports ``used`` tokens consumed from ``lease_id``
+  since the last report (the server credits the unused remainder when
+  provably still in-window) and asks for a fresh ``want``-token slice.
+- ``LEASE_RETURN``: final usage report; ``want`` is 0.
+
+Responses share one layout too: ``status:int8, lease_id:int64,
+tokens:int32, ttl_ms:int32`` — ``status`` is a ``TokenStatus`` byte. OK
+carries a live lease; NOT_LEASABLE (= 11) is the refusal (flow not
+leasable, no headroom, lease revoked) telling the client to fall back to
+per-request RPCs and back off leasing this flow; MOVED appends the new
+owner's endpoint as the rev-4 UTF-8 trailer. Both doors route the lease
+type bytes to the token service's host-side lease handler (the C++ door
+forwards non-data-plane bytes untouched, so no native rebuild).
 """
 
 from __future__ import annotations
@@ -123,6 +147,10 @@ class MsgType(enum.IntEnum):
     MOVE_STATE = 11
     MOVE_COMMIT = 12
     MOVE_ABORT = 13
+    # codec rev 5: client-local admission leases
+    LEASE_GRANT = 14
+    LEASE_RENEW = 15
+    LEASE_RETURN = 16
 
 
 # front doors route these type bytes to the replication applier instead of
@@ -138,10 +166,19 @@ MOVE_TYPES = frozenset(
      MsgType.MOVE_ABORT}
 )
 
+# rev-5 lease frames route to the token service's host-side lease handler
+# on both doors (cheap control-plane ops answered inline, never batched)
+LEASE_TYPES = frozenset(
+    {MsgType.LEASE_GRANT, MsgType.LEASE_RENEW, MsgType.LEASE_RETURN}
+)
+
 # TokenStatus.MOVED — mirrored here as a bare int because this module must
 # stay importable without jax (socket-only processes); decode_response keys
 # the endpoint trailer on it
 MOVED_STATUS = 10
+# TokenStatus.NOT_LEASABLE, mirrored for the same reason: the rev-5 lease
+# refusal (flow not leasable / no headroom / lease revoked)
+NOT_LEASABLE_STATUS = 11
 
 
 class ReplAck(enum.IntEnum):
@@ -667,6 +704,87 @@ def decode_move_ctrl(payload: bytes):
     namespace = payload[off : off + ns_len].decode("utf-8", errors="replace")
     peer = payload[off + ns_len :].decode("utf-8", errors="replace")
     return xid, epoch, namespace, peer
+
+
+# -- codec rev 5: lease frames ------------------------------------------------
+_LEASE_REQ = struct.Struct(">qqii")  # lease_id, flow_id, used, want
+_LEASE_RSP = struct.Struct(">bqii")  # status, lease_id, tokens, ttl_ms
+
+
+@dataclass(frozen=True)
+class LeaseResponse:
+    """Decoded rev-5 lease answer (grant/renew/return share the layout)."""
+
+    xid: int
+    msg_type: MsgType
+    status: int
+    lease_id: int = 0
+    tokens: int = 0
+    ttl_ms: int = 0
+    endpoint: str = ""  # MOVED only: the new owner's "host:port"
+
+
+def encode_lease_request(
+    xid: int, msg_type: int, flow_id: int, want: int,
+    lease_id: int = 0, used: int = 0,
+) -> bytes:
+    """LEASE_GRANT / LEASE_RENEW / LEASE_RETURN request frame."""
+    if msg_type not in (
+        MsgType.LEASE_GRANT, MsgType.LEASE_RENEW, MsgType.LEASE_RETURN
+    ):
+        raise ValueError(f"not a lease type: {msg_type}")
+    payload = _HEAD.pack(xid, msg_type) + _LEASE_REQ.pack(
+        lease_id, flow_id, used, want
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_lease_request(payload: bytes):
+    """Lease request payload → (xid, msg_type, lease_id, flow_id, used,
+    want). Raises ``ValueError`` on a runt or torn payload (the door drops
+    the connection, same contract as ``decode_request``)."""
+    if len(payload) < _HEAD.size + _LEASE_REQ.size:
+        raise ValueError("runt lease request frame")
+    xid, mtype = _HEAD.unpack_from(payload, 0)
+    if mtype not in (
+        MsgType.LEASE_GRANT, MsgType.LEASE_RENEW, MsgType.LEASE_RETURN
+    ):
+        raise ValueError(f"not a lease type: {mtype}")
+    lease_id, flow_id, used, want = _LEASE_REQ.unpack_from(payload, _HEAD.size)
+    return xid, MsgType(mtype), lease_id, flow_id, used, want
+
+
+def encode_lease_response(
+    xid: int, msg_type: int, status: int, lease_id: int = 0,
+    tokens: int = 0, ttl_ms: int = 0, endpoint: str = "",
+) -> bytes:
+    """Lease answer frame; a MOVED status appends the rev-4 endpoint
+    trailer so a redirected client learns the new owner in one round
+    trip."""
+    payload = _HEAD.pack(xid, msg_type) + _LEASE_RSP.pack(
+        int(status), lease_id, tokens, ttl_ms
+    )
+    if int(status) == MOVED_STATUS and endpoint:
+        payload += endpoint.encode("utf-8")[:256]
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_lease_response(payload: bytes) -> LeaseResponse:
+    """Lease answer payload → :class:`LeaseResponse`. Raises ``ValueError``
+    on a runt payload (client readers degrade to a dropped connection)."""
+    if len(payload) < _HEAD.size + _LEASE_RSP.size:
+        raise ValueError("runt lease response frame")
+    xid, mtype = _HEAD.unpack_from(payload, 0)
+    status, lease_id, tokens, ttl_ms = _LEASE_RSP.unpack_from(
+        payload, _HEAD.size
+    )
+    endpoint = ""
+    off = _HEAD.size + _LEASE_RSP.size
+    if status == MOVED_STATUS and len(payload) > off:
+        endpoint = payload[off:].decode("utf-8", errors="replace")
+    return LeaseResponse(
+        xid, MsgType(mtype), status, lease_id, tokens, ttl_ms, endpoint
+    )
 
 
 def encode_response(rsp: FlowResponse) -> bytes:

@@ -55,6 +55,15 @@ sums, not ring buckets (SF-sketch's fat-update/slim-query split applied to
 handoff): ring- and epoch-free, so the destination folds it into its own
 current bucket regardless of clock skew, and typically ~100× smaller than
 a full snapshot of the same rows.
+
+Leases (wire rev 5) cross a move as "transfer the charge, recall the
+lease": ``begin_move`` revokes the source's lease registry for the
+namespace (renewals answer MOVED and fall back to per-request RPCs), while
+the LEASED event column — the full delegated charge — rides ``flow_sums``
+to the destination like any other window sum. The destination therefore
+keeps counting every outstanding delegated token against the global limit
+from its first imported window, and clients re-grant fresh leases there;
+no lease survives a move, but no delegated token escapes accounting.
 """
 
 from __future__ import annotations

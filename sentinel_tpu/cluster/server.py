@@ -211,6 +211,60 @@ class _LoopWorker:
                             return
                         await writer.drain()
                         continue
+                    if mtype in P.LEASE_TYPES:
+                        # wire rev 5 (client-local admission): lease ops are
+                        # control-plane-rare (one per TTL per hot flow), so
+                        # they skip the micro-batch queue and run the
+                        # service's host-side grant/renew/return directly —
+                        # to_thread keeps the device fold off the event loop
+                        try:
+                            (xid, lmt, lease_id, lflow, used, want) = (
+                                P.decode_lease_request(payload)
+                            )
+                        except Exception:
+                            record_log.warning(
+                                "bad lease frame from client; closing"
+                            )
+                            return
+                        srv.connections.touch(address)
+                        if srv.is_standby:
+                            # proof-of-life refusal, same contract as the
+                            # decision path: the client falls back to
+                            # per-request RPCs and the failover layer never
+                            # evicts this endpoint
+                            writer.write(P.encode_lease_response(
+                                xid, lmt, _STANDBY
+                            ))
+                            await writer.drain()
+                            continue
+                        lease_fn = getattr(srv.service, "lease_grant", None)
+                        if lease_fn is None:
+                            # SPI impl without leases: refuse, don't die
+                            writer.write(P.encode_lease_response(
+                                xid, lmt, P.NOT_LEASABLE_STATUS
+                            ))
+                            await writer.drain()
+                            continue
+                        if lmt == P.MsgType.LEASE_GRANT:
+                            res = await asyncio.to_thread(
+                                srv.service.lease_grant, lflow, want
+                            )
+                        elif lmt == P.MsgType.LEASE_RENEW:
+                            res = await asyncio.to_thread(
+                                srv.service.lease_renew,
+                                lease_id, lflow, used, want,
+                            )
+                        else:
+                            res = await asyncio.to_thread(
+                                srv.service.lease_return, lease_id, used
+                            )
+                        writer.write(P.encode_lease_response(
+                            xid, lmt, int(res.status),
+                            lease_id=res.lease_id, tokens=res.tokens,
+                            ttl_ms=res.ttl_ms, endpoint=res.endpoint,
+                        ))
+                        await writer.drain()
+                        continue
                     if mtype == P.MsgType.BATCH_FLOW:
                         # vectorized decode; no per-request Python objects
                         try:

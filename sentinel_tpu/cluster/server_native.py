@@ -1079,6 +1079,17 @@ class NativeTokenServer:
                 sess.closed()
                 door.close_conn(fd, gen)
             return
+        # rev-5 lease frames ride the control lane too (one per TTL per hot
+        # flow — never on the per-decision path, which is the whole point)
+        if len(payload) >= 5 and P.peek_type(payload) in P.LEASE_TYPES:
+            try:
+                rsp_bytes = self._handle_lease(payload, address)
+            except ValueError:
+                record_log.warning("bad lease frame; closing %s", address)
+                door.close_conn(fd, gen)
+                return
+            door.send(fd, gen, rsp_bytes)
+            return
         try:
             req = P.decode_request(payload)
         except Exception:
@@ -1095,6 +1106,40 @@ class NativeTokenServer:
                 int(TokenStatus.FAIL),
             )
         door.send(fd, gen, P.encode_response(rsp))
+
+    def _handle_lease(self, payload, address: str) -> bytes:
+        """Wire rev 5: decode a lease request, run the service's host-side
+        grant/renew/return, encode the reply. Raises ValueError on a torn
+        frame (caller closes the connection — the containment contract)."""
+        xid, lmt, lease_id, flow_id, used, want = (
+            P.decode_lease_request(payload)
+        )
+        self.connections.touch(address)
+        if self.is_standby:
+            # proof-of-life refusal, same as the decision path: the client
+            # falls back to per-request RPCs, the breaker records success
+            return P.encode_lease_response(xid, lmt, _STANDBY)
+        service = self.service
+        if getattr(service, "lease_grant", None) is None:
+            return P.encode_lease_response(
+                xid, lmt, P.NOT_LEASABLE_STATUS
+            )
+        try:
+            if lmt == P.MsgType.LEASE_GRANT:
+                res = service.lease_grant(flow_id, want)
+            elif lmt == P.MsgType.LEASE_RENEW:
+                res = service.lease_renew(lease_id, flow_id, used, want)
+            else:
+                res = service.lease_return(lease_id, used)
+        except Exception:
+            record_log.exception("lease op failed")
+            return P.encode_lease_response(
+                xid, lmt, int(TokenStatus.FAIL)
+            )
+        return P.encode_lease_response(
+            xid, lmt, int(res.status), lease_id=res.lease_id,
+            tokens=res.tokens, ttl_ms=res.ttl_ms, endpoint=res.endpoint,
+        )
 
     def _handle_control(self, req, address: str) -> P.FlowResponse:
         service = self.service

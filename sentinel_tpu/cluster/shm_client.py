@@ -53,8 +53,12 @@ class ShmTokenClient(TokenClient):
 
     def __init__(self, shm_dir: str, timeout_ms: int = 20,
                  namespace: str = "default", slot_payload: int = 65536,
-                 n_slots: int = 16, spin_us: Optional[int] = None):
-        super().__init__(f"shm:{shm_dir}", -1, timeout_ms, namespace)
+                 n_slots: int = 16, spin_us: Optional[int] = None,
+                 lease: bool = False, lease_want: int = 256,
+                 lease_backoff_s: float = 0.1):
+        super().__init__(f"shm:{shm_dir}", -1, timeout_ms, namespace,
+                         lease=lease, lease_want=lease_want,
+                         lease_backoff_s=lease_backoff_s)
         self.shm_dir = shm_dir
         self.slot_payload = slot_payload
         self.n_slots = n_slots
@@ -118,6 +122,7 @@ class ShmTokenClient(TokenClient):
                 pending.event.set()
 
     def close(self) -> None:
+        self._return_leases()  # best-effort conservation, same as TCP
         ring = self._ring
         if ring is not None:
             self._drop_ring(ring)
@@ -142,14 +147,18 @@ class ShmTokenClient(TokenClient):
                     payload = chaos.mangle("frame_corrupt", payload)
                 _count_recv(len(payload))
                 try:
-                    if P.peek_type(payload) == P.MsgType.BATCH_FLOW:
+                    mtype = P.peek_type(payload)
+                    if mtype == P.MsgType.BATCH_FLOW:
                         xid = int.from_bytes(payload[:4], "big", signed=True)
                         pending = self._pending.get(xid)
                         if pending is not None:
                             pending.response = bytes(payload)
                             pending.event.set()
                         continue
-                    rsp = P.decode_response(bytes(payload))
+                    if mtype in P.LEASE_TYPES:
+                        rsp = P.decode_lease_response(bytes(payload))
+                    else:
+                        rsp = P.decode_response(bytes(payload))
                 except Exception:
                     # corrupt server bytes degrade to a dropped connection,
                     # never a dead reader with a traceback (TCP contract)

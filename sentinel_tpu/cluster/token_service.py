@@ -14,6 +14,7 @@ Both deployment shapes of the reference exist here:
 
 from __future__ import annotations
 
+import itertools
 import threading
 from dataclasses import dataclass
 from functools import partial
@@ -189,6 +190,49 @@ class TokenService:
         raise NotImplementedError
 
 
+@dataclass(frozen=True)
+class LeaseResult:
+    """Outcome of a wire-rev-5 lease operation (grant/renew/return).
+
+    ``status`` is a TokenStatus code: OK carries a live lease
+    (``lease_id``/``tokens``/``ttl_ms``), NOT_LEASABLE means admit
+    per-request instead (no headroom, revoked, or leasing disabled),
+    NO_RULE_EXISTS / MOVED / STANDBY mean what they mean on the decision
+    path — MOVED fills ``endpoint`` with the new owner."""
+
+    status: int
+    lease_id: int = 0
+    tokens: int = 0
+    ttl_ms: int = 0
+    endpoint: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return int(self.status) == int(TokenStatus.OK)
+
+
+class _Lease:
+    """One outstanding lease: host registry entry only. The token charge
+    itself lives in the LEASED column of the flow window — the registry is
+    what lets renew/return credit unused tokens back and lets the drill
+    bound crash over-admission by ``outstanding_leases()``. Deliberately
+    NOT part of snapshots/deltas: a promoted standby starts with an empty
+    registry, renews become credit-less re-grants, and the charge (which
+    IS replicated) keeps the limit conservative."""
+
+    __slots__ = ("lease_id", "flow_id", "slot", "tokens", "granted_ms",
+                 "expiry_ms")
+
+    def __init__(self, lease_id, flow_id, slot, tokens, granted_ms,
+                 expiry_ms):
+        self.lease_id = int(lease_id)
+        self.flow_id = int(flow_id)
+        self.slot = int(slot)
+        self.tokens = int(tokens)
+        self.granted_ms = int(granted_ms)
+        self.expiry_ms = int(expiry_ms)
+
+
 class DefaultTokenService(TokenService):
     """Engine-backed token service.
 
@@ -206,6 +250,8 @@ class DefaultTokenService(TokenService):
         mesh=None,
         serve_buckets: Optional[Sequence[int]] = None,
         fuse_depths: Optional[Sequence[int]] = (8, 4, 2),
+        lease_ttl_ms: int = 500,
+        lease_fraction: float = 0.5,
     ):
         self.config = config or EngineConfig()
         # serving shape buckets: a lightly-loaded step pads to the smallest
@@ -285,6 +331,10 @@ class DefaultTokenService(TokenService):
         # namespace → flowId sets; the command surface edits one namespace
         # at a time while the device table always holds the union)
         self._rules_by_ns: Dict[str, Dict[int, ClusterFlowRule]] = {}
+        # flat flow_id → rule view of _rules_by_ns (same lifecycle): the
+        # lease grant path needs the rule's count/mode/namespace per call
+        # without walking namespaces
+        self._rule_of: Dict[int, ClusterFlowRule] = {}
         self._param_rules_src: Dict[int, "ClusterParamFlowRule"] = {}
         # namespaces this server explicitly serves (modifyNamespaceSet);
         # unioned with namespaces of loaded rules for info/fetchConfig
@@ -332,6 +382,28 @@ class DefaultTokenService(TokenService):
         # moving — the idle hot path pays one `is not None` check.
         self._moving: Dict[str, Tuple[str, int]] = {}
         self._moving_snap: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # wire rev 5 token leases: short-TTL client-local admission slices.
+        # A grant charges the whole slice into the LEASED event column of
+        # the flow window at grant time (pre-paid — see ClusterEvent.LEASED)
+        # and records it here so renew/return can credit unused tokens back.
+        # lease_fraction caps each grant at that share of the flow's CURRENT
+        # headroom, so k clients racing for leases geometrically share the
+        # window instead of the first one draining it; lease_ttl_ms bounds
+        # how long a crashed client's slice stays admitted-but-unobserved
+        # (the over-admission window). lease_fraction <= 0 disables leasing
+        # (every grant answers NOT_LEASABLE).
+        self.lease_ttl_ms = max(1, int(lease_ttl_ms))
+        self.lease_fraction = float(lease_fraction)
+        self._leases: Dict[int, _Lease] = {}
+        self._lease_seq = itertools.count(1)
+        self._lease_stats = {
+            "granted": 0, "renewed": 0, "returned": 0, "revoked": 0,
+        }
+        _SM.register_lease_provider(
+            lambda: (lambda s: s.lease_stats() if s is not None else {})(
+                _self()
+            )
+        )
 
     @staticmethod
     def _prep_batch(cfg, slots, acq, pr):
@@ -476,6 +548,7 @@ class DefaultTokenService(TokenService):
             for r in rules:
                 by_ns.setdefault(r.namespace, {})[r.flow_id] = r
             self._rules_by_ns = by_ns
+            self._rule_of = {r.flow_id: r for r in rules}
             table, self._index = build_rule_table(
                 self.config, rules, index=self._index,
                 ns_max_qps=self._ns_max_qps, connected=self._connected,
@@ -514,6 +587,21 @@ class DefaultTokenService(TokenService):
             self._state_gen += 1
             if self._dirty is not None:
                 self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
+            # leases pin flow_id → slot; a reload may have reassigned the
+            # slot or dropped the rule, so re-resolve every outstanding
+            # lease and revoke those whose rule vanished (their LEASED
+            # charge simply expires with the window — conservative)
+            if self._leases:
+                dead = []
+                for lid, lease in self._leases.items():
+                    slot = self._index.slot_of.get(lease.flow_id)
+                    if slot is None:
+                        dead.append(lid)
+                    else:
+                        lease.slot = int(slot)
+                for lid in dead:
+                    del self._leases[lid]
+                self._lease_stats["revoked"] += len(dead)
 
     def load_namespace_rules(
         self, namespace: str, rules: List[ClusterFlowRule]
@@ -1336,6 +1424,20 @@ class DefaultTokenService(TokenService):
                 )
             self._moving[namespace] = (str(endpoint), int(epoch))
             self._rebuild_moving_snap()
+            # recall the namespace's outstanding leases: registry entries
+            # drop here (renews now answer MOVED → clients fall back and
+            # re-grant at the destination) while the LEASED charge stays in
+            # the flow window, so the MOVE's window-sum export carries it to
+            # the new owner — "transfer the charge, recall the lease"
+            flows = set(self._rules_by_ns.get(namespace, ()))
+            if flows and self._leases:
+                dead = [
+                    lid for lid, l in self._leases.items()
+                    if l.flow_id in flows
+                ]
+                for lid in dead:
+                    del self._leases[lid]
+                self._lease_stats["revoked"] += len(dead)
 
     def abort_move(self, namespace: str) -> None:
         """Restore normal serving for ``namespace``. Lossless by
@@ -1376,6 +1478,191 @@ class DefaultTokenService(TokenService):
             if row < 0 or row >= len(names):
                 return None
             return self._moving.get(names[row])
+
+    # -- wire rev 5: token leases (client-local admission) -------------------
+    def _sweep_leases_locked(self, now: int) -> None:
+        """Drop leases past their TTL. Their LEASED charge stays in the flow
+        window and expires with it — a crashed client therefore causes
+        *under*-admission for up to one window, never over-admission.
+        Caller holds ``self._lock``."""
+        if not self._leases:
+            return
+        dead = [
+            lid for lid, l in self._leases.items() if now >= l.expiry_ms
+        ]
+        if dead:
+            for lid in dead:
+                del self._leases[lid]
+            self._lease_stats["revoked"] += len(dead)
+
+    def _credit_lease_locked(self, lease: _Lease, used: int) -> None:
+        """Credit a lease's unused tokens back into the EXACT ring bucket
+        its grant charged — but only when the start stamp proves that
+        bucket is still the grant's epoch. Charge and credit then rotate
+        out *together*, so a flow's LEASED window sum can never go net
+        negative (crediting into a *different* bucket could outlive the
+        charge and briefly over-admit). When the bucket has rotated (or
+        an engine-time rebase shifted the stamps) the credit is dropped
+        and the unused tokens expire with the window — the conservative
+        direction. Caller holds ``self._lock``."""
+        from sentinel_tpu.engine.state import ClusterEvent, flow_spec
+
+        unused = lease.tokens - max(0, int(used))
+        if unused <= 0:
+            return
+        spec = flow_spec(self.config)
+        idx = int((lease.granted_ms // spec.bucket_ms) % spec.n_buckets)
+        aligned = int(lease.granted_ms - lease.granted_ms % spec.bucket_ms)
+        ws = self._state.flow
+        if int(np.asarray(ws.starts)[idx]) != aligned:
+            return
+        counts = ws.counts.at[
+            lease.slot, idx, int(ClusterEvent.LEASED)
+        ].add(jnp.asarray(-unused, ws.counts.dtype))
+        self._state = self._state._replace(
+            flow=ws._replace(counts=counts)
+        )
+        if self._dirty is not None:
+            self._dirty["flow"].add(int(lease.slot))
+
+    def _lease_admit_locked(
+        self, flow_id: int, want: int, now: int, stat: str
+    ) -> LeaseResult:
+        """Grant core: prorate a slice of the flow's CURRENT headroom
+        (threshold − PASS − LEASED − matured borrows, the same occupancy
+        the device kernel reads), charge it into the LEASED column, and
+        register the lease. Caller holds ``self._lock`` and has swept."""
+        from sentinel_tpu.engine.rules import ThresholdMode
+        from sentinel_tpu.engine.state import (
+            N_CLUSTER_EVENTS, ClusterEvent, flow_spec,
+        )
+        from sentinel_tpu.stats import window as W
+
+        flow_id = int(flow_id)
+        rule = self._rule_of.get(flow_id)
+        if rule is None:
+            return LeaseResult(int(TokenStatus.NO_RULE_EXISTS))
+        mv = self._moving.get(rule.namespace)
+        if mv is not None:
+            # namespace mid-move or committed away: same redirect contract
+            # as the decision path — tokens carries the shard-map epoch
+            return LeaseResult(
+                int(TokenStatus.MOVED), tokens=int(mv[1]), endpoint=mv[0]
+            )
+        want = int(want)
+        if want <= 0 or self.lease_fraction <= 0.0:
+            return LeaseResult(int(TokenStatus.NOT_LEASABLE))
+        slot = self._index.slot_of.get(flow_id)
+        if slot is None:
+            return LeaseResult(int(TokenStatus.NO_RULE_EXISTS))
+        spec = flow_spec(self.config)
+        now32 = jnp.int32(now)
+        ids = jnp.asarray(np.asarray([slot], np.int32))
+        occupied = float(np.asarray(
+            W.window_sum_at(spec, self._state.flow, now32,
+                            int(ClusterEvent.PASS), ids)
+            + W.window_sum_at(spec, self._state.flow, now32,
+                              int(ClusterEvent.LEASED), ids)
+            + W.window_sum_at(spec, self._state.occupy, now32, 0, ids)
+        )[0])
+        # same per-window budget the device kernel enforces: rule count is
+        # per-second, scaled by connected clients under AVG_LOCAL
+        factor = (
+            max(1, int(self._connected.get(rule.namespace, 1)))
+            if rule.mode == ThresholdMode.AVG_LOCAL else 1
+        )
+        threshold = (
+            float(rule.count) * factor * self.config.exceed_count
+            * (spec.interval_ms / 1000.0)
+        )
+        grant = min(want, int((threshold - occupied) * self.lease_fraction))
+        if grant < 1:
+            return LeaseResult(int(TokenStatus.NOT_LEASABLE))
+        row = [0] * int(N_CLUSTER_EVENTS)
+        row[int(ClusterEvent.LEASED)] = grant
+        self._state = self._state._replace(
+            flow=self._fold_into_current(
+                self._state.flow, spec, now, [slot], [row]
+            )
+        )
+        if self._dirty is not None:
+            self._dirty["flow"].add(int(slot))
+        lease_id = next(self._lease_seq)
+        self._leases[lease_id] = _Lease(
+            lease_id, flow_id, slot, grant, now, now + self.lease_ttl_ms
+        )
+        self._lease_stats[stat] += 1
+        return LeaseResult(
+            int(TokenStatus.OK), lease_id=lease_id, tokens=grant,
+            ttl_ms=self.lease_ttl_ms,
+        )
+
+    def lease_grant(self, flow_id: int, want: int) -> LeaseResult:
+        """Grant a short-TTL local-admission slice of ``flow_id``'s window:
+        up to ``want`` tokens, capped at ``lease_fraction`` of the flow's
+        current headroom. The slice is pre-paid (charged to the LEASED
+        column now), so the client's local admissions never touch the
+        server and every replica's psum'd limit already accounts them."""
+        with self._lock:
+            now = self._engine_now()
+            self._sweep_leases_locked(now)
+            return self._lease_admit_locked(flow_id, want, now, "granted")
+
+    def lease_renew(
+        self, lease_id: int, flow_id: int, used: int, want: int
+    ) -> LeaseResult:
+        """Atomically credit the old lease's unused tokens and grant a
+        fresh slice. An unknown ``lease_id`` (expired, revoked, or a
+        promoted standby that never saw the grant) degrades to a
+        credit-less grant — no handshake needed after failover; the old
+        charge, wherever it lives, expires with its window."""
+        with self._lock:
+            now = self._engine_now()
+            self._sweep_leases_locked(now)
+            lease = self._leases.get(int(lease_id))
+            if lease is not None and lease.flow_id == int(flow_id):
+                del self._leases[int(lease_id)]
+                self._credit_lease_locked(lease, used)
+            return self._lease_admit_locked(flow_id, want, now, "renewed")
+
+    def lease_return(self, lease_id: int, used: int) -> LeaseResult:
+        """Give a lease back early, crediting its unused tokens. Idempotent:
+        returning an expired/revoked/unknown lease is OK (the charge simply
+        expires with the window)."""
+        with self._lock:
+            now = self._engine_now()
+            self._sweep_leases_locked(now)
+            lease = self._leases.pop(int(lease_id), None)
+            if lease is None:
+                return LeaseResult(int(TokenStatus.OK))
+            self._credit_lease_locked(lease, used)
+            self._lease_stats["returned"] += 1
+            return LeaseResult(int(TokenStatus.OK))
+
+    def outstanding_leases(self) -> int:
+        """Sum of tokens currently delegated on live leases — the bound on
+        crash over-admission (a dead client can have locally admitted at
+        most what it was granted and never reported back). The ha drill
+        gates against exactly this number at SIGKILL time."""
+        with self._lock:
+            self._sweep_leases_locked(self._engine_now())
+            return sum(l.tokens for l in self._leases.values())
+
+    def lease_stats(self) -> Dict[str, int]:
+        """Counter block behind the ``sentinel_lease_*`` series and the
+        bench artifact: cumulative granted/renewed/returned/revoked plus
+        the live outstanding gauge (leases and delegated tokens).
+        ``revoked`` covers every server-side end of life: TTL expiry,
+        rule-reload drop, and MOVE recall."""
+        with self._lock:
+            if self._leases:
+                self._sweep_leases_locked(self._engine_now())
+            out = dict(self._lease_stats)
+            out["outstanding"] = len(self._leases)
+            out["outstanding_tokens"] = sum(
+                l.tokens for l in self._leases.values()
+            )
+            return out
 
     @staticmethod
     def _fold_into_current(ws, spec, now: int, rows, sums):

@@ -73,6 +73,13 @@ class TokenStatus(enum.IntEnum):
     # failover client treats it as proof of life. Like OVERLOAD/STANDBY,
     # never produced by the device kernels.
     MOVED = 10
+    # wire rev 5 lease refusal: the flow is not leasable right now (no
+    # headroom to delegate, leasing disabled, or the named lease was
+    # revoked). The server is alive and still answers per-request RPCs —
+    # clients back off leasing for this flow and fall back to the RPC
+    # path; the failover client treats it as proof of life. Never produced
+    # by the device kernels.
+    NOT_LEASABLE = 11
 
 
 class RequestBatch(NamedTuple):
@@ -313,6 +320,10 @@ def _decide_core(
     passed = (
         W.window_sum_at(spec, state.flow, now, ClusterEvent.PASS, safe_slot)
         + W.window_sum_at(spec, state.occupy, now, 0, safe_slot)  # matured borrows
+        # wire rev 5: tokens delegated to clients as local-admission leases
+        # are pre-paid — charged at grant time — so they occupy the window
+        # exactly like passed tokens until they expire or are credited back
+        + W.window_sum_at(spec, state.flow, now, ClusterEvent.LEASED, safe_slot)
     ).astype(jnp.float32)
     if config.prefix_impl == "grouped":
         # "grouped" is only sound when the host batcher sorted the batch —

@@ -21,13 +21,26 @@ from sentinel_tpu.stats.window import WindowSpec, WindowState, make_window
 
 class ClusterEvent(enum.IntEnum):
     """``ClusterFlowEvent`` (``ClusterMetricBucket``): PASS counts tokens,
-    PASS_REQUEST counts RPCs (a request may acquire N tokens)."""
+    PASS_REQUEST counts RPCs (a request may acquire N tokens).
+
+    ``LEASED`` (wire rev 5, no reference analog) counts tokens delegated to
+    clients as short-TTL local-admission leases. A grant charges the full
+    slice into the current bucket at grant time — the delegated tokens are
+    *pre-paid*, so client-local admissions never touch the server and the
+    device admission read (PASS + LEASED + matured borrows vs threshold)
+    keeps the global limit without seeing them individually. Unused tokens
+    are credited back (a negative fold) on renew/return when the charge
+    bucket is provably still inside the live window; otherwise they simply
+    expire with the window — the conservative direction. Because LEASED is
+    an ordinary event column it rides psum'd mesh limits, snapshots,
+    replication deltas, and MOVE window-sum handoffs unchanged."""
 
     PASS = 0
     PASS_REQUEST = 1
     BLOCK = 2
     BLOCK_REQUEST = 3
     OCCUPIED_PASS = 4
+    LEASED = 5
 
 
 N_CLUSTER_EVENTS = len(ClusterEvent)

@@ -62,11 +62,16 @@ class FailoverTokenClient(TokenService):
         backoff_max_ms: Optional[float] = None,
         deadline_ms: Optional[float] = None,
         client_factory: Callable = TokenClient,
+        lease: bool = False,
+        lease_want: int = 256,
     ):
         if not endpoints:
             raise ValueError("at least one endpoint required")
         self.namespace = namespace
         self.timeout_ms = timeout_ms
+        # lease kwargs are forwarded only when enabled so stub factories
+        # that predate wire rev 5 keep working unchanged
+        extra = {"lease": True, "lease_want": lease_want} if lease else {}
         # overall per-request budget for walking the endpoint list; once
         # spent, the request degrades to fallback instead of trying further
         # standbys (the configured failover deadline)
@@ -92,7 +97,7 @@ class FailoverTokenClient(TokenService):
                     ),
                     client_factory(
                         ep.host, ep.port, timeout_ms=timeout_ms,
-                        namespace=namespace,
+                        namespace=namespace, **extra,
                     ),
                 )
             )
@@ -158,6 +163,23 @@ class FailoverTokenClient(TokenService):
             status = np.asarray(result[0])
             return status.size > 0 and bool(
                 (status == int(TokenStatus.STANDBY)).all()
+            )
+        return False
+
+    @staticmethod
+    def _lease_refusal(result) -> bool:
+        """A lease-protocol refusal (NOT_LEASABLE: flow not leasable, lease
+        revoked, or no headroom to delegate). The wrapped per-endpoint
+        client already degrades its own lease refusals to per-request RPCs,
+        so this only fires for custom clients that surface the status — but
+        when it does, the server is alive and answering honestly. Same
+        whole-batch rule as OVERLOAD."""
+        if isinstance(result, TokenResult):
+            return result.status == TokenStatus.NOT_LEASABLE
+        if isinstance(result, tuple) and len(result) == 3:
+            status = np.asarray(result[0])
+            return status.size > 0 and bool(
+                (status == int(TokenStatus.NOT_LEASABLE)).all()
             )
         return False
 
@@ -227,6 +249,16 @@ class FailoverTokenClient(TokenService):
             if self._moved_redirect(result):
                 saw_standby = True  # alive, not exhausted — same as STANDBY
                 ha_metrics().count_fallback("moved_redirect")
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
+            if self._lease_refusal(result):
+                # proof of life, never eviction: a server refusing to
+                # delegate its window still decides per-request RPCs fine.
+                # The refusal carries no admission verdict, so walk on; the
+                # member's own client falls back to wire on the next call.
+                saw_standby = True
+                ha_metrics().count_fallback("lease_refused")
                 if _clock.now_ms() >= deadline:
                     break
                 continue
@@ -370,6 +402,21 @@ class FailoverTokenClient(TokenService):
     def active_endpoint(self) -> Endpoint:
         with self._lock:
             return self._members[self._active].endpoint
+
+    def lease_stats(self) -> dict:
+        """Merged lease counters across member clients (zeros when the
+        members don't lease)."""
+        merged: dict = {}
+        for member in self._members:
+            stats_fn = getattr(member.client, "lease_stats", None)
+            if stats_fn is None:
+                continue
+            try:
+                for key, value in stats_fn().items():
+                    merged[key] = merged.get(key, 0) + int(value)
+            except Exception:
+                continue
+        return merged
 
     def health_snapshot(self) -> List[dict]:
         out = []

@@ -148,6 +148,12 @@ class ServerMetrics:
         # counters (each independently monotonic; no cross-counter snapshot)
         self._shm_provider: Optional[Callable[[], dict]] = None
         self._shm_lock = threading.Lock()
+        # wire-rev-5 lease observability: the live token service registers
+        # a zero-arg provider returning its lease_stats() block (cumulative
+        # granted/renewed/returned/revoked + outstanding gauges). Same
+        # most-recent-wins weakref model as the sketch provider.
+        self._lease_provider: Optional[Callable[[], dict]] = None
+        self._lease_lock = threading.Lock()
 
     # -- fused dispatch counters --------------------------------------------
     def record_fused(self, depth: int) -> None:
@@ -344,6 +350,25 @@ class ServerMetrics:
         except Exception:
             return {}  # a torn-down door's reader must not 500 a scrape
 
+    # -- lease provider -----------------------------------------------------
+    def register_lease_provider(self, fn: Callable[[], dict]) -> None:
+        """Install the zero-arg reader for the token service's lease stats
+        (``DefaultTokenService.lease_stats`` shape). Most recent
+        registration wins; providers return ``{}`` once their service is
+        gone."""
+        with self._lease_lock:
+            self._lease_provider = fn
+
+    def lease_stats(self) -> dict:
+        with self._lease_lock:
+            fn = self._lease_provider
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:
+            return {}  # a torn-down service's reader must not 500 a scrape
+
     # -- snapshots ----------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON shape served by the ``clusterServerStats`` command — the
@@ -365,6 +390,7 @@ class ServerMetrics:
             },
             "sketch": self.sketch_stats(),
             "shm": self.shm_stats(),
+            "lease": self.lease_stats(),
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -531,6 +557,33 @@ class ServerMetrics:
             lines.append(
                 f"sentinel_server_{mname} {int(shm.get(skey, 0) or 0)}"
             )
+        lease = self.lease_stats()
+        for mname, skey, help_text in (
+            ("sentinel_lease_granted_total", "granted",
+             "Wire-rev-5 leases granted: short-TTL client-local admission "
+             "slices charged to the LEASED window column (cumulative)."),
+            ("sentinel_lease_renewed_total", "renewed",
+             "Lease renewals: unused tokens credited, fresh slice granted "
+             "(cumulative)."),
+            ("sentinel_lease_returned_total", "returned",
+             "Leases returned early by clients (cumulative)."),
+            ("sentinel_lease_revoked_total", "revoked",
+             "Leases ended server-side: TTL expiry, rule-reload drop, or "
+             "MOVE recall (cumulative)."),
+        ):
+            lines.append(f"# HELP {mname} {help_text}")
+            lines.append(f"# TYPE {mname} counter")
+            lines.append(f"{mname} {int(lease.get(skey, 0) or 0)}")
+        for mname, skey, help_text in (
+            ("sentinel_lease_outstanding", "outstanding",
+             "Live (unexpired, unreturned) leases right now."),
+            ("sentinel_lease_outstanding_tokens", "outstanding_tokens",
+             "Tokens currently delegated on live leases — the bound on "
+             "crash over-admission."),
+        ):
+            lines.append(f"# HELP {mname} {help_text}")
+            lines.append(f"# TYPE {mname} gauge")
+            lines.append(f"{mname} {int(lease.get(skey, 0) or 0)}")
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -598,6 +651,8 @@ class ServerMetrics:
             self._sketch_provider = None
         with self._shm_lock:
             self._shm_provider = None
+        with self._lease_lock:
+            self._lease_provider = None
         self._rate.reset()
 
 

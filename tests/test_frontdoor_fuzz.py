@@ -97,11 +97,42 @@ def _assert_still_serving(port):
         assert c.request_token(1).ok
     finally:
         c.close()
+    # rev-5 control plane answers too: a lease grant after the garbage
+    lc = TokenClient("127.0.0.1", port, timeout_ms=3000, lease=True,
+                     lease_want=8)
+    try:
+        assert lc.request_token(1).ok
+        assert lc.lease_stats()["granted"] >= 1
+    finally:
+        lc.close()
+
+
+def _lease_cut_corpus():
+    """Every truncation cut of each rev-5 lease request, re-framed with an
+    honest length header so the door's splitter delivers the torn payload
+    intact to ``decode_lease_request`` — the containment path under test —
+    plus a lease RESPONSE thrown at the server (wrong direction)."""
+    corpus = []
+    for mt in (P.MsgType.LEASE_GRANT, P.MsgType.LEASE_RENEW,
+               P.MsgType.LEASE_RETURN):
+        payload = P.encode_lease_request(
+            7, mt, flow_id=1, want=9, lease_id=3, used=2
+        )[2:]
+        for cut in range(len(payload)):
+            corpus.append(struct.pack(">H", cut) + payload[:cut])
+    corpus.append(P.encode_lease_response(
+        9, P.MsgType.LEASE_GRANT, 0, 5, 100, 500
+    ))
+    return corpus
 
 
 class TestAsyncioFuzz:
     def test_garbage_never_kills_the_loop(self, asyncio_server):
         _throw_garbage(asyncio_server.port, _garbage_corpus())
+        _assert_still_serving(asyncio_server.port)
+
+    def test_torn_lease_frames_never_kill_the_loop(self, asyncio_server):
+        _throw_garbage(asyncio_server.port, _lease_cut_corpus())
         _assert_still_serving(asyncio_server.port)
 
     def test_garbage_interleaved_with_live_traffic(self, asyncio_server):
@@ -128,6 +159,15 @@ class TestNativeFuzz:
         server.start()
         try:
             _throw_garbage(server.port, _garbage_corpus(seed=SEED + 1))
+            _assert_still_serving(server.port)
+        finally:
+            server.stop()
+
+    def test_torn_lease_frames_never_kill_a_lane(self, svc):
+        server = NativeTokenServer(svc, port=0, idle_ttl_s=None)
+        server.start()
+        try:
+            _throw_garbage(server.port, _lease_cut_corpus())
             _assert_still_serving(server.port)
         finally:
             server.stop()
@@ -176,6 +216,22 @@ class TestClientReaderFuzz:
             # the client object stays usable (reconnect path)
             r2 = c.request_token(1)
             assert r2 is not None
+        finally:
+            c.close()
+            t.join(timeout=5)
+
+    def test_reader_survives_truncated_lease_response(self):
+        # a runt LEASE_GRANT answer (framed honestly, payload torn) must
+        # degrade like any corrupt frame: connection dropped, no dead
+        # reader, the client object stays usable
+        rsp = P.encode_lease_response(1, P.MsgType.LEASE_GRANT, 0, 5, 64,
+                                      500)[2:]
+        torn = struct.pack(">H", 7) + rsp[:7]
+        port, t = self._fake_server([torn])
+        c = TokenClient("127.0.0.1", port, timeout_ms=300, lease=True)
+        try:
+            r = c.request_token(1)
+            assert r is not None and not r.ok  # degraded, not raised
         finally:
             c.close()
             t.join(timeout=5)
@@ -246,6 +302,77 @@ class TestDecodeIntoFuzz:
                 )
             except (ValueError, struct.error):
                 pass  # the only sanctioned failure modes
+
+
+class TestLeaseCodecFuzz:
+    """Rev-5 lease codec containment: every truncation cut raises
+    ``ValueError`` (never struct.error, never an index crash), and the
+    full frame round-trips bit-exact."""
+
+    def test_request_every_cut_raises_valueerror(self):
+        for mt in (P.MsgType.LEASE_GRANT, P.MsgType.LEASE_RENEW,
+                   P.MsgType.LEASE_RETURN):
+            payload = P.encode_lease_request(
+                42, mt, flow_id=77, want=9, lease_id=1234, used=5
+            )[2:]
+            for cut in range(len(payload)):
+                with pytest.raises(ValueError):
+                    P.decode_lease_request(payload[:cut])
+            got = P.decode_lease_request(payload)
+            assert got == (42, mt, 1234, 77, 5, 9)
+
+    def test_response_cuts_below_base_raise_valueerror(self):
+        payload = P.encode_lease_response(
+            9, P.MsgType.LEASE_RENEW, 0, lease_id=5, tokens=100, ttl_ms=500
+        )[2:]
+        for cut in range(len(payload)):
+            with pytest.raises(ValueError):
+                P.decode_lease_response(payload[:cut])
+        rsp = P.decode_lease_response(payload)
+        assert (rsp.xid, rsp.msg_type, rsp.status) == (
+            9, P.MsgType.LEASE_RENEW, 0
+        )
+        assert (rsp.lease_id, rsp.tokens, rsp.ttl_ms) == (5, 100, 500)
+
+    def test_moved_trailer_cuts_never_escape(self):
+        # the MOVED endpoint trailer is variable-length: any cut at or past
+        # the base struct must DECODE (shorter endpoint — possibly torn
+        # mid-UTF-8, absorbed by errors="replace"), never raise
+        payload = P.encode_lease_response(
+            3, P.MsgType.LEASE_RENEW, P.MOVED_STATUS, tokens=7,
+            endpoint="héßt:9000",
+        )[2:]
+        base = len(P.encode_lease_response(
+            3, P.MsgType.LEASE_RENEW, P.MOVED_STATUS, tokens=7
+        )[2:])
+        for cut in range(len(payload) + 1):
+            piece = payload[:cut]
+            if cut < base:
+                with pytest.raises(ValueError):
+                    P.decode_lease_response(piece)
+            else:
+                rsp = P.decode_lease_response(piece)
+                assert rsp.status == P.MOVED_STATUS
+        assert P.decode_lease_response(payload).endpoint == "héßt:9000"
+
+    def test_random_blobs_never_escape_valueerror(self):
+        rng = random.Random(SEED + 5)
+        for _ in range(300):
+            blob = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(0, 64))
+            )
+            for decode in (P.decode_lease_request, P.decode_lease_response):
+                try:
+                    decode(blob)
+                except ValueError:
+                    pass  # the only sanctioned failure mode
+
+    def test_decode_request_refuses_lease_types(self):
+        # lease frames route through their own codec; the decision-plane
+        # decoder must refuse them loudly rather than misparse the body
+        frame = P.encode_lease_request(1, P.MsgType.LEASE_GRANT, 1, 4)[2:]
+        with pytest.raises(ValueError):
+            P.decode_request(frame)
 
 
 @pytest.mark.skipif(not native_available(), reason="native library not built")

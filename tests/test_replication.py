@@ -141,7 +141,10 @@ class TestReplCodec:
 
 # -- delta export/apply ------------------------------------------------------
 class TestDeltaApply:
-    def test_counters_converge_bit_for_bit(self):
+    def test_counters_converge_bit_for_bit(self, manual_clock):
+        # frozen clock: on a loaded host the wall between the two
+        # metrics_snapshot reads below can cross a 100ms bucket boundary,
+        # expiring one admission from the second read but not the first
         primary = _service()
         standby = _service()
         primary.replication_enable()

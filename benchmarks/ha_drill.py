@@ -1093,6 +1093,343 @@ def run_lease_drill(
     }
 
 
+def run_hier_drill(
+    budget_qps: float = 200.0,
+    bucket_ms: int = 100,
+    reconcile_ms: float = 200.0,
+    chaos_seed: int = 7,
+):
+    """Two-pod hierarchical-limit drill: one GLOBAL budget split across two
+    LIVE token servers by the tier-3 coordinator, with a skewed-demand flip.
+
+    Topology: pod A co-hosts the ``GlobalBudgetCoordinator`` behind its
+    ordinary front door (the rev-5 SHARE_*/DEMAND_REPORT type bytes need no
+    extra port); both pods run a ``PodShareAgent`` against that door over
+    real TCP. The drill paces agent ticks and reconcile passes ITSELF (the
+    background threads stay off) so convergence is counted in ticks, not
+    wall-clock noise. Phases and gates:
+
+    - **bootstrap**: no demand → water-fill's equal split, shares conserve
+      the budget exactly.
+    - **skew to A**: a demand burst on pod A must pull A's share to ≥ 2×
+      B's within 3 reconcile ticks of the report landing.
+    - **flip to B**: demand moves to pod B; once A's old demand drains out
+      of its sliding window and the coordinator re-targets, shares must
+      converge (B ≥ 2× A) within 3 further ticks.
+    - **zero cross-pod hops**: a decision burst on both pods with the
+      control plane quiet must move the agents' RPC counters by exactly 0
+      — admission is all client-to-own-pod.
+    - **live over-admission**: both pods driven flat-out for one window
+      admit ≤ global budget + one reconcile interval's worth (the hold
+      rotation-decay → re-top gap, docs/CLUSTER_HA.md).
+    - **chaos cut + coordinator dark**: a seeded conn_reset mid-tick, then
+      the coordinator detached outright; agents must keep the last share
+      (never raise, never unpin the hold), and a dark flat-out window
+      admits ≤ Σ outstanding shares + the same slack.
+    """
+    from sentinel_tpu import chaos
+    from sentinel_tpu.cluster.client import TokenClient
+    from sentinel_tpu.cluster.hierarchy import (
+        GlobalBudgetCoordinator,
+        GlobalFlowBudget,
+        PodShareAgent,
+    )
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    failures = []
+    window_s = bucket_ms * 10 / 1000.0  # EngineConfig default n_buckets=10
+    budget_tokens = int(budget_qps * window_s)
+    # the documented bound: what one reconcile interval can leak through
+    # hold rotation-decay before the next tick re-tops the hold
+    slack_tokens = max(2, int(budget_tokens * reconcile_ms / (window_s * 1e3)))
+    cfg = EngineConfig(
+        max_flows=64, max_namespaces=4, batch_size=64, bucket_ms=bucket_ms
+    )
+    svcA = DefaultTokenService(cfg)
+    svcB = DefaultTokenService(cfg)
+    for svc in (svcA, svcB):
+        svc.load_rules(
+            [ClusterFlowRule(DRILL_FLOW, budget_qps, ThresholdMode.GLOBAL),
+             ClusterFlowRule(WARM_FLOW, 1e9, ThresholdMode.GLOBAL)]
+        )
+    coord = GlobalBudgetCoordinator(
+        [GlobalFlowBudget(DRILL_FLOW, budget_qps, window_s)],
+        share_ttl_ms=30_000, reconcile_ms=reconcile_ms,
+    )
+    svcA.attach_hierarchy(coord)
+    srvA = TokenServer(svcA, port=0, metrics_port=0)
+    srvB = TokenServer(svcB, port=0, metrics_port=0)
+    srvA.start()
+    srvB.start()
+    coord_ep = f"127.0.0.1:{srvA.port}"
+    agA = PodShareAgent(svcA, [coord_ep], "pod-a", [DRILL_FLOW], tick_ms=100)
+    agB = PodShareAgent(svcB, [coord_ep], "pod-b", [DRILL_FLOW], tick_ms=100)
+    clA = TokenClient("127.0.0.1", srvA.port, timeout_ms=500)
+    clB = TokenClient("127.0.0.1", srvB.port, timeout_ms=500)
+
+    def _round():
+        agA.tick()
+        agB.tick()
+        coord.reconcile_once()
+
+    def _burst(cl, n, fid=DRILL_FLOW):
+        ok = 0
+        for _ in range(n):
+            r = cl.request_token(fid)
+            if r is not None and r.ok:
+                ok += 1
+        return ok
+
+    def _shares():
+        return (agA.shares().get(DRILL_FLOW, 0),
+                agB.shares().get(DRILL_FLOW, 0))
+
+    def _drain(rounds_dark=False):
+        """Let DRILL_FLOW's sliding windows empty (real time — demand and
+        admissions both decay by bucket rotation), re-topping holds with
+        control-plane rounds along the way."""
+        deadline = time.monotonic() + window_s + 3 * bucket_ms / 1e3
+        while time.monotonic() < deadline:
+            if rounds_dark:
+                agA.tick()
+                agB.tick()
+            else:
+                _round()
+            time.sleep(2 * bucket_ms / 1e3)
+
+    bootstrap = skew = flip = {}
+    decision_rpcs = None
+    live = dark = {}
+    hier_series_live = False
+    try:
+        # warm the jit paths on the unbounded flow
+        warm_deadline = time.monotonic() + 60.0
+        while time.monotonic() < warm_deadline:
+            if _burst(clA, 1, WARM_FLOW) and _burst(clB, 1, WARM_FLOW):
+                break
+        else:
+            failures.append("pods never served the warm flow")
+
+        # phase 1 — bootstrap: zero demand → equal split, budget conserved
+        _round()
+        _round()
+        sA, sB = _shares()
+        bootstrap = {"share_a": sA, "share_b": sB}
+        if sA + sB > budget_tokens:
+            failures.append(
+                f"bootstrap shares {sA}+{sB} exceed the {budget_tokens} "
+                "global budget"
+            )
+        if abs(sA - sB) > 1 or sA == 0:
+            failures.append(
+                f"bootstrap split {sA}/{sB} is not the equal water-fill"
+            )
+
+        # phase 2 — skew to A: burst demand, converge within 3 ticks of
+        # the report landing (the first _round below ships the report)
+        _burst(clA, int(budget_qps * 1.5))
+        agA.tick()
+        agB.tick()  # demand now reported; targets still old
+        skew_rounds = 0
+        while skew_rounds < 6:
+            coord.reconcile_once()
+            agA.tick()
+            agB.tick()
+            skew_rounds += 1
+            sA, sB = _shares()
+            if sA >= 2 * sB:
+                break
+        skew = {"rounds": skew_rounds, "share_a": sA, "share_b": sB}
+        if sA < 2 * sB:
+            failures.append(
+                f"skewed demand never won the budget ({sA} vs {sB})"
+            )
+        elif skew_rounds > 3:
+            failures.append(
+                f"skew convergence took {skew_rounds} reconcile ticks "
+                "(contract: <= 3)"
+            )
+        if sA + sB > budget_tokens:
+            failures.append(
+                f"post-skew shares {sA}+{sB} exceed the budget"
+            )
+
+        # phase 3 — flip to B: demand moves; count ticks from the moment
+        # the coordinator re-targets (A's old demand must first drain out
+        # of its sliding window — that part is window physics, not the
+        # reconciler) to share convergence
+        flip_rounds = converge_rounds = 0
+        retargeted = False
+        while flip_rounds < 40:
+            _burst(clB, 60)
+            agA.tick()
+            agB.tick()
+            coord.reconcile_once()
+            flip_rounds += 1
+            tg = coord.stats()["targets"].get(DRILL_FLOW, {})
+            if not retargeted and (
+                tg.get("pod-b", 0) > tg.get("pod-a", 0)
+            ):
+                retargeted = True
+            elif retargeted:
+                converge_rounds += 1
+            sA, sB = _shares()
+            if retargeted and sB >= 2 * sA:
+                break
+            time.sleep(bucket_ms / 1e3)
+        flip = {
+            "rounds_total": flip_rounds,
+            "rounds_after_retarget": converge_rounds,
+            "share_a": sA,
+            "share_b": sB,
+        }
+        if not (retargeted and sB >= 2 * sA):
+            failures.append(
+                f"demand flip never converged ({sA} vs {sB} after "
+                f"{flip_rounds} rounds)"
+            )
+        elif converge_rounds > 3:
+            failures.append(
+                f"flip convergence took {converge_rounds} ticks past "
+                "the re-target (contract: <= 3)"
+            )
+        if sA + sB > budget_tokens:
+            failures.append(f"post-flip shares {sA}+{sB} exceed the budget")
+
+        # phase 4 — zero cross-pod hops on the decision path: with the
+        # control plane quiet, a decision burst moves agent RPCs by 0
+        rpc0 = (agA.stats()["agent_rpcs"] + agB.stats()["agent_rpcs"])
+        decisions = _burst(clA, 150) + _burst(clB, 150)
+        decision_rpcs = (
+            agA.stats()["agent_rpcs"] + agB.stats()["agent_rpcs"] - rpc0
+        )
+        if decision_rpcs != 0:
+            failures.append(
+                f"{decision_rpcs} cross-pod RPCs during a decision burst "
+                "(contract: the decision path never leaves the pod)"
+            )
+
+        # phase 5 — live over-admission: drain, then drive BOTH pods
+        # flat-out with the control plane pacing normally. The drive stays
+        # strictly INSIDE one window (window_s − 2.5 buckets): past that,
+        # the drive's own front-loaded admissions age out of the sliding
+        # window and legitimately refill — that is window physics, not
+        # over-admission, and counting it would gate on the wrong thing.
+        drive_s = window_s - 2.5 * bucket_ms / 1e3
+        _drain()
+        admits = 0
+        t0 = time.monotonic()
+        last_round = t0
+        while time.monotonic() - t0 < drive_s:
+            admits += _burst(clA, 25) + _burst(clB, 25)
+            if time.monotonic() - last_round >= reconcile_ms / 1e3:
+                _round()
+                last_round = time.monotonic()
+        over_live = max(0, admits - budget_tokens)
+        live = {"admits": admits, "over_admission": over_live,
+                "slack_tokens": slack_tokens}
+        if over_live > slack_tokens:
+            failures.append(
+                f"live over-admission {over_live} exceeds one reconcile "
+                f"interval's worth ({slack_tokens} tokens)"
+            )
+
+        # phase 6 — seeded chaos cut mid-tick: the agent must neither
+        # raise nor lose its share when the renew channel is severed
+        sA0, sB0 = _shares()
+        chaos.arm("conn_reset:n=1", seed=chaos_seed)
+        try:
+            agB.tick()
+        except Exception as e:
+            failures.append(f"agent tick raised under chaos: {e!r}")
+        finally:
+            chaos.disarm()
+        if agB.shares().get(DRILL_FLOW, 0) != sB0:
+            failures.append("chaos-cut tick lost the agent's share")
+
+        # phase 7 — coordinator dark: detach it; agents degrade to the
+        # last-granted share, and a dark flat-out window stays bounded by
+        # Σ outstanding shares (+ the same rotation slack)
+        svcA.hierarchy = None
+        for _ in range(3):
+            agA.tick()
+            agB.tick()
+        sA, sB = _shares()
+        if (sA, sB) != (sA0, sB0):
+            failures.append(
+                f"dark pods moved their shares {sA0}/{sB0} -> {sA}/{sB} "
+                "(contract: hold the last grant)"
+            )
+        if not (agA.stats()["agent_degraded"]
+                and agB.stats()["agent_degraded"]):
+            failures.append("dark agents never flagged degraded mode")
+        _drain(rounds_dark=True)
+        admits_dark = 0
+        t0 = time.monotonic()
+        last_round = t0
+        while time.monotonic() - t0 < drive_s:
+            admits_dark += _burst(clA, 25) + _burst(clB, 25)
+            if time.monotonic() - last_round >= reconcile_ms / 1e3:
+                agA.tick()
+                agB.tick()
+                last_round = time.monotonic()
+        over_dark = max(0, admits_dark - (sA + sB))
+        dark = {"admits": admits_dark, "share_sum": sA + sB,
+                "over_admission": over_dark}
+        if over_dark > slack_tokens:
+            failures.append(
+                f"dark over-admission {over_dark} exceeds the outstanding-"
+                f"share bound {sA + sB} + {slack_tokens} slack"
+            )
+
+        # recovery: re-attach, one round, the ledger sees both pods again
+        svcA.attach_hierarchy(coord)
+        _round()
+        if coord.stats()["outstanding_shares"] < 2:
+            failures.append("coordinator never re-leased after recovery")
+
+        # observability: the hier series must be on the scrape surface
+        if srvA.metrics_port:
+            try:
+                hier_series_live = (
+                    "sentinel_hier_share_tokens" in _scrape(srvA.metrics_port)
+                )
+            except Exception as e:
+                failures.append(f"hier metrics scrape failed: {e!r}")
+            if not hier_series_live:
+                failures.append(
+                    "sentinel_hier_share_tokens missing from /metrics"
+                )
+    finally:
+        clA.close()
+        clB.close()
+        agA.close()
+        agB.close()
+        coord.stop()
+        srvA.stop()
+        srvB.stop()
+    return {
+        "budget_tokens": budget_tokens,
+        "reconcile_ms": reconcile_ms,
+        "slack_tokens": slack_tokens,
+        "bootstrap": bootstrap,
+        "skew": skew,
+        "flip": flip,
+        "decision_rpcs": decision_rpcs,
+        "live": live,
+        "dark": dark,
+        "hier_series_live": hier_series_live,
+        "coordinator": {
+            k: v for k, v in coord.stats().items()
+            if not isinstance(v, dict)
+        },
+        "failures": failures,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true",
@@ -1109,6 +1446,13 @@ def main() -> None:
     ap.add_argument("--only-lease", action="store_true",
                     help="run ONLY the lease drill (the CI lease-smoke "
                          "job's fast path)")
+    ap.add_argument("--skip-hier", action="store_true",
+                    help="skip the two-pod hierarchical-limit drill")
+    ap.add_argument("--only-hier", action="store_true",
+                    help="run ONLY the hierarchical-limit drill (the CI "
+                         "hier-smoke job's fast path)")
+    ap.add_argument("--hier-seed", type=int, default=7,
+                    help="chaos seed for the hier drill's conn_reset cut")
     # child-role flags (used with --serve)
     ap.add_argument("--standby-of", default=None)
     ap.add_argument("--promote-after-ms", type=float, default=None)
@@ -1143,6 +1487,25 @@ def main() -> None:
             f"kill, standby blocked {lease['standby_blocks']}x)"
         )
         return
+    if args.only_hier:
+        doc = {"hier": run_hier_drill(chaos_seed=args.hier_seed)}
+        doc["failures"] = doc["hier"]["failures"]
+        doc["wall_s"] = round(time.time() - t0, 1)
+        print(json.dumps(doc, indent=2))
+        if doc["failures"]:
+            print(f"HIER DRILL FAILED: {doc['failures']}", file=sys.stderr)
+            sys.exit(1)
+        hier = doc["hier"]
+        print(
+            f"hier drill ok: skew converged in {hier['skew']['rounds']} "
+            f"tick(s), flip in {hier['flip']['rounds_after_retarget']} "
+            f"tick(s) past re-target, {hier['decision_rpcs']} cross-pod "
+            f"RPCs per decision burst, live over-admission "
+            f"{hier['live']['over_admission']} of "
+            f"{hier['budget_tokens']} (slack {hier['slack_tokens']}), "
+            f"dark over-admission {hier['dark']['over_admission']}"
+        )
+        return
     doc = run_drill(deadline_ms=args.deadline_ms)
     if not args.skip_replication:
         doc["replication"] = run_replication_drill()
@@ -1153,6 +1516,9 @@ def main() -> None:
     if not args.skip_lease:
         doc["lease"] = run_lease_drill()
         doc["failures"] = doc["failures"] + doc["lease"]["failures"]
+    if not args.skip_hier:
+        doc["hier"] = run_hier_drill(chaos_seed=args.hier_seed)
+        doc["failures"] = doc["failures"] + doc["hier"]["failures"]
     if not args.skip_overload:
         doc["overload"] = run_overload_drill()
         doc["failures"] = doc["failures"] + doc["overload"]["failures"]
@@ -1199,6 +1565,17 @@ def main() -> None:
             f"{lease['outstanding_tokens_at_kill']} "
             f"({lease['local_admits']} client-local admits survived the "
             f"kill, standby blocked {lease['standby_blocks']}x)"
+        )
+    if "hier" in doc:
+        hier = doc["hier"]
+        print(
+            f"hier drill ok: skew converged in {hier['skew']['rounds']} "
+            f"tick(s), flip in {hier['flip']['rounds_after_retarget']} "
+            f"tick(s) past re-target, {hier['decision_rpcs']} cross-pod "
+            f"RPCs per decision burst, live over-admission "
+            f"{hier['live']['over_admission']} of "
+            f"{hier['budget_tokens']} (slack {hier['slack_tokens']}), "
+            f"dark over-admission {hier['dark']['over_admission']}"
         )
     if "overload" in doc:
         ovl = doc["overload"]

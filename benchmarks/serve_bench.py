@@ -379,6 +379,96 @@ def measure_ha(deadline_ms: float = 500.0,
     }
 
 
+def measure_hier(budget_qps: float = 200.0, decisions: int = 2000,
+                 reconcile_iters: int = 200) -> dict:
+    """Hierarchy-tier probe for the bench artifact: two in-process pods
+    split one global budget through a co-located coordinator (pod A's
+    ordinary front door carries the share traffic), then three numbers:
+
+    - per-pod share after the control plane settles (the water-fill
+      outcome the dashboard would show),
+    - ``reconcile_once`` wall latency p50/p99 with live demand (the
+      DCN-tier loop's cost — what bounds how low ``reconcile_ms`` can go,
+      docs/PERF.md),
+    - cross-pod RPCs per decision over a decision burst — gated at
+      exactly 0: the whole point of the tier is that admission never
+      leaves the pod."""
+    from sentinel_tpu.cluster.hierarchy import (
+        GlobalBudgetCoordinator,
+        GlobalFlowBudget,
+        PodShareAgent,
+    )
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    flow = 42
+    cfg = EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+    window_s = cfg.bucket_ms * 10 / 1000.0
+    svc_a = DefaultTokenService(cfg)
+    svc_b = DefaultTokenService(cfg)
+    for svc in (svc_a, svc_b):
+        svc.load_rules(
+            [ClusterFlowRule(flow, budget_qps, ThresholdMode.GLOBAL)]
+        )
+    coord = GlobalBudgetCoordinator(
+        [GlobalFlowBudget(flow, budget_qps, window_s)]
+    )
+    svc_a.attach_hierarchy(coord)
+    server = TokenServer(svc_a, port=0, metrics_port=0)
+    server.start()
+    ep = f"127.0.0.1:{server.port}"
+    ag_a = PodShareAgent(svc_a, [ep], "pod-a", [flow])
+    ag_b = PodShareAgent(svc_b, [ep], "pod-b", [flow])
+    try:
+        # settle the control plane: report → reconcile → renew, twice
+        for _ in range(2):
+            ag_a.tick()
+            ag_b.tick()
+            coord.reconcile_once()
+        ag_a.tick()
+        ag_b.tick()
+        # skewed demand so the timed reconcile passes do real water-fill
+        for _ in range(50):
+            svc_a.request_token(flow)
+        ag_a.tick()
+        ag_b.tick()
+        lat_ms = []
+        for _ in range(reconcile_iters):
+            t0 = time.perf_counter()
+            coord.reconcile_once()
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+        lat = np.asarray(lat_ms)
+        # the hot-path gate: decisions on both pods with the control plane
+        # quiet must not move the agents' RPC counters at all
+        rpc0 = ag_a.stats()["agent_rpcs"] + ag_b.stats()["agent_rpcs"]
+        for _ in range(decisions // 2):
+            svc_a.request_token(flow)
+            svc_b.request_token(flow)
+        rpc_delta = (
+            ag_a.stats()["agent_rpcs"] + ag_b.stats()["agent_rpcs"] - rpc0
+        )
+        return {
+            "budget_tokens": coord.budget_of(flow),
+            "share_per_pod": {
+                "pod-a": ag_a.shares().get(flow, 0),
+                "pod-b": ag_b.shares().get(flow, 0),
+            },
+            "reconcile_p50_ms": round(float(np.percentile(lat, 50)), 4),
+            "reconcile_p99_ms": round(float(np.percentile(lat, 99)), 4),
+            "decisions": decisions,
+            "cross_pod_rpcs_per_decision": round(
+                rpc_delta / max(decisions, 1), 6
+            ),
+        }
+    finally:
+        ag_a.close()
+        ag_b.close()
+        coord.stop()
+        server.stop()
+
+
 def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
                   n_flows: int = 100_000, max_batch: int = 16384,
                   n_dispatchers: int = None, budget_s: float = None,
@@ -601,6 +691,14 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
     except Exception as e:
         print(f"serve_bench: ha probe failed: {e!r}", file=sys.stderr)
         ha = None
+    # hierarchy-tier probe: per-pod share split, reconcile latency, and
+    # the zero-cross-pod-RPCs-per-decision gate. Same contract as the ha
+    # probe: a broken probe surfaces as hier=None, never as a lost run.
+    try:
+        hier = measure_hier()
+    except Exception as e:
+        print(f"serve_bench: hier probe failed: {e!r}", file=sys.stderr)
+        hier = None
     return {
         "backend": backend,
         # only the native door has dispatcher threads; the asyncio fallback
@@ -630,6 +728,7 @@ def serve_measure(native: bool = True, closed_kw=None, sweep_rates=None,
             closed["verdicts_per_sec"] / ceiling, 3
         ) if ceiling else None,
         "ha": ha,
+        "hier": hier,
         "lease": lease_block,
         **({"mesh": mesh_block} if mesh_block else {}),
         **({"single_door_baseline": baseline,

@@ -293,7 +293,7 @@ class TokenClient(TokenService):
                     payload = view[r + 2 : r + 2 + ln]
                     r += 2 + ln
                     mtype = P.peek_type(payload)
-                    if mtype in P.LEASE_TYPES:
+                    if mtype in P.LEASE_TYPES or mtype in P.HIER_TYPES:
                         rsp = P.decode_lease_response(bytes(payload))
                         pending = self._pending.get(rsp.xid)
                         if pending is not None:
@@ -520,6 +520,40 @@ class TokenClient(TokenService):
             out["cached"] = len(self._leases)
             out["rpcs"] = self._rpcs
             return out
+
+    # -- hierarchy tier (pod share agent ↔ global budget coordinator) --------
+    def share_op(
+        self, msg_type, flow_id: int, want: int = 0,
+        share_id: int = 0, used: int = 0,
+    ):
+        """SHARE_GRANT / SHARE_RENEW / SHARE_RETURN round trip; returns
+        ``P.LeaseResponse`` or None. Shares ride the lease frame layout
+        (``lease_id`` is the share id), so this is the lease roundtrip
+        with a hierarchy type byte."""
+        if msg_type not in P.SHARE_TYPES:
+            raise ValueError(f"not a share type: {msg_type}")
+        return self._lease_roundtrip(
+            msg_type, flow_id, want, lease_id=share_id, used=used
+        )
+
+    def demand_report(self, pod_id: str, entries):
+        """Ship one DEMAND_REPORT (``entries`` = ``[(flow_id, share_id,
+        rate_milli), ...]``) and wait for the coordinator's ack; returns
+        ``P.LeaseResponse`` (``tokens`` = entries accepted) or None."""
+        xid = next(self._xid)
+        pending = _Pending()
+        self._pending[xid] = pending
+        try:
+            frame = P.encode_demand_report(xid, pod_id, entries)
+            if not self._send(frame):
+                return None
+            self._count_rpc()
+            if not pending.event.wait(self.timeout_ms / 1000.0):
+                return None
+            rsp = pending.response
+            return rsp if isinstance(rsp, P.LeaseResponse) else None
+        finally:
+            self._pending.pop(xid, None)
 
     def request_params_token(self, flow_id, acquire, param_hashes) -> TokenResult:
         rsp = self._roundtrip(

@@ -53,6 +53,30 @@ def reset_snapshot_errors_for_tests() -> None:
         _SNAPSHOT_ERRORS = 0
 
 
+# flow copies dropped by the mid-MOVE dedupe in aggregate_snapshots —
+# surfaced as sentinel_assignment_move_dedup_total so a redirect window
+# that lingers (end_redirect never called) is visible on the dashboard
+_MOVE_DEDUP = 0
+_MOVE_DEDUP_LOCK = threading.Lock()
+
+
+def count_move_dedup(n: int = 1) -> None:
+    global _MOVE_DEDUP
+    with _MOVE_DEDUP_LOCK:
+        _MOVE_DEDUP += int(n)
+
+
+def move_dedup_total() -> int:
+    with _MOVE_DEDUP_LOCK:
+        return _MOVE_DEDUP
+
+
+def reset_move_dedup_for_tests() -> None:
+    global _MOVE_DEDUP
+    with _MOVE_DEDUP_LOCK:
+        _MOVE_DEDUP = 0
+
+
 class NamespaceAssignment:
     """namespace → pod ownership map with a generation counter.
 
@@ -114,35 +138,83 @@ def flow_namespaces(rules: Iterable[ClusterFlowRule]) -> Dict[int, str]:
 
 def aggregate_snapshots(
     snapshots: Iterable[Mapping[int, Mapping[str, float]]],
-) -> Dict[int, Dict[str, float]]:
+    global_budgets: Optional[Mapping[int, float]] = None,
+) -> Dict[object, Dict[str, float]]:
     """DCN-tier metric aggregation: sum per-flow metric snapshots from every
-    pod into the global view the dashboard shows. Namespace ownership makes
-    this a disjoint union in steady state, but a snapshot taken mid-move can
-    see a flow on two pods — summing (not overwriting) keeps totals right.
+    pod into the global view the dashboard shows.
+
+    Namespace ownership makes this a disjoint union in steady state, but
+    during a MOVE's redirect window BOTH pods report the flow: the source's
+    counters froze at the begin-move device step (its snapshot rows carry a
+    ``moved_epoch`` marker stamping the shard-map epoch), while the
+    destination counts live traffic. Summing both double-reports the frozen
+    window, so marked rows dedupe: a flow with any UNMARKED copy keeps only
+    the unmarked copies; a flow seen only as marked copies (destination's
+    snapshot missing from this pull) keeps the single copy with the highest
+    shard-map epoch. Dropped copies are counted in
+    ``sentinel_assignment_move_dedup_total``. The ``moved_epoch`` marker
+    itself never reaches the output — it is routing metadata, not a metric.
 
     Items may be mappings or zero-arg callables fetching one (a remote pod's
     stats pull). A pod whose fetch raises — or whose payload is malformed —
     contributes NOTHING (no half-merged rows), is logged, and is counted in
     ``sentinel_assignment_snapshot_errors_total``; it must not abort the
-    other pods' aggregation or silently vanish from the sum."""
-    out: Dict[int, Dict[str, float]] = {}
+    other pods' aggregation or silently vanish from the sum.
+
+    ``global_budgets`` (flow_id → the coordinator's budget tokens) adds a
+    ``"global"`` block for ``clusterServerStats``: fleet-wide LEASED-share
+    charge summed across pods vs the global budget, per flow — the one
+    number that says whether a hierarchical limit is holding."""
+    # staged per-flow copies: (metrics-without-marker, moved_epoch or None)
+    copies: Dict[int, List[Tuple[Dict[str, float], Optional[float]]]] = {}
     for i, snap in enumerate(snapshots):
         try:
             if callable(snap):
                 snap = snap()
-            staged: Dict[int, Dict[str, float]] = {}
+            staged: Dict[int, Tuple[Dict[str, float], Optional[float]]] = {}
             for fid, metrics in snap.items():
-                slot = staged.setdefault(int(fid), {})
+                row: Dict[str, float] = {}
+                moved: Optional[float] = None
                 for k, v in metrics.items():
-                    slot[k] = slot.get(k, 0.0) + float(v)
+                    if k == "moved_epoch":
+                        moved = float(v)
+                    else:
+                        row[k] = row.get(k, 0.0) + float(v)
+                staged[int(fid)] = (row, moved)
         except Exception:
             record_log.exception(
                 "pod snapshot %d failed during aggregation; skipping it", i,
             )
             count_snapshot_error()
             continue
-        for fid, metrics in staged.items():
-            slot = out.setdefault(fid, {})
-            for k, v in metrics.items():
+        for fid, copy in staged.items():
+            copies.setdefault(fid, []).append(copy)
+    out: Dict[object, Dict[str, float]] = {}
+    for fid, rows in copies.items():
+        unmarked = [r for r, moved in rows if moved is None]
+        if unmarked:
+            keep = unmarked
+        else:
+            # every copy is mid-move/committed-away: keep the newest-epoch
+            # one (the closest thing to the authoritative frozen window)
+            keep = [max(rows, key=lambda rm: rm[1])[0]]
+        if len(keep) < len(rows):
+            count_move_dedup(len(rows) - len(keep))
+        slot = out.setdefault(fid, {})
+        for row in keep:
+            for k, v in row.items():
                 slot[k] = slot.get(k, 0.0) + v
+    if global_budgets is not None:
+        glob: Dict[str, Dict[str, float]] = {}
+        for fid, budget in global_budgets.items():
+            leased = float(
+                out.get(int(fid), {}).get("leased_tokens", 0.0)
+            )
+            budget = float(budget)
+            glob[str(int(fid))] = {
+                "budget_tokens": budget,
+                "leased_tokens": leased,
+                "occupancy": leased / budget if budget > 0 else 0.0,
+            }
+        out["global"] = glob
     return out

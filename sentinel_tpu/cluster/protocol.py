@@ -92,6 +92,29 @@ per-request RPCs and back off leasing this flow; MOVED appends the new
 owner's endpoint as the rev-4 UTF-8 trailer. Both doors route the lease
 type bytes to the token service's host-side lease handler (the C++ door
 forwards non-data-plane bytes untouched, so no native rebuild).
+
+Rev-5 family, hierarchy tier — pods lease provisioned SHARES of a global
+flow budget from the cluster's budget coordinator, exactly as clients
+lease slices from a pod, one level up:
+
+- ``SHARE_GRANT`` / ``SHARE_RENEW`` / ``SHARE_RETURN`` reuse the lease
+  request AND response layouts byte for byte (``lease_id`` is the share
+  id, ``want``/``tokens`` are share tokens, ``ttl_ms`` is the share TTL).
+  Distinct type bytes — not a flag — because the coordinator runs
+  co-located with a pod behind the SAME door: a LEASE_GRANT for global
+  flow F is a client leasing from that pod's local window, a SHARE_GRANT
+  for F is a pod leasing from the global ledger.
+- ``DEMAND_REPORT`` carries a pod's per-tick observed demand:
+  ``pod_len:uint16, n_entries:uint16`` + pod-id UTF-8 + ``n_entries`` ×
+  ``(flow_id:int64, share_id:int64, rate_milli:int64)``. Rates ride as
+  milli-tokens/s so sub-token arrival rates survive the integer wire.
+  The coordinator answers with the shared lease-response frame
+  (``tokens`` = entries accepted); NOT_LEASABLE means "no coordinator
+  attached here" and the agent should walk its endpoint list.
+
+Both doors route ``HIER_TYPES`` to the service's attached coordinator
+(``service.hierarchy``); a standby answers STANDBY like any other
+control op, so agent-side failover walks on.
 """
 
 from __future__ import annotations
@@ -151,6 +174,16 @@ class MsgType(enum.IntEnum):
     LEASE_GRANT = 14
     LEASE_RENEW = 15
     LEASE_RETURN = 16
+    # rev-5 family, hierarchy tier: pods lease provisioned SHARES of a
+    # global flow budget from the coordinator. Share ops reuse the lease
+    # request/response structs byte for byte — a pod is just a lease
+    # client with a long TTL — but carry their own type bytes so the
+    # coordinator pod's door can tell a pod-share op from a client-lease
+    # op on the same flow_id without any payload sniffing.
+    DEMAND_REPORT = 17
+    SHARE_GRANT = 18
+    SHARE_RENEW = 19
+    SHARE_RETURN = 20
 
 
 # front doors route these type bytes to the replication applier instead of
@@ -171,6 +204,16 @@ MOVE_TYPES = frozenset(
 LEASE_TYPES = frozenset(
     {MsgType.LEASE_GRANT, MsgType.LEASE_RENEW, MsgType.LEASE_RETURN}
 )
+
+# hierarchy tier: pod-share ops reuse the lease frame layout but carry their
+# own type bytes so the coordinator pod's door can separate them from client
+# leases on the same flow
+SHARE_TYPES = frozenset(
+    {MsgType.SHARE_GRANT, MsgType.SHARE_RENEW, MsgType.SHARE_RETURN}
+)
+
+# everything both doors route to the attached hierarchy coordinator
+HIER_TYPES = frozenset(SHARE_TYPES | {MsgType.DEMAND_REPORT})
 
 # TokenStatus.MOVED — mirrored here as a bare int because this module must
 # stay importable without jax (socket-only processes); decode_response keys
@@ -728,10 +771,10 @@ def encode_lease_request(
     xid: int, msg_type: int, flow_id: int, want: int,
     lease_id: int = 0, used: int = 0,
 ) -> bytes:
-    """LEASE_GRANT / LEASE_RENEW / LEASE_RETURN request frame."""
-    if msg_type not in (
-        MsgType.LEASE_GRANT, MsgType.LEASE_RENEW, MsgType.LEASE_RETURN
-    ):
+    """LEASE_GRANT / LEASE_RENEW / LEASE_RETURN request frame. The
+    hierarchy tier's SHARE_* ops reuse the same layout (a pod is a lease
+    client with a long TTL), so they encode through here too."""
+    if msg_type not in LEASE_TYPES and msg_type not in SHARE_TYPES:
         raise ValueError(f"not a lease type: {msg_type}")
     payload = _HEAD.pack(xid, msg_type) + _LEASE_REQ.pack(
         lease_id, flow_id, used, want
@@ -746,9 +789,7 @@ def decode_lease_request(payload: bytes):
     if len(payload) < _HEAD.size + _LEASE_REQ.size:
         raise ValueError("runt lease request frame")
     xid, mtype = _HEAD.unpack_from(payload, 0)
-    if mtype not in (
-        MsgType.LEASE_GRANT, MsgType.LEASE_RENEW, MsgType.LEASE_RETURN
-    ):
+    if mtype not in LEASE_TYPES and mtype not in SHARE_TYPES:
         raise ValueError(f"not a lease type: {mtype}")
     lease_id, flow_id, used, want = _LEASE_REQ.unpack_from(payload, _HEAD.size)
     return xid, MsgType(mtype), lease_id, flow_id, used, want
@@ -785,6 +826,58 @@ def decode_lease_response(payload: bytes) -> LeaseResponse:
     return LeaseResponse(
         xid, MsgType(mtype), status, lease_id, tokens, ttl_ms, endpoint
     )
+
+
+# -- hierarchy tier: demand-report frames -------------------------------------
+# A pod's share agent ships one DEMAND_REPORT per tick: the pod id plus one
+# entry per globally-limited flow carrying the share it holds and the arrival
+# rate it observed (milli-tokens/s, so sub-token rates survive the int wire).
+# The coordinator answers with the shared lease-response frame (status +
+# tokens = entries accepted) — no second response layout to fuzz.
+_DEMAND_HEAD = struct.Struct(">HH")  # pod_len, n_entries
+_DEMAND_ENTRY = struct.Struct(">qqq")  # flow_id, share_id, rate_milli
+MAX_DEMAND_ENTRIES = (
+    MAX_FRAME - _HEAD.size - _DEMAND_HEAD.size - 256
+) // _DEMAND_ENTRY.size
+
+
+def encode_demand_report(
+    xid: int, pod_id: str, entries: List[Tuple[int, int, int]]
+) -> bytes:
+    """DEMAND_REPORT frame: ``entries`` is ``[(flow_id, share_id,
+    rate_milli), ...]``."""
+    pod = pod_id.encode("utf-8")[:256]
+    if len(entries) > MAX_DEMAND_ENTRIES:
+        raise ValueError(f"too many demand entries: {len(entries)}")
+    payload = bytearray(_HEAD.pack(xid, MsgType.DEMAND_REPORT))
+    payload += _DEMAND_HEAD.pack(len(pod), len(entries))
+    payload += pod
+    for flow_id, share_id, rate_milli in entries:
+        payload += _DEMAND_ENTRY.pack(int(flow_id), int(share_id), int(rate_milli))
+    return _LEN.pack(len(payload)) + bytes(payload)
+
+
+def decode_demand_report(payload: bytes):
+    """DEMAND_REPORT payload → ``(xid, pod_id, entries)``. Raises
+    ``ValueError`` on ANY runt, torn, or mistyped payload — the door drops
+    the connection, never a partial decode."""
+    if len(payload) < _HEAD.size + _DEMAND_HEAD.size:
+        raise ValueError("runt demand report frame")
+    xid, mtype = _HEAD.unpack_from(payload, 0)
+    if mtype != MsgType.DEMAND_REPORT:
+        raise ValueError(f"not a demand report: {mtype}")
+    pod_len, n_entries = _DEMAND_HEAD.unpack_from(payload, _HEAD.size)
+    off = _HEAD.size + _DEMAND_HEAD.size
+    need = off + pod_len + n_entries * _DEMAND_ENTRY.size
+    if len(payload) != need:
+        raise ValueError("torn demand report frame")
+    pod_id = payload[off : off + pod_len].decode("utf-8", errors="replace")
+    off += pod_len
+    entries: List[Tuple[int, int, int]] = []
+    for _ in range(n_entries):
+        entries.append(_DEMAND_ENTRY.unpack_from(payload, off))
+        off += _DEMAND_ENTRY.size
+    return xid, pod_id, entries
 
 
 def encode_response(rsp: FlowResponse) -> bytes:

@@ -93,25 +93,55 @@ class ShardMap:
     """Epoch-numbered namespace→endpoint assignment. Immutable; every
     change is a NEW map with a strictly larger epoch, so "is this map
     newer" is one integer compare — the fence stale clients are measured
-    against."""
+    against.
+
+    ``global_flows`` is the hierarchy tier's section: flow_id (as str —
+    the map is a JSON document) → the global budget coordinator's
+    endpoint. It rides the SAME epoch fence, so coordinator failover,
+    MOVE, and routing all agree on one monotonic version — a stale map
+    can no more point an agent at a dead coordinator than it can point a
+    client at a moved namespace."""
 
     epoch: int = 0
     endpoint_of: Mapping[str, str] = field(default_factory=dict)
+    global_flows: Mapping[str, str] = field(default_factory=dict)
 
     def assign(self, namespace: str, endpoint: str) -> "ShardMap":
         """Next-epoch map with ``namespace`` moved to ``endpoint``."""
         m = dict(self.endpoint_of)
         m[namespace] = endpoint
-        return ShardMap(self.epoch + 1, m)
+        return ShardMap(self.epoch + 1, m, dict(self.global_flows))
+
+    def assign_global(self, flow_id, endpoint: str) -> "ShardMap":
+        """Next-epoch map with ``flow_id``'s global budget coordinator at
+        ``endpoint`` (pass ``None``/empty to delist the flow)."""
+        g = dict(self.global_flows)
+        if endpoint:
+            g[str(int(flow_id))] = str(endpoint)
+        else:
+            g.pop(str(int(flow_id)), None)
+        return ShardMap(self.epoch + 1, dict(self.endpoint_of), g)
+
+    def coordinator_of(self, flow_id) -> Optional[str]:
+        return self.global_flows.get(str(int(flow_id)))
 
     def to_doc(self) -> Dict[str, object]:
-        return {"epoch": int(self.epoch), "endpoints": dict(self.endpoint_of)}
+        return {
+            "epoch": int(self.epoch),
+            "endpoints": dict(self.endpoint_of),
+            "global_flows": dict(self.global_flows),
+        }
 
     @staticmethod
     def from_doc(doc: Mapping[str, object]) -> "ShardMap":
         return ShardMap(
             int(doc["epoch"]),
             {str(k): str(v) for k, v in dict(doc["endpoints"]).items()},
+            # absent in pre-hierarchy documents — back-compat default
+            {
+                str(k): str(v)
+                for k, v in dict(doc.get("global_flows") or {}).items()
+            },
         )
 
 

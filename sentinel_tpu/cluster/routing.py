@@ -46,14 +46,20 @@ class _RouteState:
     after construction — reconfiguration builds a replacement and swaps the
     single ``RoutingTokenClient._state`` reference (atomic in CPython)."""
 
-    __slots__ = ("epoch", "namespace_of", "pod_of", "endpoints", "clients")
+    __slots__ = ("epoch", "namespace_of", "pod_of", "endpoints", "clients",
+                 "global_flows")
 
-    def __init__(self, epoch, namespace_of, pod_of, endpoints, clients):
+    def __init__(self, epoch, namespace_of, pod_of, endpoints, clients,
+                 global_flows=None):
         self.epoch = int(epoch)  # shard-map epoch fence
         self.namespace_of: Mapping[int, str] = namespace_of
         self.pod_of: Mapping[str, str] = pod_of
         self.endpoints: Mapping[str, Endpoint] = endpoints
         self.clients: Mapping[str, TokenService] = clients
+        # hierarchy tier: flow_id (str) → global budget coordinator
+        # endpoint, carried verbatim from the shard map's global_flows
+        # section under the same epoch fence
+        self.global_flows: Mapping[str, str] = global_flows or {}
 
     def replace(self, **kw) -> "_RouteState":
         fields = {s: kw.get(s, getattr(self, s)) for s in self.__slots__}
@@ -172,11 +178,23 @@ class RoutingTokenClient(TokenService):
                     continue
                 pod_of[ns] = str(ep_text)
                 endpoints[str(ep_text)] = ep
+            kw = {}
+            gf = getattr(shard_map, "global_flows", None)
+            if gf:
+                # the hierarchy section replaces wholesale — it is part of
+                # the same epoched document, not a per-entry merge
+                kw["global_flows"] = dict(gf)
             self._state = st.replace(
                 epoch=int(shard_map.epoch), pod_of=pod_of,
-                endpoints=endpoints,
+                endpoints=endpoints, **kw,
             )
         return True
+
+    def coordinator_of(self, flow_id) -> Optional[str]:
+        """The global budget coordinator endpoint for ``flow_id`` per the
+        installed shard map's ``global_flows`` section, or None when the
+        flow has no hierarchical budget. Lock-free snapshot read."""
+        return self._state.global_flows.get(str(int(flow_id)))
 
     def _learn_move(self, namespace: str, ep_text: str, epoch: int) -> bool:
         """Install a single route learned from a MOVED redirect. Same epoch

@@ -265,6 +265,69 @@ class _LoopWorker:
                         ))
                         await writer.drain()
                         continue
+                    if mtype in P.HIER_TYPES:
+                        # hierarchy tier: pod share agents leasing from the
+                        # co-located global budget coordinator. Control-
+                        # plane-rare (one frame per agent tick); the
+                        # coordinator is a host-side ledger, so to_thread
+                        # keeps its lock wait off the event loop.
+                        hier = getattr(srv.service, "hierarchy", None)
+                        try:
+                            if mtype == P.MsgType.DEMAND_REPORT:
+                                xid, pod_id, entries = (
+                                    P.decode_demand_report(payload)
+                                )
+                                hmt = P.MsgType.DEMAND_REPORT
+                                args = (pod_id, entries)
+                            else:
+                                (xid, hmt, share_id, hflow, used, want) = (
+                                    P.decode_lease_request(payload)
+                                )
+                                args = (share_id, hflow, used, want)
+                        except Exception:
+                            record_log.warning(
+                                "bad hier frame from agent; closing"
+                            )
+                            return
+                        srv.connections.touch(address)
+                        if srv.is_standby:
+                            writer.write(P.encode_lease_response(
+                                xid, hmt, _STANDBY
+                            ))
+                            await writer.drain()
+                            continue
+                        if hier is None:
+                            # no coordinator co-located here: refuse, the
+                            # agent's failover walk tries the next endpoint
+                            writer.write(P.encode_lease_response(
+                                xid, hmt, P.NOT_LEASABLE_STATUS
+                            ))
+                            await writer.drain()
+                            continue
+                        if hmt == P.MsgType.DEMAND_REPORT:
+                            res = await asyncio.to_thread(
+                                hier.handle_demand_report, *args
+                            )
+                        elif hmt == P.MsgType.SHARE_GRANT:
+                            res = await asyncio.to_thread(
+                                hier.share_grant, args[1], args[3]
+                            )
+                        elif hmt == P.MsgType.SHARE_RENEW:
+                            res = await asyncio.to_thread(
+                                hier.share_renew,
+                                args[0], args[1], args[2], args[3],
+                            )
+                        else:
+                            res = await asyncio.to_thread(
+                                hier.share_return, args[0], args[2]
+                            )
+                        writer.write(P.encode_lease_response(
+                            xid, hmt, int(res.status),
+                            lease_id=res.lease_id, tokens=res.tokens,
+                            ttl_ms=res.ttl_ms, endpoint=res.endpoint,
+                        ))
+                        await writer.drain()
+                        continue
                     if mtype == P.MsgType.BATCH_FLOW:
                         # vectorized decode; no per-request Python objects
                         try:

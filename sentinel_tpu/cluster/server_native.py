@@ -1090,6 +1090,17 @@ class NativeTokenServer:
                 return
             door.send(fd, gen, rsp_bytes)
             return
+        # hierarchy-tier frames (pod share ops + demand reports): same
+        # control-lane treatment, dispatched to the co-located coordinator
+        if len(payload) >= 5 and P.peek_type(payload) in P.HIER_TYPES:
+            try:
+                rsp_bytes = self._handle_hier(payload, address)
+            except ValueError:
+                record_log.warning("bad hier frame; closing %s", address)
+                door.close_conn(fd, gen)
+                return
+            door.send(fd, gen, rsp_bytes)
+            return
         try:
             req = P.decode_request(payload)
         except Exception:
@@ -1138,6 +1149,49 @@ class NativeTokenServer:
             )
         return P.encode_lease_response(
             xid, lmt, int(res.status), lease_id=res.lease_id,
+            tokens=res.tokens, ttl_ms=res.ttl_ms, endpoint=res.endpoint,
+        )
+
+    def _handle_hier(self, payload, address: str) -> bytes:
+        """Hierarchy tier: decode a share op or demand report, run the
+        co-located coordinator's ledger op, encode the (lease-layout)
+        reply. Raises ValueError on a torn frame (caller closes)."""
+        mtype = P.peek_type(payload)
+        if mtype == int(P.MsgType.DEMAND_REPORT):
+            xid, pod_id, entries = P.decode_demand_report(payload)
+            hmt = P.MsgType.DEMAND_REPORT
+            args = None
+        else:
+            xid, hmt, share_id, flow_id, used, want = (
+                P.decode_lease_request(payload)
+            )
+            args = (share_id, flow_id, used, want)
+        self.connections.touch(address)
+        if self.is_standby:
+            return P.encode_lease_response(xid, hmt, _STANDBY)
+        hier = getattr(self.service, "hierarchy", None)
+        if hier is None:
+            # no coordinator co-located here: refuse so the agent's
+            # failover walk tries the next endpoint
+            return P.encode_lease_response(
+                xid, hmt, P.NOT_LEASABLE_STATUS
+            )
+        try:
+            if hmt == P.MsgType.DEMAND_REPORT:
+                res = hier.handle_demand_report(pod_id, entries)
+            elif hmt == P.MsgType.SHARE_GRANT:
+                res = hier.share_grant(args[1], args[3])
+            elif hmt == P.MsgType.SHARE_RENEW:
+                res = hier.share_renew(args[0], args[1], args[2], args[3])
+            else:
+                res = hier.share_return(args[0], args[2])
+        except Exception:
+            record_log.exception("hier op failed")
+            return P.encode_lease_response(
+                xid, hmt, int(TokenStatus.FAIL)
+            )
+        return P.encode_lease_response(
+            xid, hmt, int(res.status), lease_id=res.lease_id,
             tokens=res.tokens, ttl_ms=res.ttl_ms, endpoint=res.endpoint,
         )
 

@@ -404,6 +404,23 @@ class DefaultTokenService(TokenService):
                 _self()
             )
         )
+        # hierarchy tier (cluster/hierarchy.py): when this pod participates
+        # in a global flow budget, its share agent pins the UNPROVISIONED
+        # remainder of the budget as a LEASED-column hold — local headroom
+        # == the pod's share with zero hot-path changes. Entries are
+        # (granted_ms, tokens) charges with the same exact-bucket lifecycle
+        # as leases; the agent re-tops them every tick because bucket
+        # rotation expires them (conservative: a stale hold only
+        # under-admits). `hierarchy` is the co-located coordinator, if any;
+        # both doors route HIER_TYPES frames to it.
+        self._share_holds: Dict[int, List[Tuple[int, int]]] = {}
+        self.hierarchy = None
+        self.share_agent = None
+        _SM.register_hier_provider(
+            lambda: (lambda s: s.hier_stats() if s is not None else {})(
+                _self()
+            )
+        )
 
     @staticmethod
     def _prep_batch(cfg, slots, acq, pr):
@@ -1438,6 +1455,13 @@ class DefaultTokenService(TokenService):
                 for lid in dead:
                     del self._leases[lid]
                 self._lease_stats["revoked"] += len(dead)
+            # same contract for hierarchy share holds: the LEASED hold
+            # charge rides the window-sum export to the new owner (so the
+            # global budget stays pinned through the handoff) while the
+            # registry drops — the destination's own share agent re-tops
+            # its hold from ITS share on its next tick
+            for fid in flows:
+                self._share_holds.pop(int(fid), None)
 
     def abort_move(self, namespace: str) -> None:
         """Restore normal serving for ``namespace``. Lossless by
@@ -1663,6 +1687,196 @@ class DefaultTokenService(TokenService):
                 l.tokens for l in self._leases.values()
             )
             return out
+
+    # -- hierarchy tier: global-budget share holds ---------------------------
+    # A globally-limited flow is loaded locally at its FULL global budget;
+    # the pod's share agent then pins (window_budget − share) tokens as a
+    # LEASED-column "hold", leaving exactly the pod's share as local
+    # headroom. The decision hot path is untouched — the device kernel
+    # already reads LEASED — and psum'd limits, snapshots, deltas, and MOVE
+    # all carry the hold automatically, like any lease charge.
+
+    def _live_hold_locked(self, spec, entries, now):
+        """Filter hold entries to those whose grant bucket still counts
+        toward the window sum: start-stamp equality (the bucket was never
+        reused — same proof as lease credit) AND in-window age (the same
+        ``(now − interval, now]`` test as ``stats.window.valid_mask``).
+        Stamp equality alone is not enough: a rotated-out bucket keeps its
+        stale stamp until some writer reuses it, so an age-expired hold
+        would look live here while the admission read already dropped it —
+        and the re-top would never fire. Expired entries are simply gone:
+        their charge aged out with the bucket, so the hold decayed and the
+        agent must re-top it (the conservative direction: a decayed hold
+        admits MORE locally, only up to the full budget, and only until
+        the next agent tick)."""
+        starts = np.asarray(self._state.flow.starts)
+        live = []
+        for granted_ms, tokens in entries:
+            idx = int((granted_ms // spec.bucket_ms) % spec.n_buckets)
+            aligned = int(granted_ms - granted_ms % spec.bucket_ms)
+            age = int(now) - aligned
+            if int(starts[idx]) == aligned and 0 <= age < spec.interval_ms:
+                live.append((granted_ms, tokens))
+        return live
+
+    def set_share_hold(self, flow_id: int, hold_tokens: int) -> int:
+        """Pin exactly ``hold_tokens`` of ``flow_id``'s window as a
+        LEASED-column hold. A hold is a STANDING reservation, not traffic:
+        left where it was charged it would age out of the sliding window
+        one interval later and dump its whole worth of headroom at once
+        (a flat-out client eats that before the next tick — measured, not
+        hypothetical). So every call *migrates* the hold forward: live
+        entries are credited back into their exact grant buckets
+        (start-stamp guarded, same invariant as lease credit) and the full
+        target re-charges into the CURRENT bucket — the window sum is
+        unchanged within the call, and as long as the agent ticks more
+        often than one window the hold never decays. If ticks stop
+        entirely (agent dead), the hold expires one window later and the
+        flow reverts to its full local budget — the documented degrade.
+        Returns the live hold after the call."""
+        from sentinel_tpu.engine.state import (
+            N_CLUSTER_EVENTS, ClusterEvent, flow_spec,
+        )
+
+        flow_id = int(flow_id)
+        hold_tokens = max(0, int(hold_tokens))
+        with self._lock:
+            slot = self._index.slot_of.get(flow_id)
+            if slot is None:
+                self._share_holds.pop(flow_id, None)
+                return 0
+            spec = flow_spec(self.config)
+            now = self._engine_now()
+            entries = self._live_hold_locked(
+                spec, self._share_holds.get(flow_id, []), now
+            )
+            ws = self._state.flow
+            counts = ws.counts
+            for granted_ms, tokens in entries:
+                idx = int((granted_ms // spec.bucket_ms) % spec.n_buckets)
+                counts = counts.at[
+                    slot, idx, int(ClusterEvent.LEASED)
+                ].add(jnp.asarray(-tokens, counts.dtype))
+            ws = ws._replace(counts=counts)
+            if hold_tokens > 0:
+                row = [0] * int(N_CLUSTER_EVENTS)
+                row[int(ClusterEvent.LEASED)] = hold_tokens
+                ws = self._fold_into_current(ws, spec, now, [slot], [row])
+                self._share_holds[flow_id] = [(now, hold_tokens)]
+            else:
+                self._share_holds.pop(flow_id, None)
+            self._state = self._state._replace(flow=ws)
+            if self._dirty is not None:
+                self._dirty["flow"].add(int(slot))
+            return hold_tokens
+
+    def share_holds(self) -> Dict[int, int]:
+        """Live hold tokens per flow (rotation-decayed entries excluded)."""
+        from sentinel_tpu.engine.state import flow_spec
+
+        with self._lock:
+            spec = flow_spec(self.config)
+            now = self._engine_now()
+            out = {
+                fid: sum(
+                    t for _, t in self._live_hold_locked(spec, ents, now)
+                )
+                for fid, ents in self._share_holds.items()
+            }
+            # a fully-decayed hold is indistinguishable from no hold — the
+            # registry entry is just garbage awaiting the next set
+            return {fid: t for fid, t in out.items() if t > 0}
+
+    def window_budget(self, flow_id: int) -> int:
+        """The flow's full per-window token budget — the same threshold
+        the device kernel enforces (count × connected-factor ×
+        exceed_count × window). The share agent holds
+        ``window_budget − share`` so local headroom equals the share."""
+        from sentinel_tpu.engine.rules import ThresholdMode
+        from sentinel_tpu.engine.state import flow_spec
+
+        with self._lock:
+            rule = self._rule_of.get(int(flow_id))
+            if rule is None:
+                return 0
+            spec = flow_spec(self.config)
+            factor = (
+                max(1, int(self._connected.get(rule.namespace, 1)))
+                if rule.mode == ThresholdMode.AVG_LOCAL else 1
+            )
+            return int(
+                float(rule.count) * factor * self.config.exceed_count
+                * (spec.interval_ms / 1000.0)
+            )
+
+    def demand_rates(self, flow_ids) -> Dict[int, float]:
+        """Observed arrival rate per flow in tokens/s: (PASS + BLOCK)
+        window sums over the window interval. BLOCK counts *blocked*
+        tokens, so a pod squeezed to a tiny share still reports its true
+        demand — which is exactly what lets the coordinator's
+        water-filling move share back toward it."""
+        from sentinel_tpu.engine.state import ClusterEvent, flow_spec
+        from sentinel_tpu.stats import window as W
+
+        out: Dict[int, float] = {}
+        known = []
+        with self._lock:
+            spec = flow_spec(self.config)
+            now32 = jnp.int32(self._engine_now())
+            for fid in flow_ids:
+                slot = self._index.slot_of.get(int(fid))
+                if slot is None:
+                    out[int(fid)] = 0.0
+                else:
+                    known.append((int(fid), int(slot)))
+            if known:
+                ids = jnp.asarray(
+                    np.asarray([s for _, s in known], np.int32)
+                )
+                sums = np.asarray(
+                    W.window_sum_at(spec, self._state.flow, now32,
+                                    int(ClusterEvent.PASS), ids)
+                    + W.window_sum_at(spec, self._state.flow, now32,
+                                      int(ClusterEvent.BLOCK), ids)
+                )
+                interval_s = spec.interval_ms / 1000.0
+                for (fid, _), v in zip(known, sums):
+                    out[fid] = float(v) / interval_s
+        return out
+
+    def attach_hierarchy(self, coordinator) -> None:
+        """Co-locate the global budget coordinator with this pod: both
+        doors route HIER_TYPES frames to it, its ledger piggybacks on
+        this service's replication stream, and its counters join
+        ``hier_stats``."""
+        self.hierarchy = coordinator
+
+    def attach_share_agent(self, agent) -> None:
+        """Register this pod's share agent so its counters join
+        ``hier_stats`` (the agent itself talks to the coordinator over
+        the wire, not through the service)."""
+        self.share_agent = agent
+
+    def hier_stats(self) -> Dict[str, object]:
+        """Counter block behind the ``sentinel_hier_*`` series: agent-side
+        share/tick counters overlaid (coordinator wins) with the
+        coordinator ledger, when either is attached."""
+        out: Dict[str, object] = {}
+        agent = self.share_agent
+        if agent is not None:
+            try:
+                out.update(agent.stats())
+            except Exception:  # pragma: no cover - stats never raise
+                pass
+        coord = self.hierarchy
+        if coord is not None:
+            try:
+                out.update(coord.stats())
+            except Exception:  # pragma: no cover
+                pass
+        if out:
+            out["hold_tokens"] = sum(self.share_holds().values())
+        return out
 
     @staticmethod
     def _fold_into_current(ws, spec, now: int, rows, sums):
@@ -1896,6 +2110,13 @@ class DefaultTokenService(TokenService):
                     "slim_auth": np.asarray(self._param_state.slim_auth),
                     "merges": np.asarray(self._param_state.merges),
                 },
+                # hierarchy ledger piggyback (pure JSON; absent when no
+                # coordinator is co-located). A standby imports it into ITS
+                # attached coordinator so promotion inherits the share map.
+                **(
+                    {"hier": self.hierarchy.export_doc()}
+                    if self.hierarchy is not None else {}
+                ),
             }
 
     def import_state(self, state: Dict[str, object]) -> None:
@@ -2017,6 +2238,12 @@ class DefaultTokenService(TokenService):
                 # advancing, so windows older than interval_ms expire on the
                 # next read instead of resurrecting stale quota
                 self._epoch_ms = int(state["epoch_ms"])
+        # hierarchy ledger piggyback: a standby with an attached (idle)
+        # coordinator inherits the primary's share map, so promotion keeps
+        # every pod's share continuous
+        hier_doc = state.get("hier")
+        if hier_doc is not None and self.hierarchy is not None:
+            self.hierarchy.import_doc(hier_doc)
 
     # -- warm-standby delta replication (ha.replication backing) -------------
     def replication_enable(self) -> None:
@@ -2123,6 +2350,11 @@ class DefaultTokenService(TokenService):
                     delta["param_counts"] = host_rows(
                         self._param_state.counts, pr
                     )
+            if self.hierarchy is not None:
+                # hier ledger rides every tick as plain JSON (non-array keys
+                # pass through encode_delta_blob untouched); it's tiny — one
+                # entry per (global flow × pod)
+                delta["hier"] = self.hierarchy.export_doc()
             return delta
 
     def apply_replication_delta(self, delta: Dict[str, object]) -> None:
@@ -2263,6 +2495,12 @@ class DefaultTokenService(TokenService):
                 starts=jnp.asarray(delta["param_starts"]), counts=pcounts,
                 slim=pslim, slim_auth=pauth,
             )
+        # hier ledger piggyback: landed OUTSIDE the counter locks (the
+        # coordinator has its own) and only when a coordinator is attached —
+        # an old standby without one ignores the key, like any unknown key
+        hier_doc = delta.get("hier")
+        if hier_doc is not None and self.hierarchy is not None:
+            self.hierarchy.import_doc(hier_doc)
 
     # -- introspection (FetchClusterMetricCommandHandler analog) ------------
     def sketch_stats(self) -> Dict[str, object]:
@@ -2292,5 +2530,20 @@ class DefaultTokenService(TokenService):
                     "pass_qps": float(sums[slot, ClusterEvent.PASS]) / interval_s,
                     "block_qps": float(sums[slot, ClusterEvent.BLOCK]) / interval_s,
                     "pass_req_qps": float(sums[slot, ClusterEvent.PASS_REQUEST]) / interval_s,
+                    # hierarchy tier reads this for fleet-wide occupancy:
+                    # live LEASED charge (client leases + share holds)
+                    "leased_tokens": float(sums[slot, ClusterEvent.LEASED]),
                 }
+                rule = self._rule_of.get(fid)
+                mv = (
+                    self._moving.get(rule.namespace)
+                    if rule is not None else None
+                )
+                if mv is not None:
+                    # MOVING / committed-away: the counters froze at the
+                    # begin-move device step and the DESTINATION now counts
+                    # this flow. Stamp the shard-map epoch so
+                    # aggregate_snapshots can drop this pod's stale copy
+                    # instead of double-reporting during the redirect window.
+                    out[fid]["moved_epoch"] = float(mv[1])
             return out

@@ -318,6 +318,64 @@ class FailoverTokenClient(TokenService):
         ha_metrics().count_fallback("release_dropped")
         return TokenResult(TokenStatus.RELEASE_OK)
 
+    # -- hierarchy tier (share agent → coordinator) --------------------------
+    def _hier_call(self, op: Callable):
+        """Endpoint walk for hierarchy control ops (share grant/renew/
+        return, demand report). STANDBY replies walk on as usual;
+        NOT_LEASABLE is ambiguous here — the true coordinator refusing
+        headroom, or a door with no coordinator attached — so it walks on
+        too but is REMEMBERED and returned when no endpoint answers
+        better (the agent treats it as an authoritative zero-share)."""
+        deadline = _clock.now_ms() + self.deadline_ms
+        refusal = None
+        for i, member in enumerate(self._members):
+            if not member.health.allows_request():
+                continue
+            try:
+                result = op(member)
+            except Exception:
+                record_log.exception(
+                    "hier endpoint %s raised; treating as failure",
+                    member.endpoint,
+                )
+                result = None
+            if result is None:
+                member.health.record_failure()
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
+            member.health.record_success()
+            if int(result.status) == int(TokenStatus.STANDBY):
+                ha_metrics().count_fallback("standby_redirect")
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
+            if int(result.status) == int(TokenStatus.NOT_LEASABLE):
+                if refusal is None:
+                    refusal = result
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
+            self._note_served(i)
+            return result
+        return refusal
+
+    def share_op(self, msg_type, flow_id, want=0, share_id=0, used=0):
+        """Walk endpoints for a SHARE_* op; returns ``P.LeaseResponse``
+        or None when nothing answered."""
+        return self._hier_call(
+            lambda m: m.client.share_op(
+                msg_type, flow_id, want, share_id=share_id, used=used
+            )
+        )
+
+    def demand_report(self, pod_id, entries):
+        """Walk endpoints for a DEMAND_REPORT; returns the ack
+        ``P.LeaseResponse`` or None."""
+        return self._hier_call(
+            lambda m: m.client.demand_report(pod_id, entries)
+        )
+
     def request_batch_arrays(self, flow_ids, acquires=None, prios=None,
                              timeout_ms: Optional[int] = None):
         def op(member):

@@ -119,6 +119,9 @@ def encode_snapshot(state: Dict[str, object]) -> Dict[str, object]:
         "occupy": _enc_win(state["occupy"]),
         "ns": _enc_win(state["ns"]),
         "param": _enc_win(state["param"]),
+        # hierarchy-coordinator ledger piggyback (already JSON-safe; absent
+        # when no coordinator is co-located with this pod)
+        **({"hier": state["hier"]} if "hier" in state else {}),
     }
 
 
@@ -163,6 +166,7 @@ def decode_snapshot(doc: Dict[str, object]) -> Dict[str, object]:
         "occupy": _dec_win(doc["occupy"]),
         "ns": _dec_win(doc["ns"]),
         "param": _dec_win(doc["param"]),
+        **({"hier": doc["hier"]} if "hier" in doc else {}),
     }
 
 

@@ -196,6 +196,18 @@ def render(now_ms: Optional[int] = None) -> str:
         f"sentinel_assignment_snapshot_errors_total "
         f"{_namespaces.snapshot_error_total()}"
     )
+    lines.append(
+        "# HELP sentinel_assignment_move_dedup_total Mid-MOVE duplicate "
+        "flow copies dropped during cross-pod aggregation (source pod "
+        "still reporting a moved namespace's frozen window)."
+    )
+    lines.append(
+        "# TYPE sentinel_assignment_move_dedup_total counter"
+    )
+    lines.append(
+        f"sentinel_assignment_move_dedup_total "
+        f"{_namespaces.move_dedup_total()}"
+    )
     return "\n".join(lines) + "\n"
 
 

@@ -154,6 +154,8 @@ class ServerMetrics:
         # most-recent-wins weakref model as the sketch provider.
         self._lease_provider: Optional[Callable[[], dict]] = None
         self._lease_lock = threading.Lock()
+        self._hier_provider: Optional[Callable[[], dict]] = None
+        self._hier_lock = threading.Lock()
 
     # -- fused dispatch counters --------------------------------------------
     def record_fused(self, depth: int) -> None:
@@ -369,6 +371,25 @@ class ServerMetrics:
         except Exception:
             return {}  # a torn-down service's reader must not 500 a scrape
 
+    # -- hierarchy provider -------------------------------------------------
+    def register_hier_provider(self, fn: Callable[[], dict]) -> None:
+        """Install the zero-arg reader for the hierarchy tier's stats
+        (``DefaultTokenService.hier_stats`` shape — coordinator ledger
+        and/or share-agent counters, ``{}`` when neither is attached).
+        Most recent registration wins."""
+        with self._hier_lock:
+            self._hier_provider = fn
+
+    def hier_stats(self) -> dict:
+        with self._hier_lock:
+            fn = self._hier_provider
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:
+            return {}  # a torn-down service's reader must not 500 a scrape
+
     # -- snapshots ----------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON shape served by the ``clusterServerStats`` command — the
@@ -391,6 +412,7 @@ class ServerMetrics:
             "sketch": self.sketch_stats(),
             "shm": self.shm_stats(),
             "lease": self.lease_stats(),
+            "hier": self.hier_stats(),
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -584,6 +606,36 @@ class ServerMetrics:
             lines.append(f"# HELP {mname} {help_text}")
             lines.append(f"# TYPE {mname} gauge")
             lines.append(f"{mname} {int(lease.get(skey, 0) or 0)}")
+        hier = self.hier_stats()
+        if hier:
+            for mname, skey, help_text in (
+                ("sentinel_hier_share_grants_total", "share_grants",
+                 "Global-budget shares granted/regranted to pods by the "
+                 "coordinator (cumulative)."),
+                ("sentinel_hier_reconciles_total", "reconciles",
+                 "Coordinator reconciliation passes: water-fill share "
+                 "targets over reported demand (cumulative)."),
+                ("sentinel_hier_demand_reports_total", "demand_reports",
+                 "Per-tick pod demand reports received by the coordinator "
+                 "(cumulative)."),
+            ):
+                lines.append(f"# HELP {mname} {help_text}")
+                lines.append(f"# TYPE {mname} counter")
+                lines.append(f"{mname} {int(hier.get(skey, 0) or 0)}")
+            shares = hier.get("share_tokens") or {}
+            if isinstance(shares, dict):
+                lines.append(
+                    "# HELP sentinel_hier_share_tokens Tokens of the global "
+                    "budget currently provisioned to pod shares, per flow "
+                    "(coordinator view when co-located, else this pod's own "
+                    "share)."
+                )
+                lines.append("# TYPE sentinel_hier_share_tokens gauge")
+                for fid in sorted(shares, key=str):
+                    lines.append(
+                        f'sentinel_hier_share_tokens{{flow="{fid}"}} '
+                        f"{int(shares[fid] or 0)}"
+                    )
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -653,6 +705,8 @@ class ServerMetrics:
             self._shm_provider = None
         with self._lease_lock:
             self._lease_provider = None
+        with self._hier_lock:
+            self._hier_provider = None
         self._rate.reset()
 
 

@@ -1,0 +1,413 @@
+"""Hierarchical global limits (multi-pod tier): unit + kill coverage.
+
+Coordinator side: water-fill share proration conserves the budget exactly,
+grants/renews never let live shares exceed it, TTL expiry reclaims a dead
+pod's tokens, and the ledger piggybacks losslessly on snapshots. Pod side:
+the LEASED share hold squeezes local headroom to exactly the share, grows
+and shrinks precisely, decays one window after the agent stops re-topping
+it, and a MOVE carries the charge to the destination while recalling the
+registry. Wire side: the demand-report codec rejects every truncation cut,
+and a killed coordinator leaves both pods holding their last share with
+total admissions bounded by the global budget.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sentinel_tpu.cluster import namespaces as NS
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.hierarchy import (
+    GlobalBudgetCoordinator,
+    GlobalFlowBudget,
+    PodShareAgent,
+    water_fill,
+)
+from sentinel_tpu.cluster.server import TokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.ha.snapshot import decode_snapshot, encode_snapshot
+
+G = ThresholdMode.GLOBAL
+# default window: 10 x 100ms buckets -> threshold == rule count per window
+CFG = EngineConfig(max_flows=64, max_namespaces=8, batch_size=64)
+FLOW = 101
+
+
+def _svc(count=50.0, ns="default", **kw):
+    svc = DefaultTokenService(CFG, **kw)
+    svc.load_rules([ClusterFlowRule(FLOW, count, G, ns)])
+    return svc
+
+
+def _drain(svc, flow=FLOW):
+    """Admit until BLOCKED; returns how many decisions passed — the flow's
+    remaining window headroom as the decide kernel sees it."""
+    passed = 0
+    while svc.request_token(flow).ok:
+        passed += 1
+        assert passed <= 1000, "window never closed"
+    return passed
+
+
+def _coord(budget=100.0, **kw):
+    kw.setdefault("share_ttl_ms", 5000)
+    return GlobalBudgetCoordinator(
+        [GlobalFlowBudget(FLOW, budget, 1.0)], **kw
+    )
+
+
+# -- water-fill proration -----------------------------------------------------
+class TestWaterFill:
+    def test_under_demand_splits_slack_equally(self):
+        # demand fits: everyone gets their ask, idle headroom parks evenly
+        assert water_fill(100, {"a": 60.0, "b": 20.0}) == {"a": 70, "b": 30}
+
+    def test_over_demand_levels_the_fill(self):
+        assert water_fill(100, {"a": 500.0, "b": 100.0}) == {"a": 50, "b": 50}
+
+    def test_floor_keeps_a_collapsed_pod_alive(self):
+        assert water_fill(100, {"a": 500.0, "b": 0.0}, floor=10) == {
+            "a": 90, "b": 10,
+        }
+
+    def test_floors_exceeding_budget_degrade_to_equal_split(self):
+        out = water_fill(10, {"a": 5.0, "b": 5.0, "c": 5.0}, floor=6)
+        assert sum(out.values()) == 10
+        assert max(out.values()) - min(out.values()) <= 1
+
+    def test_empty_and_zero_budget(self):
+        assert water_fill(100, {}) == {}
+        assert water_fill(0, {"a": 5.0}) == {"a": 0}
+
+    def test_fuzz_conserves_budget_and_order(self):
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        for _ in range(300):
+            n = int(rng.integers(1, 7))
+            budget = int(rng.integers(1, 1000))
+            demands = {
+                f"p{i}": float(rng.integers(0, 2000)) for i in range(n)
+            }
+            floor = int(rng.integers(0, max(1, budget // n)))
+            out = water_fill(budget, demands, floor)
+            # exact conservation: shares are integers summing to the budget
+            assert sum(out.values()) == budget, (budget, demands, floor, out)
+            # determinism
+            assert out == water_fill(budget, demands, floor)
+            # weak monotonicity: more demand never earns a smaller share
+            # (± 1 token of remainder rounding)
+            pods = sorted(demands, key=lambda p: demands[p])
+            for lo, hi in zip(pods, pods[1:]):
+                if demands[hi] > demands[lo]:
+                    assert out[hi] >= out[lo] - 1, (demands, floor, out)
+
+
+# -- demand-report codec ------------------------------------------------------
+class TestDemandReportCodec:
+    ENTRIES = [(FLOW, 9, 1500), (7, 0, 0), (-3, 2**40, -250)]
+
+    LEN = P._LEN.size  # frames are length-prefixed; decode takes the payload
+
+    def test_roundtrip(self):
+        frame = P.encode_demand_report(42, "pod-a", self.ENTRIES)
+        payload = bytes(frame[self.LEN:])
+        xid, pod, entries = P.decode_demand_report(payload)
+        assert (xid, pod, list(entries)) == (42, "pod-a", self.ENTRIES)
+
+    def test_empty_entries_roundtrip(self):
+        payload = bytes(P.encode_demand_report(1, "p", [])[self.LEN:])
+        assert P.decode_demand_report(payload) == (1, "p", [])
+
+    def test_every_truncation_cut_raises(self):
+        payload = bytes(
+            P.encode_demand_report(42, "pod-a", self.ENTRIES)[self.LEN:]
+        )
+        for cut in range(len(payload)):
+            with pytest.raises(ValueError):
+                P.decode_demand_report(payload[:cut])
+
+    def test_trailing_garbage_raises(self):
+        payload = bytes(
+            P.encode_demand_report(42, "pod-a", self.ENTRIES)[self.LEN:]
+        )
+        with pytest.raises(ValueError):
+            P.decode_demand_report(payload + b"\x00")
+
+    def test_mistyped_frame_raises(self):
+        payload = bytearray(P.encode_demand_report(1, "p", [])[self.LEN:])
+        payload[4] = int(P.MsgType.SHARE_GRANT)  # flip the type byte
+        with pytest.raises(ValueError):
+            P.decode_demand_report(bytes(payload))
+
+
+# -- coordinator ledger -------------------------------------------------------
+class TestCoordinatorLedger:
+    def test_grants_never_exceed_budget(self, manual_clock):
+        c = _coord(100.0)
+        a = c.share_grant(FLOW, 80)
+        b = c.share_grant(FLOW, 80)
+        assert a.status == 0 and a.tokens == 80 and a.lease_id > 0
+        # only 20 left in the pool
+        assert b.status == 0 and b.tokens == 20
+        assert c.outstanding_shares() == 100
+
+    def test_exhausted_pool_grants_zero_not_refusal(self, manual_clock):
+        c = _coord(100.0)
+        c.share_grant(FLOW, 100)
+        r = c.share_grant(FLOW, 50)
+        # an authoritative zero: OK with no tokens (the agent pins the full
+        # budget as hold), NOT a NOT_LEASABLE degrade signal
+        assert r.status == 0 and r.tokens == 0 and r.lease_id == 0
+
+    def test_unknown_flow_is_not_leasable(self, manual_clock):
+        assert _coord().share_grant(999, 10).status == int(
+            P.NOT_LEASABLE_STATUS
+        )
+
+    def test_renew_reclaims_own_tokens_first(self, manual_clock):
+        c = _coord(100.0)
+        g = c.share_grant(FLOW, 100)
+        # pool is empty, but a renew drops the old share FIRST — the pod
+        # can always reclaim at least its own tokens
+        r = c.share_renew(g.lease_id, FLOW, 0, 100)
+        assert r.tokens == 100 and r.lease_id != g.lease_id
+        assert c.outstanding_shares() == 100
+
+    def test_return_frees_the_pool(self, manual_clock):
+        c = _coord(100.0)
+        g = c.share_grant(FLOW, 100)
+        assert c.share_return(g.lease_id, 0).status == 0
+        assert c.share_return(g.lease_id, 0).status == 0  # idempotent
+        assert c.share_grant(FLOW, 100).tokens == 100
+
+    def test_ttl_expiry_reclaims_a_dead_pods_share(self, manual_clock):
+        c = _coord(100.0, share_ttl_ms=500)
+        c.share_grant(FLOW, 100)
+        manual_clock.advance(501)
+        assert c.outstanding_shares() == 0
+        assert c.stats()["share_expired"] == 1
+        assert c.share_grant(FLOW, 100).tokens == 100
+
+    def test_demand_report_labels_shares_and_targets_follow(
+        self, manual_clock
+    ):
+        c = _coord(100.0, min_share_frac=0.05)
+        ga = c.share_grant(FLOW, 50)
+        gb = c.share_grant(FLOW, 50)
+        # rate_milli: a observes 60 tokens/s, b 40 tokens/s
+        assert c.handle_demand_report(
+            "a", [(FLOW, ga.lease_id, 60_000)]
+        ).tokens == 1
+        c.handle_demand_report("b", [(FLOW, gb.lease_id, 40_000)])
+        targets = c.reconcile_once()[FLOW]
+        assert targets == {"a": 60, "b": 40}
+        # hysteresis: a 2-token wobble (< 10% of budget) keeps old targets
+        c.handle_demand_report("a", [(FLOW, ga.lease_id, 62_000)])
+        c.handle_demand_report("b", [(FLOW, gb.lease_id, 38_000)])
+        assert c.reconcile_once()[FLOW] == {"a": 60, "b": 40}
+        # a real flip (> 10% of budget) moves them
+        c.handle_demand_report("a", [(FLOW, ga.lease_id, 90_000)])
+        c.handle_demand_report("b", [(FLOW, gb.lease_id, 10_000)])
+        assert c.reconcile_once()[FLOW] == {"a": 90, "b": 10}
+
+    def test_stale_demand_ages_out(self, manual_clock):
+        c = _coord(100.0, share_ttl_ms=500)
+        c.handle_demand_report("a", [(FLOW, 0, 60_000)])
+        assert c.reconcile_once()[FLOW] != {}
+        manual_clock.advance(1001)  # 2 x share_ttl_ms
+        assert c.reconcile_once()[FLOW] == {}
+
+    def test_ledger_doc_roundtrip(self, manual_clock):
+        c = _coord(100.0)
+        g = c.share_grant(FLOW, 70)
+        c.handle_demand_report("a", [(FLOW, g.lease_id, 60_000)])
+        c.reconcile_once()
+        d = _coord(100.0)
+        d.import_doc(c.export_doc())
+        assert d.outstanding_shares() == 70
+        assert d.stats()["targets"] == c.stats()["targets"]
+        # the promoted standby keeps allocating from where the primary left
+        assert d.share_grant(FLOW, 100).tokens == 30
+
+
+# -- pod-side share holds -----------------------------------------------------
+class TestShareHolds:
+    def test_hold_squeezes_headroom_exactly(self, manual_clock):
+        svc = _svc(50.0)
+        assert svc.set_share_hold(FLOW, 30) == 30
+        assert svc.share_holds() == {FLOW: 30}
+        assert _drain(svc) == 20
+
+    def test_hold_grows_and_shrinks_exactly(self, manual_clock):
+        svc = _svc(50.0)
+        svc.set_share_hold(FLOW, 30)
+        assert _drain(svc) == 20            # PASS 20 + LEASED 30 = 50
+        svc.set_share_hold(FLOW, 10)        # shrink frees 20
+        assert _drain(svc) == 20            # PASS 40 + LEASED 10 = 50
+        svc.set_share_hold(FLOW, 45)        # grow past the window: shut
+        assert _drain(svc) == 0
+        svc.set_share_hold(FLOW, 0)         # drop entirely
+        assert svc.share_holds() == {}
+        assert _drain(svc) == 10            # PASS 40 of 50 remain charged
+
+    def test_hold_decays_one_window_after_agent_stops(self, manual_clock):
+        svc = _svc(50.0)
+        svc.request_token(FLOW)  # pin the engine epoch before the hold
+        svc.set_share_hold(FLOW, 30)
+        manual_clock.advance(1001)  # > one window with NO re-top
+        # documented degrade: a dead agent's hold expires with the window
+        # and the flow reverts to its full local budget
+        assert svc.share_holds() == {}
+        assert _drain(svc) == 50
+
+    def test_migrating_hold_survives_many_windows(self, manual_clock):
+        svc = _svc(50.0)
+        svc.request_token(FLOW)
+        svc.set_share_hold(FLOW, 30)
+        # agent-style re-top every 100ms across 2.5 windows: the hold must
+        # migrate bucket to bucket instead of aging out
+        for _ in range(25):
+            manual_clock.advance(100)
+            assert svc.set_share_hold(FLOW, 30) == 30
+        assert svc.share_holds() == {FLOW: 30}
+        # the early PASS aged out long ago; only the hold occupies the window
+        assert _drain(svc) == 20
+
+    def test_unknown_flow_hold_is_a_noop(self, manual_clock):
+        svc = _svc(50.0)
+        assert svc.set_share_hold(999, 30) == 0
+        assert svc.share_holds() == {}
+
+
+# -- MOVE carries the share charge --------------------------------------------
+class TestMoveCarriesShareCharge:
+    def test_begin_move_drops_registry_but_charge_rides_export(
+        self, manual_clock
+    ):
+        src = _svc(50.0, ns="mv")
+        for _ in range(5):
+            assert src.request_token(FLOW).ok
+        src.set_share_hold(FLOW, 30)
+        src.begin_move("mv", "dst-pod:4242", epoch=3)
+        # registry recalled (the destination's agent re-tops from ITS share)
+        assert src.share_holds() == {}
+        doc = src.export_namespace_state("mv")
+        dst = DefaultTokenService(CFG)
+        dst.import_namespace_state(doc)
+        # lossless: the destination window carries PASS 5 + LEASED 30, so
+        # exactly 15 of the 50 global-window tokens remain admittable
+        assert dst.share_holds() == {}
+        assert _drain(dst) == 15
+
+    def test_abort_move_restores_source_with_hold_charge(self, manual_clock):
+        src = _svc(50.0, ns="mv")
+        src.set_share_hold(FLOW, 30)
+        src.begin_move("mv", "dst-pod:4242", epoch=3)
+        src.abort_move("mv")
+        # MOVED-masked requests never touched the counters: the LEASED
+        # charge is still in the window even though the registry dropped
+        assert _drain(src) == 20
+
+
+# -- snapshot piggyback -------------------------------------------------------
+class TestLedgerSnapshotPiggyback:
+    def test_hier_doc_rides_snapshot_codec(self, manual_clock):
+        svc = _svc()
+        coord = _coord(100.0)
+        svc.attach_hierarchy(coord)
+        g = coord.share_grant(FLOW, 70)
+        coord.handle_demand_report("a", [(FLOW, g.lease_id, 60_000)])
+        doc = encode_snapshot(svc.export_state())
+        assert doc["hier"]["flows"][str(FLOW)]["shares"]
+        standby = _svc()
+        standby.attach_hierarchy(_coord(100.0))
+        standby.import_state(decode_snapshot(doc))
+        assert standby.hierarchy.outstanding_shares() == 70
+
+    def test_snapshot_without_coordinator_has_no_hier_block(
+        self, manual_clock
+    ):
+        doc = encode_snapshot(_svc().export_state())
+        assert "hier" not in doc
+        # and a pre-hierarchy document restores into a hier-aware service
+        _svc().import_state(decode_snapshot(doc))
+
+
+# -- DCN-tier aggregation -----------------------------------------------------
+class TestAggregateGlobalBlock:
+    def test_mid_move_copies_dedupe_and_global_block_sums(self):
+        NS.reset_move_dedup_for_tests()
+        src = {42: {"pass_qps": 5.0, "leased_tokens": 30.0,
+                    "moved_epoch": 3}}
+        dst = {42: {"pass_qps": 2.0, "leased_tokens": 20.0}}
+        out = NS.aggregate_snapshots([src, dst], global_budgets={42: 100})
+        # the source's frozen copy dropped; the marker never leaks out
+        assert out[42] == {"pass_qps": 2.0, "leased_tokens": 20.0}
+        g = out["global"]["42"]
+        assert g == {"budget_tokens": 100.0, "leased_tokens": 20.0,
+                     "occupancy": 0.2}
+
+    def test_all_marked_keeps_newest_epoch_copy(self):
+        NS.reset_move_dedup_for_tests()
+        old = {42: {"pass_qps": 1.0, "moved_epoch": 2}}
+        new = {42: {"pass_qps": 9.0, "moved_epoch": 5}}
+        out = NS.aggregate_snapshots([old, new])
+        assert out[42] == {"pass_qps": 9.0}
+
+
+# -- coordinator kill over the wire -------------------------------------------
+class TestCoordinatorKill:
+    def test_pods_hold_last_share_and_admissions_stay_bounded(self):
+        budget = 40.0  # 40 tokens over the 1s window, fleet-wide
+        svc_a = _svc(budget)
+        svc_b = _svc(budget)
+        coord = GlobalBudgetCoordinator(
+            [GlobalFlowBudget(FLOW, budget, 1.0)],
+            share_ttl_ms=30_000, reconcile_ms=50,
+        )
+        svc_a.attach_hierarchy(coord)
+        srv = TokenServer(svc_a, port=0)
+        srv.start()
+        agents = []
+        try:
+            flows = [GlobalFlowBudget(FLOW, budget, 1.0)]
+            for svc, pod in ((svc_a, "pod-a"), (svc_b, "pod-b")):
+                agents.append(PodShareAgent(
+                    svc, [f"127.0.0.1:{srv.port}"], pod, flows,
+                    tick_ms=50, timeout_ms=100, deadline_ms=200,
+                ))
+            # bootstrap: report + grant, reconcile on the demand, re-grant
+            for ag in agents:
+                ag.tick()
+            coord.reconcile_once()
+            for ag in agents:
+                ag.tick()
+            shares = {ag.pod_id: ag.shares()[FLOW] for ag in agents}
+            assert sum(shares.values()) <= int(budget)
+            assert all(s > 0 for s in shares.values())
+            outstanding = coord.outstanding_shares()
+
+            srv.stop()  # SIGKILL stand-in: the door goes dark mid-lease
+
+            for _ in range(2):
+                for ag in agents:
+                    ag.tick()  # RPCs fail; must not raise
+            for ag in agents:
+                # degrade-to-last-share: the grant survives the dark door
+                assert ag.shares()[FLOW] == shares[ag.pod_id]
+                assert ag.stats()["agent_degraded"] == 1
+            # each pod's hold pins budget - share, so total admissions over
+            # one window never exceed the budget + outstanding shares (and
+            # here, with shares summing to the budget, the budget itself)
+            admitted = _drain(svc_a) + _drain(svc_b)
+            assert admitted <= int(budget) + outstanding
+            assert admitted <= sum(shares.values())
+        finally:
+            for ag in agents:
+                ag.close()
+            coord.stop()
+            srv.stop()

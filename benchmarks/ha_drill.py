@@ -238,33 +238,65 @@ def run_overload_drill(seconds: float = 2.5, probe_timeout_ms: int = 500):
       frames, which this drill doesn't send),
     - ``sentinel_server_shed_total`` moved (the server really shed),
     - a concurrent ``FailoverTokenClient`` health probe NEVER evicts the
-      overloaded-but-alive server (OVERLOAD is proof of life).
+      overloaded-but-alive server (OVERLOAD is proof of life),
+    - the brownout escalation wrote a **black-box dump** whose per-tenant
+      SLO block identifies the flooding namespace: the flood targets the
+      ``flood`` namespace's flows only, so its burn/over counts must
+      dwarf the bystander ``steady`` namespace's (docs/OBSERVABILITY.md).
 
     Returns the artifact dict with a ``failures`` list (empty = passed).
     """
+    import glob
+    import tempfile
+
     import numpy as np
 
     from benchmarks.serve_client import run_closed, run_open
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.core.config import SentinelConfig
     from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
     from sentinel_tpu.engine.rules import ThresholdMode
     from sentinel_tpu.ha import FailoverTokenClient
     from sentinel_tpu.metrics.server import server_metrics
+    from sentinel_tpu.overload import AdmissionController, OverloadConfig
+    from sentinel_tpu.trace import blackbox
+    from sentinel_tpu.trace import ring as trace_ring
+    from sentinel_tpu.trace.slo import KEY_OBJECTIVE_MS
+    from sentinel_tpu.trace.slo import reset_slo_plane_for_tests
 
     failures = []
     svc = DefaultTokenService(
         EngineConfig(max_flows=64, max_namespaces=4, batch_size=256)
     )
+    # two tenants: the open-loop flood below targets flows 0-3 ONLY, so
+    # the dump's per-tenant attribution must name "flood", not "steady"
     svc.load_rules(
-        [ClusterFlowRule(f, 1e9, ThresholdMode.GLOBAL) for f in range(8)]
+        [ClusterFlowRule(f, 1e9, ThresholdMode.GLOBAL,
+                         namespace="flood" if f < 4 else "steady")
+         for f in range(8)]
     )
+    # a generous latency objective keeps the bystander tenant's burn near
+    # zero on this batching CPU path: only refusals (all aimed at the
+    # flooded tenant) spend error budget
+    SentinelConfig.set(KEY_OBJECTIVE_MS, "50")
+    reset_slo_plane_for_tests()
+    blackbox_dir = tempfile.mkdtemp(prefix="sentinel-blackbox-drill-")
+    blackbox.configure(blackbox_dir, window_s=30.0, min_interval_s=0.5)
+    trace_ring.arm(sample=0.01)
     # a small bounded queue + capped fusion make saturation honest: the
     # batcher can't amortize an arbitrary backlog into one device step,
-    # and the front door answers OVERLOAD the moment the queue fills
+    # and the front door answers OVERLOAD the moment the queue fills.
+    # The admission ladder is tightened (low BDP floor, short sustain) so
+    # the 2x flood demonstrably escalates the brownout — the trigger the
+    # black-box gate below depends on.
     server = TokenServer(
         svc, port=0, max_queue=32, max_batch=128, max_inflight=1,
         inline_below=0,
+        overload=AdmissionController(OverloadConfig(
+            headroom_shed=4.0, headroom_degrade=64.0, min_bdp=64.0,
+            sustain_ms=100.0,
+        )),
     )
     server.start()
     sm = server_metrics()
@@ -310,9 +342,10 @@ def run_overload_drill(seconds: float = 2.5, probe_timeout_ms: int = 500):
         rate = 2.0 * capacity
         shed0 = sm.shed_totals()
         for _attempt in range(3):
+            # n_flows=4: every flooded row belongs to the "flood" tenant
             open_doc = run_open(
                 server.port, batch=64, rate=rate, seconds=seconds,
-                n_flows=8, seed=11, window=100_000,
+                n_flows=4, seed=11, window=100_000,
             )
             shed1 = sm.shed_totals()
             shed_delta = {
@@ -348,10 +381,66 @@ def run_overload_drill(seconds: float = 2.5, probe_timeout_ms: int = 500):
             )
         if probe_stats["probes"] and not probe_stats["resolved"]:
             failures.append("no health probe resolved during the flood")
+
+        # -- black-box gate: the escalation dumped, the dump parses, and
+        # its per-tenant SLO block names the flooding namespace
+        bb_doc = {"path": None, "parsed": False}
+        dumps = sorted(glob.glob(os.path.join(blackbox_dir, "*.json")))
+        if not dumps:
+            failures.append(
+                "brownout escalation wrote no black-box dump "
+                f"(admission={server.overload.snapshot()})"
+            )
+        else:
+            try:
+                with open(dumps[-1]) as f:
+                    doc = json.load(f)
+                tenants = doc.get("slo", {}).get("tenants", {})
+                flood_over = (
+                    tenants.get("flood", {}).get("windows", {})
+                    .get("1m", {}).get("over", 0)
+                )
+                steady_over = (
+                    tenants.get("steady", {}).get("windows", {})
+                    .get("1m", {}).get("over", 0)
+                )
+                bb_doc = {
+                    "path": dumps[-1],
+                    "parsed": doc.get("schema") == "sentinel-blackbox/1",
+                    "reason": doc.get("reason"),
+                    "events": len(doc.get("events", [])),
+                    "floodOver1m": flood_over,
+                    "steadyOver1m": steady_over,
+                    "floodBurn1m": (
+                        tenants.get("flood", {}).get("burnRate", {})
+                        .get("1m")
+                    ),
+                }
+                if not bb_doc["parsed"]:
+                    failures.append(
+                        f"black-box dump schema wrong: {doc.get('schema')}"
+                    )
+                if not str(doc.get("reason", "")).startswith("brownout"):
+                    failures.append(
+                        "black-box dump reason is not the brownout "
+                        f"escalation: {doc.get('reason')}"
+                    )
+                if flood_over <= 2 * steady_over or flood_over == 0:
+                    failures.append(
+                        "black-box SLO block failed to identify the "
+                        f"flooding namespace (flood over={flood_over}, "
+                        f"steady over={steady_over})"
+                    )
+            except Exception as e:
+                failures.append(f"black-box dump unparseable: {e!r}")
     finally:
         if stop_probe is not None:
             stop_probe.set()
         server.stop()
+        trace_ring.disarm()
+        blackbox.configure(None)
+        with SentinelConfig._lock:
+            SentinelConfig._props.pop(KEY_OBJECTIVE_MS, None)
     return {
         "capacity_vps": round(capacity),
         "offered_rate_vps": round(rate),
@@ -362,6 +451,7 @@ def run_overload_drill(seconds: float = 2.5, probe_timeout_ms: int = 500):
         "shed_by_reason": shed_delta,
         "admission": server.overload.snapshot(),
         "probe": probe_stats,
+        "blackbox": bb_doc,
         "failures": failures,
     }
 
@@ -1507,6 +1597,9 @@ def main() -> None:
         )
         return
     doc = run_drill(deadline_ms=args.deadline_ms)
+    from sentinel_tpu.metrics.exporter import build_info
+
+    doc["build"] = build_info()
     if not args.skip_replication:
         doc["replication"] = run_replication_drill()
         doc["failures"] = doc["failures"] + doc["replication"]["failures"]

@@ -137,7 +137,8 @@ def _xid_probe_shm(shm_dir: str, n_flows: int, frames: int = 24,
 
 
 def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
-              mesh_devices: int = 0, transport: str = "tcp") -> dict:
+              mesh_devices: int = 0, transport: str = "tcp",
+              trace: str = "off") -> dict:
     import tempfile
 
     from benchmarks.serve_bench import (
@@ -174,6 +175,11 @@ def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
 
         sm = server_metrics()
         sm.reset()
+        trace_doc = None
+        if trace == "sampled":
+            from sentinel_tpu.trace import ring as trace_ring
+
+            trace_ring.arm(sample=1.0)
         closed = run_closed(
             server.port, clients=2, batch=4096, pipeline=4,
             seconds=seconds, n_flows=n_flows, shm_dir=shm_dir,
@@ -184,6 +190,8 @@ def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
             xid = _xid_probe_shm(shm_dir, n_flows)
         else:
             xid = _xid_probe(server.port, n_flows)
+        if trace == "sampled":
+            trace_doc = _collect_trace(xid_probe=xid)
     finally:
         server.stop()
         service.close()
@@ -193,6 +201,8 @@ def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
             shm_teardown_clean = [
                 f for f in os.listdir(shm_dir) if f.endswith(".ring")
             ] == []
+    from sentinel_tpu.metrics.exporter import build_info
+
     return {
         "front_door": (
             front_door + "+shm" if shm_dir is not None else front_door
@@ -210,6 +220,57 @@ def run_smoke(seconds: float = 4.0, intake_shards: int = 1,
         "xid_probe": xid,
         "shm_teardown_clean": shm_teardown_clean,
         "seconds": seconds,
+        "trace": trace_doc,
+        "build": build_info(),
+    }
+
+
+def _collect_trace(xid_probe: dict) -> dict:
+    """Sampled-mode evidence, gathered while the server is still up:
+    end-to-end span completeness over the sampled xids (the probe's
+    distinct xids must each assemble client_in → reply_out), plus a
+    forced black-box dump that must parse back."""
+    import tempfile
+
+    from sentinel_tpu.trace import blackbox as trace_bb
+    from sentinel_tpu.trace import ring as trace_ring
+    from sentinel_tpu.trace import spans as trace_spans
+
+    assembled = trace_spans.assemble_recent(limit=256)
+    comp = trace_spans.completeness(assembled)
+    probe_xids = [
+        0x5EED0000 + k for k in range(xid_probe["frames_sent"])
+    ]
+    probe_spans = {
+        hex(x): (lambda s: s is not None and s["complete"])(
+            trace_spans.assemble(x)
+        )
+        for x in probe_xids
+    }
+    dump_dir = tempfile.mkdtemp(prefix="sentinel-blackbox-smoke-")
+    blackbox = {"parsed": False, "path": None, "error": None}
+    try:
+        path = trace_bb.dump("trace_smoke", directory=dump_dir)
+        with open(path) as f:
+            doc = json.load(f)
+        blackbox = {
+            "parsed": doc.get("schema") == "sentinel-blackbox/1",
+            "path": path,
+            "reason": doc.get("reason"),
+            "events": len(doc.get("events", [])),
+            "sloTenants": len(doc.get("slo", {}).get("tenants", {})),
+        }
+    except Exception as e:  # surfaced in the gate, not swallowed
+        blackbox["error"] = repr(e)
+    trace_ring.disarm()
+    return {
+        "completeness": comp,
+        "probe_spans_complete": sum(probe_spans.values()),
+        "probe_spans_total": len(probe_spans),
+        "probe_incomplete": sorted(
+            x for x, ok in probe_spans.items() if not ok
+        ),
+        "blackbox": blackbox,
     }
 
 
@@ -237,11 +298,56 @@ def main() -> int:
                          "door instead of TCP. Gates CORRECTNESS (zero "
                          "client errors, xid exactness over the ring, clean "
                          "segment teardown), not the TCP rate floor")
+    ap.add_argument("--trace", choices=("off", "sampled"), default="off",
+                    help="'sampled' arms the flight recorder at sample=1.0 "
+                         "and gates end-to-end span completeness (>=99%% of "
+                         "sampled xids client_in->reply_out, probe xids all "
+                         "complete) plus a forced black-box dump parsing "
+                         "back. Skips the rate floor: full sampling is the "
+                         "diagnostic mode, not the serving default")
+    ap.add_argument("--trace-overhead-gate", type=float, default=None,
+                    metavar="FRAC",
+                    help="with tracing off, gate verdicts/s >= floor x "
+                         "(1-FRAC) — the disarmed recorder's one-branch "
+                         "cost must stay under FRAC (CI uses 0.02)")
     args = ap.parse_args()
 
     doc = run_smoke(seconds=args.seconds, intake_shards=args.intake_shards,
-                    mesh_devices=args.mesh_devices, transport=args.transport)
+                    mesh_devices=args.mesh_devices, transport=args.transport,
+                    trace=args.trace)
     print(json.dumps(doc, indent=2))
+
+    if args.trace == "sampled":
+        tr = doc["trace"]
+        failures = []
+        if doc["errors"]:
+            failures.append(f"{doc['errors']} client-observed errors")
+        frac = tr["completeness"]["fraction"]
+        if frac is None or frac < 0.99:
+            failures.append(
+                f"span completeness {frac} under 0.99 over "
+                f"{tr['completeness']['spans']} sampled spans"
+            )
+        if tr["probe_spans_complete"] != tr["probe_spans_total"]:
+            failures.append(
+                f"probe spans incomplete: {tr['probe_incomplete']}"
+            )
+        if not tr["blackbox"]["parsed"]:
+            failures.append(
+                f"black-box dump did not parse: {tr['blackbox']}"
+            )
+        if failures:
+            for f_ in failures:
+                print(f"TRACE SMOKE FAIL: {f_}", file=sys.stderr)
+            return 1
+        print(
+            f"TRACE SMOKE OK: {tr['completeness']['complete']}/"
+            f"{tr['completeness']['spans']} spans complete, "
+            f"{tr['probe_spans_complete']}/{tr['probe_spans_total']} probe "
+            f"xids end-to-end, black-box dump parsed "
+            f"({tr['blackbox']['events']} events)"
+        )
+        return 0
 
     if args.transport == "shm":
         failures = []
@@ -324,12 +430,16 @@ def main() -> int:
     failures = []
     if doc["errors"]:
         failures.append(f"{doc['errors']} client-observed errors")
-    floor = ref["floor_verdicts_per_sec"] * (1.0 - args.tolerance)
+    tolerance = (
+        args.trace_overhead_gate if args.trace_overhead_gate is not None
+        else args.tolerance
+    )
+    floor = ref["floor_verdicts_per_sec"] * (1.0 - tolerance)
     if doc["verdicts_per_sec"] < floor:
         failures.append(
             f"verdicts/s {doc['verdicts_per_sec']} under floor "
             f"{floor:.0f} (ref floor {ref['floor_verdicts_per_sec']}, "
-            f"tolerance {args.tolerance:.0%})"
+            f"tolerance {tolerance:.0%})"
         )
     p99_budget = (
         args.p99_budget_ms if args.p99_budget_ms is not None

@@ -28,6 +28,7 @@ from sentinel_tpu.cluster.token_service import TokenResult, TokenService
 from sentinel_tpu.core.config import SentinelConfig
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.engine import TokenStatus
+from sentinel_tpu.trace import ring as _TR
 
 RECONNECT_DELAY_S = 2.0  # legacy cap alias; see the backoff ladder below
 
@@ -378,6 +379,10 @@ class TokenClient(TokenService):
                     remaining = lease.tokens - lease.used
                     if kick:
                         self._spawn_renew(flow_id)
+                    if _TR.ARMED:  # flight recorder: admitted wire-free
+                        _TR.record(
+                            _TR.LEASE_LOCAL, xid=flow_id, aux=acquire
+                        )
                     return TokenResult(TokenStatus.OK, remaining)
                 elif not lease.renewing:
                     # exhausted before the renew-ahead fired: retire it and

@@ -128,6 +128,10 @@ import numpy as np
 
 from sentinel_tpu import chaos as _chaos
 
+# codec revision this build speaks: 2 deadline trailer, 3 REPL, 4 MOVE,
+# 5 LEASE + HIER share ops (the doc revisions above)
+WIRE_REV = 5
+
 # 2-byte big-endian length prefix caps a frame at 65535 bytes; single-request
 # messages keep the reference's 1024-byte budget, BATCH_FLOW frames use the
 # full range (~5000 requests/frame at 13 B each).

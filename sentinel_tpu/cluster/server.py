@@ -46,6 +46,8 @@ from sentinel_tpu.engine import TokenStatus
 from sentinel_tpu.metrics.profiler import ProfilerHook
 from sentinel_tpu.metrics.server import server_metrics
 from sentinel_tpu.overload import AdmissionController, BrownoutLevel
+from sentinel_tpu.trace import ring as _TR
+from sentinel_tpu.trace.slo import slo_plane as _slo_plane
 
 _SM = server_metrics()
 _OVERLOAD = int(TokenStatus.OVERLOAD)
@@ -227,6 +229,11 @@ class _LoopWorker:
                             )
                             return
                         srv.connections.touch(address)
+                        if _TR.ARMED:  # flight recorder: lease control hop
+                            _TR.record(
+                                _TR.LEASE, xid=xid, shard=self.index,
+                                aux=want,
+                            )
                         if srv.is_standby:
                             # proof-of-life refusal, same contract as the
                             # decision path: the client falls back to
@@ -290,6 +297,8 @@ class _LoopWorker:
                             )
                             return
                         srv.connections.touch(address)
+                        if _TR.ARMED:  # flight recorder: hierarchy hop
+                            _TR.record(_TR.HIER, xid=xid, shard=self.index)
                         if srv.is_standby:
                             writer.write(P.encode_lease_response(
                                 xid, hmt, _STANDBY
@@ -337,6 +346,11 @@ class _LoopWorker:
                             return
                         srv.connections.touch(address)
                         k = len(item.flow_ids)
+                        if _TR.ARMED:  # flight recorder: frame decoded
+                            _TR.record(
+                                _TR.CLIENT_IN, xid=item.xid,
+                                shard=self.index, aux=k,
+                            )
                         if srv.is_standby:
                             # redirect-style refusal: this node replicates
                             # from a live primary and must not double-count
@@ -361,6 +375,19 @@ class _LoopWorker:
                             # budget (the old failure mode: timeout + a
                             # mis-charged failover breaker)
                             _SM.count_shed("queue_full", k)
+                            if _TR.ARMED:
+                                _TR.record(
+                                    _TR.SHED, xid=item.xid,
+                                    shard=self.index, aux=k,
+                                )
+                            ns_fn = getattr(
+                                srv.service, "namespace_index", None
+                            )
+                            if ns_fn is not None:
+                                _slo_plane().record_shed_indexed(
+                                    *ns_fn(item.flow_ids),
+                                    reason="queue_full",
+                                )
                             writer.write(
                                 P.encode_batch_response(
                                     item.xid,
@@ -380,6 +407,11 @@ class _LoopWorker:
                             else None
                         )
                         srv.overload.note_enqueued(k)
+                        if _TR.ARMED:  # flight recorder: queued for batch
+                            _TR.record(
+                                _TR.ENQUEUE, xid=item.xid,
+                                shard=self.index, aux=self.queue.qsize(),
+                            )
                         await self.queue.put(
                             (item, writer, loop.time(), deadline)
                         )
@@ -608,11 +640,18 @@ class _LoopWorker:
             # (probabilistic pass / OVERLOAD). Shed rows are still ANSWERED
             # — one response frame per request frame, always.
             level = srv.overload.level()
+            ns_fn = getattr(service, "namespace_index", None)
             if level >= BrownoutLevel.DEGRADE:
                 shed = srv.overload.shed_mask(prios, level)
                 status, remaining, wait = srv.overload.degrade_verdicts(shed)
                 _SM.count_shed("degrade", int(shed.sum()))
-                _SM.record_verdict_batch(status, None, ())
+                # per-tenant attribution: degrade answers locally, so the
+                # verdict counters (and the SLO shed plane underneath)
+                # resolve namespaces here instead of on the device path
+                ns_idx, ns_names = (
+                    ns_fn(flow_ids) if ns_fn is not None else (None, ())
+                )
+                _SM.record_verdict_batch(status, ns_idx, ns_names)
                 keep = None
             else:
                 keep = None
@@ -621,12 +660,21 @@ class _LoopWorker:
                     if m.any():
                         keep = np.nonzero(~m)[0]
                         _SM.count_shed("brownout", n_flow - keep.size)
+                        if ns_fn is not None:
+                            _slo_plane().record_shed_indexed(
+                                *ns_fn(flow_ids[m]), reason="brownout"
+                            )
                 d_ids, d_cnts, d_prios = (
                     (flow_ids, counts, prios)
                     if keep is None
                     else (flow_ids[keep], counts[keep], prios[keep])
                 )
                 d_n = len(d_ids)
+                if _TR.ARMED and batch_frames:
+                    _TR.record_many(
+                        _TR.DISPATCH, [f.xid for _i, f in batch_frames],
+                        shard=self.index, aux=d_n,
+                    )
                 t_decide = time.perf_counter()
                 try:
                     dispatch = getattr(service, "dispatch_batch_arrays", None)
@@ -776,6 +824,11 @@ class _LoopWorker:
                             )
                         )
                         writers_to_drain.add(writer)
+                        if _TR.ARMED:
+                            _TR.record(
+                                _TR.REPLY_OUT, xid=item.xid,
+                                shard=self.index,
+                            )
                 except Exception:
                     pass
             for writer, (xids, counts, slices) in grouped.items():
@@ -805,6 +858,11 @@ class _LoopWorker:
                     await writer.drain()
                 except Exception:
                     pass
+            if _TR.ARMED and grouped:  # flight recorder: replies flushed
+                for _w, (xids, counts, _s) in grouped.items():
+                    _TR.record_many(
+                        _TR.REPLY_OUT, xids, shard=self.index,
+                    )
             _SM.write_ms.record((time.perf_counter() - t_write) * 1e3)
 
         # flow verdicts go out the moment they're materialized, CONCURRENT

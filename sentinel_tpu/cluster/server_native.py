@@ -52,6 +52,8 @@ from sentinel_tpu.engine import TokenStatus
 from sentinel_tpu.metrics.profiler import ProfilerHook
 from sentinel_tpu.metrics.server import server_metrics
 from sentinel_tpu.overload import AdmissionController, BrownoutLevel
+from sentinel_tpu.trace import ring as _TR
+from sentinel_tpu.trace.slo import slo_plane as _slo_plane
 
 _SM = server_metrics()
 _OVERLOAD = int(TokenStatus.OVERLOAD)
@@ -650,12 +652,22 @@ class NativeTokenServer:
                      block["f_type"][:k]),
                     time.monotonic(), door, block,
                 )
+                if _TR.ARMED:  # flight recorder: frames entered the host
+                    if door is self._shm_door:
+                        _TR.record(_TR.SHM_POLL, shard=shard, aux=n)
+                    _TR.record_many(
+                        _TR.CLIENT_IN, pull[3][2], shard=shard, aux=n
+                    )
                 if self.is_standby:
                     # unpromoted warm standby: data plane is closed. Refuse
                     # the whole pull with STANDBY so the failover client
                     # walks on to the live primary (no retry hint — this is
                     # not backpressure)
                     _SM.count_shed("standby", n)
+                    if _TR.ARMED:
+                        _TR.record_many(
+                            _TR.SHED, pull[3][2], shard=shard, aux=n
+                        )
                     status = np.full(n, _STANDBY, np.int8)
                     _SM.record_verdict_batch(status, None, ())
                     try:
@@ -677,6 +689,10 @@ class NativeTokenServer:
                 )
                 if self._lane_put(q, pull, give_up_after_s=give_up):
                     self._dispatch_sem.release()
+                    if _TR.ARMED:
+                        _TR.record_many(
+                            _TR.ENQUEUE, pull[3][2], shard=shard, aux=n
+                        )
                     dt_ms = (time.perf_counter() - t0) * 1e3
                     _SM.intake_ms.record(dt_ms)
                     _SM.count_shard_pull(shard, n, dt_ms)
@@ -690,11 +706,25 @@ class NativeTokenServer:
                     # retry hint
                     self.overload.note_done(n)
                     _SM.count_shed("queue_full", n)
+                    if _TR.ARMED:
+                        _TR.record_many(
+                            _TR.SHED, pull[3][2], shard=shard, aux=n
+                        )
                     status = np.full(n, _OVERLOAD, np.int8)
                     wait = np.full(
                         n, self.overload.retry_hint_ms, np.int32
                     )
-                    _SM.record_verdict_batch(status, None, ())
+                    # per-tenant attribution: these rows never reach the
+                    # device path, so resolve namespaces here (the SLO
+                    # plane's shed accounting rides the verdict counters)
+                    ns_fn = getattr(
+                        self.service, "namespace_index", None
+                    )
+                    _SM.record_verdict_batch(
+                        status,
+                        *(ns_fn(pull[0]) if ns_fn is not None
+                          else (None, ())),
+                    )
                     try:
                         door.submit(
                             pull[3], status, np.zeros(n, np.int32), wait
@@ -808,6 +838,12 @@ class NativeTokenServer:
                         shed = np.repeat(expired, lengths)
                         n_deadline = int(shed.sum())
                 level = self.overload.level()
+                ns_fn = getattr(service, "namespace_index", None)
+                if _TR.ARMED:  # flight recorder: fused group dispatched
+                    for p in pulls:
+                        _TR.record_many(
+                            _TR.DISPATCH, p[3][2], aux=len(pulls)
+                        )
                 t0 = time.perf_counter()
                 try:
                     if level >= BrownoutLevel.DEGRADE:
@@ -825,7 +861,11 @@ class NativeTokenServer:
                         _SM.count_shed(
                             "degrade", int(deg.sum()) - n_deadline
                         )
-                        _SM.record_verdict_batch(status, None, ())
+                        _SM.record_verdict_batch(
+                            status,
+                            *(ns_fn(ids) if ns_fn is not None
+                              else (None, ())),
+                        )
                         mat = (  # noqa: E731
                             lambda r=(status, remaining, wait): r
                         )
@@ -869,7 +909,8 @@ class NativeTokenServer:
                             n_shed = n_rows - int(keep.size)
                             _SM.record_verdict_batch(
                                 np.full(n_shed, _OVERLOAD, np.int8),
-                                None, (),
+                                *(ns_fn(ids[mask]) if ns_fn is not None
+                                  else (None, ())),
                             )
 
                             # scatter the dispatched slice back into full-
@@ -967,6 +1008,11 @@ class NativeTokenServer:
                         remaining[off : off + span],
                         wait[off : off + span],
                     )
+                    if _TR.ARMED:  # flight recorder: replies on the wire
+                        for fr in frames_list:
+                            _TR.record_many(
+                                _TR.REPLY_OUT, fr[2], aux=span
+                            )
                 except Exception:
                     if not self._stop.is_set():
                         record_log.exception("native submit failed")
@@ -1125,6 +1171,8 @@ class NativeTokenServer:
         xid, lmt, lease_id, flow_id, used, want = (
             P.decode_lease_request(payload)
         )
+        if _TR.ARMED:
+            _TR.record(_TR.LEASE, xid=xid, aux=want)
         self.connections.touch(address)
         if self.is_standby:
             # proof-of-life refusal, same as the decision path: the client
@@ -1166,6 +1214,8 @@ class NativeTokenServer:
                 P.decode_lease_request(payload)
             )
             args = (share_id, flow_id, used, want)
+        if _TR.ARMED:
+            _TR.record(_TR.HIER, xid=xid)
         self.connections.touch(address)
         if self.is_standby:
             return P.encode_lease_response(xid, hmt, _STANDBY)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -45,6 +46,7 @@ from sentinel_tpu.engine.param import (
 from sentinel_tpu.engine.rules import RuleIndex
 from sentinel_tpu.metrics.server import server_metrics
 from sentinel_tpu.metrics.stat_logger import log_cluster
+from sentinel_tpu.trace import ring as _TR
 
 _SM = server_metrics()
 
@@ -862,6 +864,7 @@ class DefaultTokenService(TokenService):
         """
         if _chaos.ARMED:  # device_stall injection: a slow/preempted step
             _chaos.maybe_sleep("device_stall")
+        t_dispatch = time.monotonic()
         flow_ids = np.asarray(flow_ids, np.int64)
         n = flow_ids.shape[0]
         if n == 0:
@@ -929,6 +932,8 @@ class DefaultTokenService(TokenService):
                 self._dirty["flow"].update(
                     np.unique(slots[slots >= 0]).tolist()
                 )
+        if _TR.ARMED:  # flight recorder: device step submitted
+            _TR.record(_TR.DEVICE_IN, aux=n)
 
         def _materialize():
             # blocks on the async dispatch; runs outside the lock
@@ -967,7 +972,12 @@ class DefaultTokenService(TokenService):
             ns_idx = np.where(
                 slots_ns >= 0, slot_ns[np.maximum(slots_ns, 0)], np.int32(-1)
             )
-            _SM.record_verdict_batch(status, ns_idx, ns_names)
+            _SM.record_verdict_batch(
+                status, ns_idx, ns_names,
+                latency_ms=(time.monotonic() - t_dispatch) * 1e3,
+            )
+            if _TR.ARMED:  # flight recorder: device step materialized
+                _TR.record(_TR.DEVICE_OUT, aux=n)
             # cluster server stat log (ClusterServerStatLogUtil analog): one
             # aggregated counter per verdict class per window
             for event, code in (
@@ -1039,6 +1049,7 @@ class DefaultTokenService(TokenService):
         one pull arrived together, so this only collapses sub-millisecond
         clock skew a per-frame loop would have read anyway.
         """
+        t_dispatch = time.monotonic()
         lookup_snap = self._lookup
         # a fused span is uniform only if acquire is constant across ALL its
         # frames; mixed spans scan the general (refining) body for every
@@ -1140,6 +1151,9 @@ class DefaultTokenService(TokenService):
                     np.unique(span[span >= 0]).tolist()
                 )
         _SM.record_fused(depth)
+        if _TR.ARMED:  # flight recorder: fused group submitted
+            _TR.record(_TR.FUSE, aux=depth)
+            _TR.record(_TR.DEVICE_IN, aux=depth * cap)
 
         def _materialize():
             # blocks on the async dispatch; runs outside the lock. Verdict
@@ -1186,7 +1200,12 @@ class DefaultTokenService(TokenService):
                 slot_ns[np.maximum(slots_span, 0)],
                 np.int32(-1),
             )
-            _SM.record_verdict_batch(status, ns_idx, ns_names)
+            _SM.record_verdict_batch(
+                status, ns_idx, ns_names,
+                latency_ms=(time.monotonic() - t_dispatch) * 1e3,
+            )
+            if _TR.ARMED:  # flight recorder: fused group materialized
+                _TR.record(_TR.DEVICE_OUT, aux=depth * cap)
             for event, code in (
                 ("pass", int(TokenStatus.OK)),
                 ("block", int(TokenStatus.BLOCKED)),
@@ -1462,6 +1481,8 @@ class DefaultTokenService(TokenService):
             # its hold from ITS share on its next tick
             for fid in flows:
                 self._share_holds.pop(int(fid), None)
+        if _TR.ARMED:  # flight recorder: MOVE begin (phase 0)
+            _TR.record(_TR.MOVE, aux=0)
 
     def abort_move(self, namespace: str) -> None:
         """Restore normal serving for ``namespace``. Lossless by
@@ -1470,6 +1491,11 @@ class DefaultTokenService(TokenService):
         with self._lock:
             self._moving.pop(namespace, None)
             self._rebuild_moving_snap()
+        if _TR.ARMED:  # flight recorder: MOVE abort (phase 2)
+            _TR.record(_TR.MOVE, aux=2)
+        from sentinel_tpu.trace import blackbox as _blackbox
+
+        _blackbox.maybe_dump(f"move_abort:{namespace}")
 
     def end_redirect(self, namespace: str) -> None:
         """Drop the post-commit redirect tombstone AND the namespace's rules
@@ -1502,6 +1528,23 @@ class DefaultTokenService(TokenService):
             if row < 0 or row >= len(names):
                 return None
             return self._moving.get(names[row])
+
+    def namespace_index(
+        self, flow_ids
+    ) -> Tuple[np.ndarray, Tuple[str, ...]]:
+        """``(ns_idx int32[N], ns_names)`` for a batch of flow ids — the
+        front doors' per-tenant attribution of rows that never reach the
+        device (queue full, brownout, degrade), shaped for
+        ``ServerMetrics.record_verdict_batch``. Lock-free (snapshot
+        reads); shed paths only, never the serving hot path."""
+        slots = self._lookup_from(
+            self._lookup, np.asarray(flow_ids, np.int64)
+        )
+        names, slot_ns = self._ns_snapshot
+        idx = np.where(
+            slots >= 0, slot_ns[np.maximum(slots, 0)], np.int32(-1)
+        )
+        return idx, names
 
     # -- wire rev 5: token leases (client-local admission) -------------------
     def _sweep_leases_locked(self, now: int) -> None:
@@ -1630,7 +1673,10 @@ class DefaultTokenService(TokenService):
         with self._lock:
             now = self._engine_now()
             self._sweep_leases_locked(now)
-            return self._lease_admit_locked(flow_id, want, now, "granted")
+            res = self._lease_admit_locked(flow_id, want, now, "granted")
+        if _TR.ARMED:  # flight recorder: lease grant
+            _TR.record(_TR.LEASE, aux=getattr(res, "tokens", 0) or 0)
+        return res
 
     def lease_renew(
         self, lease_id: int, flow_id: int, used: int, want: int
@@ -1647,7 +1693,10 @@ class DefaultTokenService(TokenService):
             if lease is not None and lease.flow_id == int(flow_id):
                 del self._leases[int(lease_id)]
                 self._credit_lease_locked(lease, used)
-            return self._lease_admit_locked(flow_id, want, now, "renewed")
+            res = self._lease_admit_locked(flow_id, want, now, "renewed")
+        if _TR.ARMED:  # flight recorder: lease renew
+            _TR.record(_TR.LEASE, aux=getattr(res, "tokens", 0) or 0)
+        return res
 
     def lease_return(self, lease_id: int, used: int) -> LeaseResult:
         """Give a lease back early, crediting its unused tokens. Idempotent:
@@ -1661,7 +1710,9 @@ class DefaultTokenService(TokenService):
                 return LeaseResult(int(TokenStatus.OK))
             self._credit_lease_locked(lease, used)
             self._lease_stats["returned"] += 1
-            return LeaseResult(int(TokenStatus.OK))
+        if _TR.ARMED:  # flight recorder: lease returned early
+            _TR.record(_TR.LEASE, aux=int(used))
+        return LeaseResult(int(TokenStatus.OK))
 
     def outstanding_leases(self) -> int:
         """Sum of tokens currently delegated on live leases — the bound on

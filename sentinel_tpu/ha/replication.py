@@ -478,6 +478,15 @@ class StandbyApplier:
             "standby promoted to primary (reason=%s, lag=%.0fms)",
             reason, self._lag_ms,
         )
+        from sentinel_tpu.trace import blackbox as _blackbox
+        from sentinel_tpu.trace import ring as _TR
+
+        if _TR.ARMED:
+            _TR.record(_TR.PROMOTE)
+        # a promotion means the primary just died (or an operator thinks
+        # it did) — freeze the evidence before the new primary's traffic
+        # overwrites the rings
+        _blackbox.maybe_dump(f"standby_promote:{reason}")
         if self.on_promote is not None:
             try:
                 self.on_promote(reason)

@@ -30,6 +30,7 @@ HTTP surface (the command center registers it at ``/metric/prometheus``).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from sentinel_tpu.core import clock as _clock
@@ -112,6 +113,49 @@ _COUNTER_HELP = """\
 # HELP sentinel_block_total Blocked requests since process start.
 # TYPE sentinel_block_total counter\
 """
+
+_START_TIME_S = time.time()
+
+
+def build_info() -> Dict[str, str]:
+    """Identity labels for ``sentinel_build_info`` — also stamped into
+    bench artifacts and black-box dumps so any saved document names the
+    build that produced it."""
+    from sentinel_tpu import __version__
+    from sentinel_tpu.cluster.protocol import WIRE_REV
+
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unavailable"
+    return {
+        "version": __version__,
+        "wire_rev": str(WIRE_REV),
+        "jax_backend": backend,
+    }
+
+
+def uptime_seconds() -> float:
+    """Seconds since this process imported the exporter (the scrape
+    surface's lifetime — counter resets correlate with this going to 0)."""
+    return time.time() - _START_TIME_S
+
+
+def _render_build_info() -> str:
+    info = build_info()
+    labels = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(info.items()))
+    return (
+        "# HELP sentinel_build_info Build identity (constant 1; labels "
+        "carry version, wire rev, jax backend).\n"
+        "# TYPE sentinel_build_info gauge\n"
+        f"sentinel_build_info{{{labels}}} 1\n"
+        "# HELP sentinel_server_uptime_seconds Seconds since process "
+        "start (exporter import).\n"
+        "# TYPE sentinel_server_uptime_seconds gauge\n"
+        f"sentinel_server_uptime_seconds {uptime_seconds():g}"
+    )
 
 
 def render(now_ms: Optional[int] = None) -> str:
@@ -208,6 +252,11 @@ def render(now_ms: Optional[int] = None) -> str:
         f"sentinel_assignment_move_dedup_total "
         f"{_namespaces.move_dedup_total()}"
     )
+    # per-tenant SLO plane (burn rates, latency, shed attribution)
+    from sentinel_tpu.trace.slo import slo_plane
+
+    lines.append(slo_plane().render())
+    lines.append(_render_build_info())
     return "\n".join(lines) + "\n"
 
 

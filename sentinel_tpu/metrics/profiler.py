@@ -7,13 +7,24 @@ serving process without a restart (the always-on ``profile_dir`` /
 jax.profiler allows ONE active trace per process; the hook serializes
 start/stop and reports a clean error instead of the profiler's RuntimeError
 when a trace is already running.
+
+The hook also drives the host-side flight recorder (``sentinel_tpu.trace``):
+``start`` arms the rings at full sampling so every request in the profiled
+window is traceable end-to-end, and ``stop`` writes the assembled spans as
+``trace-spans-<ms>.json`` next to the XProf trace — one command captures
+BOTH the device timeline and the host pipeline stages that fed it. A window
+where the device trace shows idle gaps and the span artifact shows frames
+parked between ``enqueue`` and ``dispatch`` is the host starving the
+device; without the span half that diagnosis needed a second tool.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
+from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.log import record_log
 
 
@@ -22,12 +33,15 @@ class ProfilerHook:
         self._lock = threading.Lock()
         self.default_dir = default_dir
         self.trace_dir: Optional[str] = None
+        self._was_armed = False
 
     @property
     def active(self) -> bool:
         return self.trace_dir is not None
 
     def start(self, trace_dir: Optional[str] = None) -> dict:
+        from sentinel_tpu.trace import ring as trace_ring
+
         with self._lock:
             if self.trace_dir is not None:
                 return {
@@ -41,24 +55,42 @@ class ProfilerHook:
 
             jax.profiler.start_trace(target)
             self.trace_dir = target
+            # an operator already arming a sampled recorder keeps it; the
+            # profiled window itself records everything
+            self._was_armed = trace_ring.ARMED
+            trace_ring.arm(sample=1.0)
             record_log.info("profiler trace started → %s", target)
             return {"profiling": True, "dir": target}
 
     def stop(self) -> dict:
+        from sentinel_tpu.trace import ring as trace_ring
+        from sentinel_tpu.trace import spans as trace_spans
+
         with self._lock:
             if self.trace_dir is None:
                 return {"error": "not profiling", "profiling": False}
             target, self.trace_dir = self.trace_dir, None
             import jax.profiler
 
+            spans_path: Optional[str] = None
+            try:
+                spans_path = trace_spans.write_artifact(
+                    os.path.join(
+                        target, f"trace-spans-{_clock.now_ms()}.json"
+                    )
+                )
+            except Exception:
+                record_log.exception("span artifact write failed")
+            if not self._was_armed:
+                trace_ring.disarm()
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 record_log.exception("profiler stop failed")
                 return {"error": "profiler stop failed", "dir": target,
-                        "profiling": False}
+                        "profiling": False, "spans": spans_path}
             record_log.info("profiler trace written → %s", target)
-            return {"profiling": False, "dir": target}
+            return {"profiling": False, "dir": target, "spans": spans_path}
 
     def status(self) -> dict:
         return {"profiling": self.active, "dir": self.trace_dir}

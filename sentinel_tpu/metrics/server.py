@@ -244,11 +244,16 @@ class ServerMetrics:
         status: np.ndarray,
         ns_idx: Optional[np.ndarray],
         ns_names: Tuple[str, ...],
+        latency_ms: Optional[float] = None,
     ) -> None:
         """Count one materialized batch: ``status`` int8[N] TokenStatus
         codes, ``ns_idx`` int32[N] namespace row per request (-1 → no rule;
         None → attribute everything to ``(no-rule)``). Vectorized — a few
-        masked bincounts per batch, never a Python loop over requests."""
+        masked bincounts per batch, never a Python loop over requests.
+
+        ``latency_ms`` (decision latency shared by the whole batch) feeds
+        the per-tenant SLO plane; refusal statuses are attributed there as
+        sheds either way."""
         status = np.asarray(status)
         n = int(status.shape[0])
         if n == 0:
@@ -273,6 +278,33 @@ class ServerMetrics:
         with self._verdict_lock:
             for key, v in updates.items():
                 self._verdicts[key] = self._verdicts.get(key, 0) + v
+        self._feed_slo(updates, latency_ms)
+
+    # refusal verdict → the SLO-plane shed reason it is attributed under
+    _SLO_SHED_REASONS = {"overload": "overload", "too_many_request":
+                         "namespace_guard", "moved": "moved"}
+
+    def _feed_slo(
+        self,
+        updates: Dict[Tuple[str, str], int],
+        latency_ms: Optional[float],
+    ) -> None:
+        """Per-tenant SLO accounting off the verdict-batch updates: served
+        rows record the batch's decision latency, refusals record as sheds
+        (each row lands in exactly one window bucket — served OR shed)."""
+        from sentinel_tpu.trace.slo import slo_plane
+
+        plane = slo_plane()
+        served: Dict[str, int] = {}
+        for (vname, ns), v in updates.items():
+            reason = self._SLO_SHED_REASONS.get(vname)
+            if reason is not None:
+                plane.record_shed(ns, reason, v)
+            else:
+                served[ns] = served.get(ns, 0) + v
+        if latency_ms is not None:
+            for ns, v in served.items():
+                plane.record(ns, latency_ms, v)
 
     def count_rls(self, domain: str, ok_n: int, over_n: int) -> None:
         """Envoy RLS responses, per domain. The descriptors already counted
@@ -720,3 +752,10 @@ def server_metrics() -> ServerMetrics:
 
 def reset_server_metrics_for_tests() -> None:
     _SINGLETON.reset()
+    # the SLO plane and flight-recorder rings are fed off this registry's
+    # paths; a test that resets one expects all three to start clean
+    from sentinel_tpu.trace import ring as _trace_ring
+    from sentinel_tpu.trace.slo import reset_slo_plane_for_tests
+
+    reset_slo_plane_for_tests()
+    _trace_ring.reset_for_tests()

@@ -196,6 +196,7 @@ class AdmissionController:
             if (now - self._over_since) * 1000.0 < cfg.sustain_ms:
                 level = BrownoutLevel.NORMAL
         with self._lock:
+            prev = self._level
             self._level = level
             self._admit_frac = (
                 min(1.0, bdp / inflight) if inflight > 0 else 1.0
@@ -204,6 +205,16 @@ class AdmissionController:
             # the ladder engaged on SUSTAINED pressure: this server is
             # genuinely behind, so advise which namespaces to move away
             self._maybe_advise(now, level)
+            if level.value > prev.value:
+                # escalation: freeze the flight-recorder evidence while
+                # the window leading INTO the brownout is still in the
+                # rings
+                from sentinel_tpu.trace import blackbox as _blackbox
+                from sentinel_tpu.trace import ring as _TR
+
+                if _TR.ARMED:
+                    _TR.record(_TR.BROWNOUT, aux=int(level.value))
+                _blackbox.maybe_dump(f"brownout:{level.name.lower()}")
 
     def _maybe_advise(self, now: float, level: BrownoutLevel) -> None:
         """Emit a ``rebalance-advise`` event naming the hottest namespaces

@@ -1,0 +1,34 @@
+"""Flight-recorder tracing, black-box dumps, and the per-tenant SLO plane.
+
+Four pieces, one discipline (the ``chaos/`` ARMED pattern — a disarmed
+subsystem costs one branch per hop):
+
+- :mod:`~sentinel_tpu.trace.ring` — per-thread fixed-size struct rings of
+  ``(t_ns, stage, xid, shard, aux)`` events, fed by every hop of both
+  front doors, the device step boundary, and the control paths.
+- :mod:`~sentinel_tpu.trace.spans` — xid-hash-sampled end-to-end spans
+  assembled on demand across rings (``cluster/server/trace`` command).
+- :mod:`~sentinel_tpu.trace.blackbox` — atomic post-mortem dumps (rings +
+  metrics + config fingerprint) on brownout escalation, promotion, MOVE
+  abort, or operator command.
+- :mod:`~sentinel_tpu.trace.slo` — per-namespace latency histograms,
+  1m/1h burn rates vs the p99 objective, and per-tenant shed attribution,
+  merged fleet-wide by :func:`~sentinel_tpu.trace.slo.merge_fleet`.
+"""
+
+from sentinel_tpu.trace import blackbox, ring, slo, spans
+from sentinel_tpu.trace.ring import arm, disarm, record, sample_xid
+from sentinel_tpu.trace.slo import merge_fleet, slo_plane
+
+__all__ = [
+    "ring",
+    "spans",
+    "blackbox",
+    "slo",
+    "arm",
+    "disarm",
+    "record",
+    "sample_xid",
+    "slo_plane",
+    "merge_fleet",
+]

@@ -433,12 +433,22 @@ def cmd_cluster_server_stats(params, body):
     counts) so the dashboard sees live shard moves next to the pipeline.
     The ``sketch`` block mirrors ``sentinel_sketch_*``: the param sketch's
     variant, fat/slim HBM bytes, and SALSA merge counters per rule slot
-    (docs/SKETCHES.md)."""
+    (docs/SKETCHES.md). The ``trace`` block is the flight recorder's
+    arming state, the ``slo`` block the per-tenant latency/burn-rate
+    plane, and ``buildInfo`` the version/wire-rev stamp — so one stats
+    pull carries everything a fleet merge needs
+    (docs/OBSERVABILITY.md)."""
+    from sentinel_tpu.metrics import exporter
     from sentinel_tpu.metrics.ha import ha_metrics
     from sentinel_tpu.metrics.server import server_metrics
+    from sentinel_tpu.trace import ring as trace_ring
+    from sentinel_tpu.trace.slo import slo_plane
 
     out = server_metrics().snapshot()
     out["rebalance"] = ha_metrics().snapshot()["rebalance"]
+    out["trace"] = trace_ring.status()
+    out["slo"] = slo_plane().snapshot()
+    out["buildInfo"] = exporter.build_info()
     return out
 
 
@@ -464,6 +474,107 @@ def cmd_cluster_server_profiler(params, body):
     if action == "status":
         return hook.status()
     return {"error": "action must be start|stop|status"}
+
+
+@command_mapping(
+    "cluster/server/trace",
+    "flight-recorder control; action=arm|disarm|status|spans|blackbox "
+    "[&sample=0.01][&xid=][&limit=][&dir=]",
+)
+def cmd_cluster_server_trace(params, body):
+    """Operator surface of the always-on flight recorder
+    (``sentinel_tpu.trace``, docs/OBSERVABILITY.md):
+
+    - ``arm``/``disarm``: start/stop recording (``sample`` = fraction of
+      xids end-to-end sampled; control events always record while armed);
+    - ``status``: arming state + per-thread ring occupancy;
+    - ``spans``: assemble sampled end-to-end spans on demand — ``xid``
+      picks one, otherwise the newest ``limit`` sampled xids; ``dir``
+      additionally writes the JSON artifact and returns its path;
+    - ``blackbox``: force a black-box dump now (``dir`` overrides the
+      configured directory) — the same artifact brownout escalation,
+      standby promotion, and MOVE aborts write automatically.
+    """
+    from sentinel_tpu.trace import blackbox, spans
+    from sentinel_tpu.trace import ring as trace_ring
+
+    action = params.get("action", "status")
+    if action == "arm":
+        trace_ring.arm(sample=float(params.get("sample", 0.01)))
+        return trace_ring.status()
+    if action == "disarm":
+        trace_ring.disarm()
+        return trace_ring.status()
+    if action == "status":
+        return trace_ring.status()
+    if action == "spans":
+        xid = params.get("xid")
+        if xid is not None:
+            span = spans.assemble(int(xid, 0) if isinstance(xid, str)
+                                  else int(xid))
+            if span is None:
+                return {"error": f"xid {xid} not in the rings "
+                        "(unsampled, or overwritten)"}
+            return span
+        limit = int(params.get("limit", 64))
+        out_dir = params.get("dir")
+        if out_dir:
+            path = os.path.join(
+                out_dir, f"trace-spans-{_clock.now_ms()}.json"
+            )
+            return {"path": spans.write_artifact(path, limit=limit)}
+        assembled = spans.assemble_recent(limit=limit)
+        return {
+            "completeness": spans.completeness(assembled),
+            "spans": assembled,
+        }
+    if action == "blackbox":
+        if not blackbox.enabled() and not params.get("dir"):
+            return {"error": "no black-box dir configured; pass dir="}
+        return {
+            "path": blackbox.dump(
+                reason=params.get("reason", "operator"),
+                directory=params.get("dir"),
+            )
+        }
+    return {"error": "action must be arm|disarm|status|spans|blackbox"}
+
+
+@command_mapping(
+    "cluster/server/slo",
+    "per-tenant SLO plane; action=local|fleet (fleet: body = JSON list "
+    "of pod clusterServerStats/slo payloads)",
+)
+def cmd_cluster_server_slo(params, body):
+    """Per-tenant latency/burn-rate surface (``sentinel_tpu.trace.slo``):
+
+    - ``local``: this pod's snapshot — objective, per-namespace latency
+      quantiles, 1m/1h burn rates, shed attribution;
+    - ``fleet``: merge pod snapshots into the fleet view. The body is a
+      JSON array whose items are either raw ``slo`` snapshots or whole
+      ``clusterServerStats`` payloads (their ``slo`` block is used) —
+      the same pull-and-merge path ``aggregate_snapshots`` established
+      for per-flow metrics. Malformed pod items contribute nothing.
+    """
+    from sentinel_tpu.trace.slo import merge_fleet, slo_plane
+
+    action = params.get("action", "local")
+    if action == "local":
+        return slo_plane().snapshot()
+    if action == "fleet":
+        try:
+            pods = json.loads(body) if body else []
+        except Exception:
+            return {"error": "body must be a JSON array of pod payloads"}
+        if not isinstance(pods, list):
+            return {"error": "body must be a JSON array of pod payloads"}
+        snaps = [
+            p.get("slo", p) if isinstance(p, dict) else p for p in pods
+        ]
+        merged = merge_fleet(snaps)
+        merged["pods"] = len(pods)
+        return merged
+    return {"error": "action must be local|fleet"}
 
 
 @command_mapping(

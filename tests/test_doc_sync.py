@@ -1,0 +1,160 @@
+"""docs/OBSERVABILITY.md ↔ code sync.
+
+The observability doc is the series reference operators build dashboards
+from; a series it documents must exist in code, and a series the exporter
+actually emits must be documented. Same contract for the
+``clusterServerStats`` key table. These tests are pure string checks — no
+server, no sockets — so drift fails fast in tier-1.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+SRC = os.path.join(REPO, "sentinel_tpu")
+
+
+def _doc_text():
+    with open(DOC) as f:
+        return f.read()
+
+
+def _source_corpus():
+    chunks = []
+    for root, _dirs, files in os.walk(SRC):
+        for name in files:
+            if name.endswith(".py"):
+                with open(os.path.join(root, name)) as f:
+                    chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def _doc_series():
+    """Backticked `sentinel_*` tokens in the doc (concrete names only —
+    globs like `sentinel_server_*` document families, not series)."""
+    names = set(re.findall(r"`(sentinel_[a-z0-9_]+)`", _doc_text()))
+    return {n for n in names if not n.endswith("_")}
+
+
+def _rendered_series():
+    """Series names the exporter actually emits, with representative
+    state seeded so the traffic-gated sections light up."""
+    from sentinel_tpu.metrics.exporter import render
+    from sentinel_tpu.metrics.server import reset_server_metrics_for_tests
+    from sentinel_tpu.trace.slo import (
+        reset_slo_plane_for_tests,
+        slo_plane,
+    )
+
+    reset_server_metrics_for_tests()
+    reset_slo_plane_for_tests()
+    try:
+        plane = slo_plane()
+        plane.record("doc-sync", 5.0, n=4)
+        plane.record_shed("doc-sync", "overload", n=1)
+        text = render()
+    finally:
+        reset_server_metrics_for_tests()
+        reset_slo_plane_for_tests()
+    names = set()
+    for line in text.splitlines():
+        m = re.match(r"# TYPE (sentinel_[a-z0-9_]+) ", line)
+        if m:
+            names.add(m.group(1))
+            continue
+        m = re.match(r"(sentinel_[a-z0-9_]+)[{ ]", line)
+        if m:
+            base = m.group(1)
+            base = re.sub(r"_(bucket|sum|count)$", "", base)
+            names.add(base)
+    return names
+
+
+class TestSeriesSync:
+    def test_every_documented_series_exists_in_code(self):
+        corpus = _source_corpus()
+        missing = []
+        for name in sorted(_doc_series()):
+            # composed names (sentinel_server_shard_pulls_total) are built
+            # from a prefix + a short literal at the render site
+            short = name.replace("sentinel_server_", "").replace(
+                "sentinel_", "")
+            if name not in corpus and f'"{short}"' not in corpus and \
+                    f"'{short}'" not in corpus:
+                missing.append(name)
+        assert not missing, (
+            f"documented in OBSERVABILITY.md but absent from code: {missing}"
+        )
+
+    def test_every_rendered_series_is_documented(self):
+        doc = _doc_text()
+        documented = _doc_series()
+        undocumented = []
+        for name in sorted(_rendered_series()):
+            if name not in documented and name not in doc:
+                undocumented.append(name)
+        assert not undocumented, (
+            f"rendered by the exporter but not in OBSERVABILITY.md: "
+            f"{undocumented}"
+        )
+
+
+class TestClusterServerStatsSync:
+    def _doc_keys(self):
+        """Keys listed in the doc's clusterServerStats table."""
+        text = _doc_text()
+        start = text.index("## The `clusterServerStats` command")
+        end = text.index("\n## ", start + 1)
+        section = text[start:end]
+        keys = set()
+        for row in re.findall(r"^\| (`[^|]+`(?: / `[^|]+`)*) \|", section,
+                              re.M):
+            keys.update(re.findall(r"`([A-Za-z]+)`", row))
+        assert keys, "clusterServerStats key table not found in the doc"
+        return keys
+
+    def _live_keys(self):
+        import sentinel_tpu.transport.handlers as handlers
+
+        out = handlers.cmd_cluster_server_stats({}, "")
+        assert isinstance(out, dict)
+        json.dumps(out)  # the command surface must stay JSON-serializable
+        return set(out)
+
+    def test_every_stats_key_is_documented(self):
+        missing = self._live_keys() - self._doc_keys()
+        assert not missing, (
+            f"clusterServerStats keys missing from OBSERVABILITY.md's "
+            f"table: {sorted(missing)}"
+        )
+
+    def test_every_documented_key_exists(self):
+        stale = self._doc_keys() - self._live_keys()
+        assert not stale, (
+            f"OBSERVABILITY.md documents clusterServerStats keys the "
+            f"command no longer returns: {sorted(stale)}"
+        )
+
+
+class TestDocCrossLinks:
+    def test_readme_links_the_doc(self):
+        with open(os.path.join(REPO, "README.md")) as f:
+            readme = f.read()
+        assert "docs/OBSERVABILITY.md" in readme
+        assert "trace/" in readme
+
+    @pytest.mark.parametrize("needle", [
+        "sentinel-trace-spans/1",
+        "sentinel-blackbox/1",
+        "cluster/server/trace",
+        "cluster/server/slo",
+        "SENTINEL_TRACE",
+        "SENTINEL_BLACKBOX_DIR",
+        "burn = over_fraction / 0.01",
+    ])
+    def test_doc_covers_trace_surface(self, needle):
+        assert needle in _doc_text()

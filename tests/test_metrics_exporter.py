@@ -130,3 +130,52 @@ class TestPrometheusExporter:
         assert code == 200
         assert "sentinel_pass_qps" in body
         assert ctype.startswith("text/plain")  # exposition format, not JSON
+
+
+class TestBuildInfoAndSloSeries:
+    """The identity stamp and the per-tenant SLO plane ride the same
+    exposition body as everything else — one scrape carries them all."""
+
+    @pytest.fixture(autouse=True)
+    def clean_slo(self):
+        from sentinel_tpu.trace.slo import reset_slo_plane_for_tests
+
+        reset_slo_plane_for_tests()
+        yield
+        reset_slo_plane_for_tests()
+
+    def test_build_info_series(self):
+        from sentinel_tpu.metrics.exporter import build_info
+
+        info = build_info()
+        assert set(info) == {"version", "wire_rev", "jax_backend"}
+        text = render()
+        assert f'version="{info["version"]}"' in text
+        assert f'wire_rev="{info["wire_rev"]}"' in text
+        assert "# TYPE sentinel_build_info gauge" in text
+        assert "sentinel_server_uptime_seconds " in text
+
+    def test_uptime_advances(self):
+        from sentinel_tpu.metrics.exporter import uptime_seconds
+
+        assert uptime_seconds() > 0
+
+    def test_slo_series_render_after_traffic(self):
+        from sentinel_tpu.trace.slo import slo_plane
+
+        plane = slo_plane()
+        plane.record("ns-a", 5.0, n=10)       # all over the 2ms objective
+        plane.record_shed("ns-b", "overload", n=3)
+        text = render()
+        assert "sentinel_slo_objective_ms 2" in text
+        assert 'sentinel_slo_latency_ms_count{namespace="ns-a"} 10' in text
+        assert 'sentinel_slo_burn_rate{namespace="ns-a",window="1m"} 100' \
+            in text
+        assert 'sentinel_slo_shed_total{namespace="ns-b",reason="overload"} 3' \
+            in text
+
+    def test_slo_idle_renders_objective_only(self):
+        text = render()
+        assert "sentinel_slo_objective_ms" in text
+        assert "sentinel_slo_burn_rate" not in text
+        assert "sentinel_slo_shed_total" not in text

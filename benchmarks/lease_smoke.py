@@ -39,6 +39,7 @@ def run_smoke(seconds: float = 3.0, n_flows: int = 256, seed: int = 11,
 
     jax.config.update("jax_platforms", "cpu")
     from benchmarks.serve_client import run_lease
+    from benchmarks.workload import zipf_flow_sequence
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
     from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
@@ -61,11 +62,14 @@ def run_smoke(seconds: float = 3.0, n_flows: int = 256, seed: int = 11,
     server = TokenServer(svc, port=0)
     server.start()
     failures = []
+    # ONE stream from the shared workload model, handed to both runs —
+    # the off/on comparison is protocol-only by construction
+    flows = zipf_flow_sequence(n_flows, alpha, 200_000, seed)
     try:
         off = run_lease(server.port, seconds, n_flows, seed, alpha=alpha,
-                        lease=False, lease_want=lease_want)
+                        lease=False, lease_want=lease_want, flows=flows)
         on = run_lease(server.port, seconds, n_flows, seed, alpha=alpha,
-                       lease=True, lease_want=lease_want)
+                       lease=True, lease_want=lease_want, flows=flows)
     finally:
         server.stop()
         svc.close()

@@ -40,6 +40,7 @@ import json
 import socket
 import threading
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -220,26 +221,16 @@ def run_closed(port: int, batch: int, pipeline: int, seconds: float,
     }
 
 
-def zipf_flow_sequence(n_flows: int, alpha: float, size: int,
-                       seed: int) -> np.ndarray:
-    """Deterministic BOUNDED-Zipfian flow-id stream: rank k in
-    [1, n_flows] drawn ∝ k^-alpha, flow id = rank - 1. Bounded, not
-    ``rng.zipf`` folded with a modulo: for alpha near 1 the unbounded tail
-    holds most of the mass (>50% of draws past rank 256 at alpha=1.1), and
-    folding it spreads that mass uniformly over the flows — a uniform
-    workload wearing a Zipfian label. The on/off lease comparison replays
-    the SAME stream (same seed), so any RPC difference is the protocol's,
-    not the workload's."""
-    rng = np.random.default_rng(seed)
-    ranks = np.arange(1, n_flows + 1, dtype=np.float64)
-    p = ranks ** -alpha
-    p /= p.sum()
-    return rng.choice(n_flows, size=size, p=p)
+# the bounded-Zipf generator lives in the shared workload model now
+# (benchmarks/workload.py); re-exported here because run_lease's callers
+# and older artifacts reference it under this module
+from benchmarks.workload import zipf_flow_sequence  # noqa: E402,F401
 
 
 def run_lease(port: int, seconds: float, n_flows: int, seed: int,
               alpha: float = 1.1, lease: bool = False,
-              lease_want: int = 256, timeout_ms: int = 200) -> dict:
+              lease_want: int = 256, timeout_ms: int = 200,
+              flows: Optional[np.ndarray] = None) -> dict:
     """Single-decision closed loop through ``TokenClient`` over a Zipfian
     flow stream — the per-decision-RPC measurement (wire rev 5). With
     ``lease=False`` every decision is one RPC (the PR-10 baseline shape);
@@ -249,7 +240,8 @@ def run_lease(port: int, seconds: float, n_flows: int, seed: int,
     the ratio measures steady state."""
     from sentinel_tpu.cluster.client import TokenClient
 
-    flows = zipf_flow_sequence(n_flows, alpha, 200_000, seed)
+    if flows is None:
+        flows = zipf_flow_sequence(n_flows, alpha, 200_000, seed)
     client = TokenClient("127.0.0.1", port, timeout_ms=timeout_ms,
                          lease=lease, lease_want=lease_want)
     decisions = ok = 0

@@ -1,0 +1,189 @@
+"""Seeded multi-tenant workload model shared by the serving benches.
+
+One place for the three things every realistic drive needs and no bench
+should reimplement slightly differently:
+
+- the **bounded-Zipf flow stream** (``zipf_flow_sequence``, factored out of
+  ``serve_client.py`` — rng.choice over normalized ranks, NOT ``rng.zipf``
+  folded with a modulo; see the docstring for why folding lies),
+- **tenant specs**: named namespaces with a flow range, a guaranteed share
+  (what the weighted brownout ladder and the scenario fairness gate both
+  read), a Zipf skew, and a base offered rate,
+- **phase schedules**: ramp / spike / flashcrowd / diurnal / steady rate
+  shapes over a fixed duration, optionally carrying a chaos spec for the
+  ``sentinel_tpu.chaos`` registry, so a scenario file is a list of
+  ``Phase`` objects and nothing else.
+
+Everything is deterministic under (spec, seed): ``scenario_bench.py`` replays
+the exact same offered load for a given seed, which is what lets CI gate on
+per-tenant numbers.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def zipf_flow_sequence(n_flows: int, alpha: float, size: int,
+                       seed: int) -> np.ndarray:
+    """Deterministic BOUNDED-Zipfian flow-id stream: rank k in
+    [1, n_flows] drawn ∝ k^-alpha, flow id = rank - 1. Bounded, not
+    ``rng.zipf`` folded with a modulo: for alpha near 1 the unbounded tail
+    holds most of the mass (>50% of draws past rank 256 at alpha=1.1), and
+    folding it spreads that mass uniformly over the flows — a uniform
+    workload wearing a Zipfian label. The on/off lease comparison replays
+    the SAME stream (same seed), so any RPC difference is the protocol's,
+    not the workload's."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_flows + 1, dtype=np.float64)
+    p = ranks ** -alpha
+    p /= p.sum()
+    return rng.choice(n_flows, size=size, p=p)
+
+
+@dataclass
+class TenantSpec:
+    """One tenant = one namespace: a contiguous flow-id range, a Zipf skew
+    over it, a guaranteed share of the server (the fairness gate's floor
+    AND the weighted shed ladder's per-namespace share), and a base
+    offered rate that the phase schedule multiplies."""
+
+    name: str
+    first_flow: int
+    n_flows: int
+    share: float  # guaranteed fraction of a shed batch / of served capacity
+    base_rate: float  # offered verdicts/sec at phase multiplier 1.0
+    zipf_alpha: float = 1.1
+    batch: int = 32  # rows per request frame
+    prioritized: bool = False  # mark this tenant's rows prioritized
+
+    def flow_stream(self, size: int, seed: int) -> np.ndarray:
+        """Tenant-local Zipf stream mapped into this tenant's flow range
+        (seed is salted per tenant with a stable crc32 — ``hash()`` is
+        per-process-randomized — so tenants are independent but each is
+        individually reproducible)."""
+        local = zipf_flow_sequence(
+            self.n_flows, self.zipf_alpha, size,
+            seed ^ (zlib.crc32(self.name.encode()) & 0x7FFFFFFF),
+        )
+        return (local + self.first_flow).astype(np.int64)
+
+
+@dataclass
+class Phase:
+    """One scenario phase: a rate shape over ``seconds``, per-tenant rate
+    multipliers, and an optional chaos spec armed for the duration."""
+
+    name: str
+    seconds: float
+    shape: str = "steady"  # steady | ramp | spike | flashcrowd | diurnal
+    magnitude: float = 1.0  # shape peak multiplier (spike height etc.)
+    # per-tenant base multipliers for this phase (default 1.0)
+    rates: Dict[str, float] = field(default_factory=dict)
+    # tenants the SHAPE applies to (None → all): a spike phase with
+    # shape_tenants=["tenant-0"] is a single-tenant flood
+    shape_tenants: Optional[List[str]] = None
+    # chaos spec for sentinel_tpu.chaos.arm() (e.g. "lane_delay:p=0.05,
+    # ms=2;conn_reset:p=0.01"), armed at phase start, disarmed at end
+    chaos: Optional[str] = None
+    measured: bool = True  # warmup phases are excluded from the gates
+
+    def multiplier(self, tenant: str, frac: float) -> float:
+        """Offered-rate multiplier for ``tenant`` at normalized phase time
+        ``frac`` in [0, 1)."""
+        base = self.rates.get(tenant, 1.0)
+        if self.shape_tenants is not None and tenant not in self.shape_tenants:
+            return base
+        return base * shape_multiplier(self.shape, self.magnitude, frac)
+
+
+def shape_multiplier(shape: str, magnitude: float, frac: float) -> float:
+    """The phase shapes. All are ≥ a small floor so a tenant never goes
+    fully silent (a silent tenant can't prove it wasn't starved):
+
+    - ``steady``: 1
+    - ``ramp``: linear 0.1 → magnitude
+    - ``spike``: 1, then ×magnitude over the middle third, then 1
+    - ``flashcrowd``: 1 until t=0.25, then a step to magnitude with an
+      exponential approach (the crowd arrives fast but not instantly)
+    - ``diurnal``: one sinusoidal "day" over the phase, 1 → magnitude → 1
+    """
+    frac = min(max(frac, 0.0), 1.0)
+    if shape == "ramp":
+        return 0.1 + (magnitude - 0.1) * frac
+    if shape == "spike":
+        return magnitude if (1.0 / 3.0) <= frac < (2.0 / 3.0) else 1.0
+    if shape == "flashcrowd":
+        if frac < 0.25:
+            return 1.0
+        ramp = 1.0 - math.exp(-(frac - 0.25) * 20.0)
+        return 1.0 + (magnitude - 1.0) * ramp
+    if shape == "diurnal":
+        return 1.0 + (magnitude - 1.0) * 0.5 * (
+            1.0 - math.cos(2.0 * math.pi * frac)
+        )
+    return 1.0  # steady
+
+
+@dataclass
+class WorkloadModel:
+    """Tenants + phases + seed = the whole offered load, deterministically.
+
+    ``offered_rate(phase, tenant, frac)`` is the instantaneous target rate;
+    drivers integrate it into an absolute send schedule (open loop) so a
+    slow server cannot slow the offered load down — the coordinated-omission
+    guard the serve bench already uses."""
+
+    tenants: List[TenantSpec]
+    phases: List[Phase]
+    seed: int = 20260805
+
+    def tenant(self, name: str) -> TenantSpec:
+        for t in self.tenants:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def shares(self) -> Dict[str, float]:
+        return {t.name: t.share for t in self.tenants}
+
+    def offered_rate(self, phase: Phase, tenant: TenantSpec,
+                     frac: float) -> float:
+        return tenant.base_rate * phase.multiplier(tenant.name, frac)
+
+    def send_schedule(self, phase: Phase, tenant: TenantSpec,
+                      tick_s: float = 0.05) -> np.ndarray:
+        """Absolute send offsets (seconds from phase start) for every
+        frame this tenant offers during ``phase``: the rate shape is
+        integrated per tick and converted to evenly spaced frame sends of
+        ``tenant.batch`` rows. Deterministic and server-independent."""
+        sends: List[float] = []
+        carry = 0.0
+        t = 0.0
+        while t < phase.seconds:
+            frac = t / phase.seconds
+            rate = self.offered_rate(phase, tenant, frac)
+            carry += rate * tick_s / max(1, tenant.batch)
+            n = int(carry)
+            if n > 0:
+                carry -= n
+                step = tick_s / n
+                sends.extend(t + i * step for i in range(n))
+            t += tick_s
+        return np.asarray(sends, np.float64)
+
+
+def demand_totals(model: WorkloadModel, phase: Phase) -> Dict[str, float]:
+    """Total rows each tenant offers during ``phase`` (the fairness gate's
+    demand side: a tenant served below its share is only *starved* if it
+    actually demanded more)."""
+    out = {}
+    for t in model.tenants:
+        sched = model.send_schedule(phase, t)
+        out[t.name] = float(sched.size * t.batch)
+    return out

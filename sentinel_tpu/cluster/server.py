@@ -656,13 +656,20 @@ class _LoopWorker:
             else:
                 keep = None
                 if level >= BrownoutLevel.SHED_LOW:
-                    m = srv.overload.shed_mask(prios, level)
+                    # tenant attribution up front so the shed is
+                    # share-weighted when shares are configured
+                    ns_pair = (
+                        ns_fn(flow_ids) if ns_fn is not None else (None, ())
+                    )
+                    m = srv.overload.shed_mask(
+                        prios, level, ns_idx=ns_pair[0], ns_names=ns_pair[1]
+                    )
                     if m.any():
                         keep = np.nonzero(~m)[0]
                         _SM.count_shed("brownout", n_flow - keep.size)
-                        if ns_fn is not None:
+                        if ns_pair[0] is not None:
                             _slo_plane().record_shed_indexed(
-                                *ns_fn(flow_ids[m]), reason="brownout"
+                                ns_pair[0][m], ns_pair[1], reason="brownout"
                             )
                 d_ids, d_cnts, d_prios = (
                     (flow_ids, counts, prios)

@@ -872,7 +872,16 @@ class NativeTokenServer:
                     else:
                         mask = shed
                         if level >= BrownoutLevel.SHED_LOW:
-                            m = self.overload.shed_mask(prios, level)
+                            # tenant attribution up front so the shed is
+                            # share-weighted when shares are configured
+                            ns_pair = (
+                                ns_fn(ids) if ns_fn is not None
+                                else (None, ())
+                            )
+                            m = self.overload.shed_mask(
+                                prios, level,
+                                ns_idx=ns_pair[0], ns_names=ns_pair[1],
+                            )
                             mask = m if mask is None else (mask | m)
                             if not mask.any():
                                 mask = None

@@ -17,7 +17,7 @@ Everything here renders under the ``sentinel_server_*`` prefix via
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -289,19 +289,37 @@ class ServerMetrics:
         updates: Dict[Tuple[str, str], int],
         latency_ms: Optional[float],
     ) -> None:
-        """Per-tenant SLO accounting off the verdict-batch updates: served
-        rows record the batch's decision latency, refusals record as sheds
-        (each row lands in exactly one window bucket — served OR shed)."""
+        """Per-tenant SLO + timeline accounting off the verdict-batch
+        updates: served rows record the batch's decision latency, refusals
+        record as sheds (each row lands in exactly one window bucket —
+        served OR shed). The timeline's shed column is fed from
+        ``SloPlane.record_shed`` (which this calls), so timeline sums
+        reconcile with both ``sentinel_server_verdicts_total`` and
+        ``sentinel_slo_shed_total`` deltas."""
+        from sentinel_tpu.metrics.timeline import timeline
         from sentinel_tpu.trace.slo import slo_plane
 
         plane = slo_plane()
+        tl = timeline()
         served: Dict[str, int] = {}
+        # timeline columns per namespace: [pass, block, other]
+        cols: Dict[str, List[int]] = {}
         for (vname, ns), v in updates.items():
             reason = self._SLO_SHED_REASONS.get(vname)
             if reason is not None:
                 plane.record_shed(ns, reason, v)
+                continue
+            served[ns] = served.get(ns, 0) + v
+            c = cols.setdefault(ns, [0, 0, 0])
+            if vname == "pass":
+                c[0] += v
+            elif vname == "block":
+                c[1] += v
             else:
-                served[ns] = served.get(ns, 0) + v
+                c[2] += v
+        for ns, c in cols.items():
+            tl.record(ns, n_pass=c[0], n_block=c[1], n_other=c[2],
+                      latency_ms=latency_ms)
         if latency_ms is not None:
             for ns, v in served.items():
                 plane.record(ns, latency_ms, v)
@@ -752,10 +770,12 @@ def server_metrics() -> ServerMetrics:
 
 def reset_server_metrics_for_tests() -> None:
     _SINGLETON.reset()
-    # the SLO plane and flight-recorder rings are fed off this registry's
-    # paths; a test that resets one expects all three to start clean
+    # the SLO plane, metric timeline, and flight-recorder rings are fed off
+    # this registry's paths; a test that resets one expects all to start clean
+    from sentinel_tpu.metrics.timeline import reset_timeline_for_tests
     from sentinel_tpu.trace import ring as _trace_ring
     from sentinel_tpu.trace.slo import reset_slo_plane_for_tests
 
     reset_slo_plane_for_tests()
+    reset_timeline_for_tests()
     _trace_ring.reset_for_tests()

@@ -8,6 +8,12 @@ from sentinel_tpu.overload.admission import (
     AdmissionController,
     BrownoutLevel,
     OverloadConfig,
+    parse_shares,
 )
 
-__all__ = ["AdmissionController", "BrownoutLevel", "OverloadConfig"]
+__all__ = [
+    "AdmissionController",
+    "BrownoutLevel",
+    "OverloadConfig",
+    "parse_shares",
+]

@@ -46,8 +46,8 @@ import enum
 import random
 import threading
 import time
-from dataclasses import dataclass
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -60,6 +60,29 @@ KEY_HEADROOM_DEGRADE = "sentinel.tpu.overload.headroom.degrade"
 KEY_MIN_BDP = "sentinel.tpu.overload.min.bdp"
 KEY_RECHECK_MS = "sentinel.tpu.overload.recheck.ms"
 KEY_SUSTAIN_MS = "sentinel.tpu.overload.sustain.ms"
+# per-namespace guaranteed shares for weighted shedding, e.g.
+# "tenant-a=0.25,tenant-b=0.25" (fractions of each shed batch)
+KEY_SHARES = "sentinel.tpu.overload.shares"
+
+
+def parse_shares(spec: str) -> Dict[str, float]:
+    """``"a=0.25,b=0.5"`` → ``{"a": 0.25, "b": 0.5}``; malformed entries
+    are dropped, negatives clamped to 0 (a bad knob must not crash the
+    door's shed path)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            out[name] = max(0.0, float(val))
+        except ValueError:
+            continue
+    return out
 
 
 class BrownoutLevel(enum.IntEnum):
@@ -99,6 +122,11 @@ class OverloadConfig:
     # server. Rate-limited; 0 disables.
     advise_top_n: int = 3
     advise_interval_ms: float = 5_000.0
+    # per-namespace guaranteed shares (fraction of each shed batch a tenant
+    # keeps before the ladder touches it); empty → legacy whole-class shed.
+    # Tenants absent from the map get ``ns_default_share``.
+    ns_shares: Dict[str, float] = field(default_factory=dict)
+    ns_default_share: float = 0.0
 
     @classmethod
     def from_config(cls) -> "OverloadConfig":
@@ -111,6 +139,7 @@ class OverloadConfig:
             min_bdp=SentinelConfig.get_float(KEY_MIN_BDP, 1024.0),
             recheck_ms=SentinelConfig.get_float(KEY_RECHECK_MS, 25.0),
             sustain_ms=SentinelConfig.get_float(KEY_SUSTAIN_MS, 500.0),
+            ns_shares=parse_shares(SentinelConfig.get(KEY_SHARES, "") or ""),
         )
 
 
@@ -275,15 +304,37 @@ class AdmissionController:
         return max(rate * min_rt / 1000.0, cfg.min_bdp)
 
     # -- brownout verdict helpers ------------------------------------------
-    def shed_mask(self, prios, level: BrownoutLevel) -> np.ndarray:
+    def set_shares(self, shares: Optional[Dict[str, float]]) -> None:
+        """Install (or clear) per-namespace guaranteed shares for weighted
+        ``SHED_LOW`` shedding. Scenario/ops entry point — rule loading
+        does not set shares implicitly."""
+        self.config.ns_shares = dict(shares) if shares else {}
+
+    def shed_mask(self, prios, level: BrownoutLevel,
+                  ns_idx=None, ns_names=()) -> np.ndarray:
         """bool[N] — True rows are refused with OVERLOAD at this level.
 
-        ``SHED_LOW`` sheds exactly the non-prioritized rows. ``DEGRADE``
-        sheds a random ``1 - admit_frac`` of ALL rows; the survivors get a
-        local (device-free) answer from :meth:`degrade_verdicts`.
+        ``SHED_LOW`` sheds the non-prioritized rows — *weighted by tenant
+        share* when shares are configured and the caller supplies the
+        batch's ``(ns_idx, ns_names)`` attribution (the
+        ``TokenService.namespace_index`` shape both doors already
+        compute): each tenant keeps a guaranteed ``ceil(share × N)`` rows
+        of the batch; only its most recent non-prioritized rows beyond
+        that are shed, and prioritized rows are never shed at this level,
+        so a single flooding tenant browns itself out while in-share
+        tenants ride through (the fairness gate's mechanism). Without
+        shares (or without attribution) the legacy whole-class shed
+        applies. ``DEGRADE`` sheds a random ``1 - admit_frac`` of ALL
+        rows; the survivors get a local (device-free) answer from
+        :meth:`degrade_verdicts`.
         """
         prios = np.asarray(prios, dtype=bool)
         if level == BrownoutLevel.SHED_LOW:
+            shares = self.config.ns_shares
+            if shares and ns_idx is not None and len(ns_names):
+                return self._weighted_shed(
+                    prios, np.asarray(ns_idx), tuple(ns_names), shares
+                )
             return ~prios
         if level == BrownoutLevel.DEGRADE:
             with self._lock:
@@ -295,6 +346,37 @@ class AdmissionController:
                 )
             return draws >= frac
         return np.zeros(prios.shape[0], dtype=bool)
+
+    def _weighted_shed(
+        self,
+        prios: np.ndarray,
+        ns_idx: np.ndarray,
+        ns_names,
+        shares: Dict[str, float],
+    ) -> np.ndarray:
+        """Share-weighted SHED_LOW: per tenant, shed only the non-prio
+        rows beyond ``ceil(share × N)``, newest-first (the tail of the
+        batch arrived last; shedding it keeps the served prefix FIFO).
+        Rows with no rule (``ns_idx < 0``) and tenants absent from the
+        share map get ``ns_default_share`` (0 by default → legacy
+        whole-class shed for them)."""
+        n = prios.shape[0]
+        shed = np.zeros(n, dtype=bool)
+        default = self.config.ns_default_share
+        for j in range(-1, len(ns_names)):
+            rows = np.nonzero(ns_idx == j)[0]
+            if rows.size == 0:
+                continue
+            share = shares.get(ns_names[j], default) if j >= 0 else default
+            guaranteed = int(np.ceil(max(0.0, share) * n))
+            excess = rows.size - guaranteed
+            if excess <= 0:
+                continue
+            cand = rows[~prios[rows]]  # prioritized rows never shed here
+            k = min(excess, cand.size)
+            if k > 0:
+                shed[cand[-k:]] = True
+        return shed
 
     def degrade_verdicts(self, shed: np.ndarray):
         """(status, remaining, wait_ms) for a fully-local DEGRADE answer:
@@ -320,5 +402,6 @@ class AdmissionController:
                 "admitFrac": round(self._admit_frac, 4),
                 "estimatedBdp": round(self.estimated_bdp(), 1),
                 "enabled": self.config.enabled,
+                "nsShares": dict(self.config.ns_shares),
                 "lastAdvice": self.last_advice,
             }

@@ -120,7 +120,12 @@ class SloPlane:
     def record_shed(self, namespace: str, reason: str, n: int = 1) -> None:
         """n rows refused for this tenant (OVERLOAD verdicts, brownout
         sheds, namespace guards). A shed burns the whole budget for those
-        requests: counted as over-objective in the burn windows too."""
+        requests: counted as over-objective in the burn windows too.
+        Every shed path in the process funnels through here (door-level
+        ``record_shed_indexed`` and the verdict counter's refusal
+        statuses alike), so this is also the single feed point for the
+        metric timeline's ``shed`` column — each refused row lands there
+        exactly once."""
         if n <= 0:
             return
         t = self._tenant(namespace)
@@ -128,6 +133,9 @@ class SloPlane:
             t.shed[reason] = t.shed.get(reason, 0) + n
         for w in t.windows.values():
             w.record(n, n)
+        from sentinel_tpu.metrics.timeline import timeline
+
+        timeline().record(namespace, n_shed=n)
 
     def record_shed_indexed(self, ns_idx, ns_names, reason: str) -> None:
         """Vectorized shed attribution off a ``(ns_idx, ns_names)`` pair

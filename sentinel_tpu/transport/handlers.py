@@ -444,12 +444,42 @@ def cmd_cluster_server_stats(params, body):
     from sentinel_tpu.trace import ring as trace_ring
     from sentinel_tpu.trace.slo import slo_plane
 
+    from sentinel_tpu.metrics.timeline import timeline
+
     out = server_metrics().snapshot()
     out["rebalance"] = ha_metrics().snapshot()["rebalance"]
     out["trace"] = trace_ring.status()
     out["slo"] = slo_plane().snapshot()
+    out["timeline"] = timeline().status()
     out["buildInfo"] = exporter.build_info()
     return out
+
+
+@command_mapping(
+    "cluster/server/metric",
+    "per-namespace per-second timeline; "
+    "startTime&endTime[&namespace][&maxLines]",
+)
+def cmd_cluster_server_metric(params, body):
+    """``SendMetricCommandHandler`` parity for the cluster door: the
+    local ``metric`` command reads per-resource seconds from the rolled
+    metric log; this reads per-namespace seconds from the metric
+    timeline (in-memory window merged with the rolled timeline files
+    when ``SENTINEL_TIMELINE_DIR`` is configured). Times are epoch ms;
+    the response is a JSON list of per-(second, namespace) samples with
+    pass/block/shed/other counts and bucketed p99/max decision latency
+    — the series the scenario harness gates on (docs/SCENARIOS.md)."""
+    from sentinel_tpu.metrics.timeline import timeline
+
+    begin = int(params.get("startTime", 0))
+    end_raw = params.get("endTime")
+    end = int(end_raw) if end_raw is not None else None
+    namespace = params.get("namespace")
+    max_lines = int(params.get("maxLines", 12000))
+    samples = timeline().find(
+        begin, end, namespace=namespace, max_lines=max_lines
+    )
+    return [s.as_dict() for s in samples]
 
 
 @command_mapping(

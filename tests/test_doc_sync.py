@@ -152,9 +152,50 @@ class TestDocCrossLinks:
         "sentinel-blackbox/1",
         "cluster/server/trace",
         "cluster/server/slo",
+        "cluster/server/metric",
         "SENTINEL_TRACE",
         "SENTINEL_BLACKBOX_DIR",
+        "SENTINEL_TIMELINE_DIR",
         "burn = over_fraction / 0.01",
     ])
     def test_doc_covers_trace_surface(self, needle):
         assert needle in _doc_text()
+
+
+class TestScenarioDocSync:
+    """docs/SCENARIOS.md ↔ harness sync: the doc names the gates and the
+    schema the artifact actually carries."""
+
+    def _text(self):
+        with open(os.path.join(REPO, "docs", "SCENARIOS.md")) as f:
+            return f.read()
+
+    def test_readme_links_the_doc(self):
+        with open(os.path.join(REPO, "README.md")) as f:
+            readme = f.read()
+        assert "docs/SCENARIOS.md" in readme
+        assert "scenario_bench.py" in readme
+
+    @pytest.mark.parametrize("needle", [
+        "sentinel-scenario/1",
+        "benchmarks/workload.py",
+        "cluster/server/metric",
+        "zipf_flow_sequence",
+        "send_schedule",
+        "--smoke",
+    ])
+    def test_doc_names_the_surface(self, needle):
+        assert needle in self._text()
+
+    def test_doc_lists_every_gate(self):
+        from benchmarks.scenario_bench import smoke_config
+
+        text = self._text()
+        for gate in ("p99Burn", "fairness", "overAdmission",
+                     "clientErrors", "floodAttribution",
+                     "timelineReconciles"):
+            assert f"`{gate}`" in text
+        # the smoke profile the doc promises is the one CI runs
+        cfg = smoke_config()
+        assert cfg.door == "tcp" and cfg.replica is False
+        assert any(p.chaos for p in cfg.model.phases)

@@ -209,6 +209,85 @@ class TestAdmissionController:
         assert snap["inflight"] == 0 and snap["enabled"] is True
 
 
+class TestWeightedShed:
+    """Per-namespace share-weighted SHED_LOW: each tenant keeps a
+    guaranteed ceil(share x N) rows of the batch; only its newest
+    non-prioritized rows beyond that are shed."""
+
+    @staticmethod
+    def _ctl(shares, default=0.0):
+        return AdmissionController(
+            config=OverloadConfig(ns_shares=shares,
+                                  ns_default_share=default),
+            metrics=ServerMetrics(),
+        )
+
+    def test_flooding_tenant_sheds_beyond_share(self):
+        ctl = self._ctl({"a": 0.25, "b": 0.25})
+        # batch of 8: a floods with 6 rows, b sends 2
+        ns_idx = np.array([0, 0, 0, 0, 0, 0, 1, 1], np.int32)
+        prios = np.zeros(8, bool)
+        mask = ctl.shed_mask(prios, BrownoutLevel.SHED_LOW,
+                             ns_idx=ns_idx, ns_names=("a", "b"))
+        # a's guarantee is ceil(0.25*8)=2: its 4 NEWEST rows are shed
+        assert mask.tolist() == [False, False, True, True,
+                                 True, True, False, False]
+
+    def test_in_share_tenant_is_untouched(self):
+        ctl = self._ctl({"a": 0.5, "b": 0.5})
+        ns_idx = np.array([0, 0, 1, 1], np.int32)
+        mask = ctl.shed_mask(np.zeros(4, bool), BrownoutLevel.SHED_LOW,
+                             ns_idx=ns_idx, ns_names=("a", "b"))
+        assert not mask.any()
+
+    def test_prioritized_rows_never_shed_at_shed_low(self):
+        ctl = self._ctl({"a": 0.0})
+        ns_idx = np.zeros(4, np.int32)
+        prios = np.array([True, True, True, False])
+        mask = ctl.shed_mask(prios, BrownoutLevel.SHED_LOW,
+                             ns_idx=ns_idx, ns_names=("a",))
+        # only the single non-prioritized row is sheddable
+        assert mask.tolist() == [False, False, False, True]
+
+    def test_unattributed_rows_get_default_share(self):
+        ctl = self._ctl({"a": 1.0}, default=0.0)
+        # ns_idx -1 = no rule matched: with default share 0, all shed
+        ns_idx = np.array([-1, -1, 0, 0], np.int32)
+        mask = ctl.shed_mask(np.zeros(4, bool), BrownoutLevel.SHED_LOW,
+                             ns_idx=ns_idx, ns_names=("a",))
+        assert mask.tolist() == [True, True, False, False]
+
+    def test_no_shares_falls_back_to_legacy(self):
+        ctl = AdmissionController(
+            config=OverloadConfig(), metrics=ServerMetrics())
+        prios = np.array([True, False])
+        mask = ctl.shed_mask(prios, BrownoutLevel.SHED_LOW,
+                             ns_idx=np.zeros(2, np.int32), ns_names=("a",))
+        assert mask.tolist() == [False, True]  # ~prios, as before
+
+    def test_no_attribution_falls_back_to_legacy(self):
+        ctl = self._ctl({"a": 1.0})
+        prios = np.array([True, False])
+        assert ctl.shed_mask(prios, BrownoutLevel.SHED_LOW).tolist() == [
+            False, True]
+
+    def test_set_shares_installs_and_clears(self):
+        ctl = AdmissionController(
+            config=OverloadConfig(), metrics=ServerMetrics())
+        ctl.set_shares({"a": 0.5})
+        assert ctl.snapshot()["nsShares"] == {"a": 0.5}
+        ctl.set_shares(None)
+        assert ctl.snapshot()["nsShares"] == {}
+
+    def test_parse_shares(self):
+        from sentinel_tpu.overload import parse_shares
+
+        assert parse_shares("a=0.25, b=0.5") == {"a": 0.25, "b": 0.5}
+        assert parse_shares("") == {}
+        # malformed entries are dropped, negatives clamped to 0
+        assert parse_shares("a=x,b=-1,=0.2,c=0.1") == {"b": 0.0, "c": 0.1}
+
+
 # -- shed metrics surface ----------------------------------------------------
 class TestShedMetrics:
     def test_count_and_render(self):

@@ -446,9 +446,18 @@ def _build_stack(cfg: ScenarioConfig):
         metered_qps = max(1.0, cfg.metered_frac * t.base_rate)
         for f in range(t.first_flow, t.first_flow + t.n_flows):
             count = metered_qps if f == t.first_flow else 1e9
+            # the tenant's shaping profile (workload.cold_start_tenant /
+            # paced_tenant) rides on the metered flow only — the long tail
+            # stays plain so shaping effects are attributable
+            shaped = f == t.first_flow and t.control_behavior != 0
             rules.append(
-                ClusterFlowRule(f, count, ThresholdMode.GLOBAL,
-                                namespace=t.name)
+                ClusterFlowRule(
+                    f, count, ThresholdMode.GLOBAL, namespace=t.name,
+                    control_behavior=t.control_behavior if shaped else 0,
+                    warm_up_period_sec=t.warm_up_period_sec,
+                    cold_factor=t.cold_factor,
+                    max_queueing_time_ms=t.max_queueing_time_ms,
+                )
             )
     svc = DefaultTokenService(
         EngineConfig(max_flows=total_flows, max_namespaces=len(

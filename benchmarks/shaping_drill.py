@@ -1,0 +1,276 @@
+"""Seeded traffic-shaping drill (CI gate for the shaper columns).
+
+Engine-level and fully deterministic: the drill drives ``decide`` with an
+explicit clock (no sleeps, no wall time), so the gates are exact claims
+about the kernel, not timing-tolerant approximations. Three gates:
+
+1. **Zero over-admission through the cross-batch borrow.** Every paced
+   admission is a scheduled pass at ``now + wait_ms``; collecting the
+   schedule across all batches of an open-loop burst drive, any sliding
+   1s window may hold at most ``count + 1`` scheduled passes. The "+1" is
+   the window-straddle row, not slack: pacing spaces passes by
+   ``1000/count`` ms, so ``count`` full gaps plus the boundary row is the
+   exact ceiling. The borrow is what makes this hold ACROSS batches — a
+   burst that arrives after SHOULD_WAIT verdicts were assigned finds the
+   future window already charged.
+2. **Paced spacing within tolerance.** Consecutive scheduled passes of the
+   paced flow sit >= cost - 1 ms apart (1ms for integer rounding), every
+   assigned wait is <= max_queueing_time_ms, and the flow's
+   latest_passed_time never decreases.
+3. **Warmup cold start.** A cold WARM_UP flow's first-second admissions
+   land at the cold rate (count/coldFactor), not the full count.
+
+The drill also reconciles the future-window accounting every step: the
+occupy tensor's future sum must grow by exactly the step's SHOULD_WAIT
+count (the pre-paid borrow the over-admission gate relies on).
+
+Flows come from the shared workload profiles (``cold_start_tenant`` /
+``paced_tenant``), the same specs ``scenario_bench.py`` builds rules from.
+Exit code is nonzero on any violated gate::
+
+    JAX_PLATFORMS=cpu python benchmarks/shaping_drill.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SCHEMA = "sentinel-shaping-drill/1"
+RESULTS_DIR = os.path.join(REPO, "benchmarks", "results")
+
+
+def run_drill(seed: int = 20260805, verbose: bool = True) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.workload import cold_start_tenant, paced_tenant
+    from sentinel_tpu.engine import (
+        ClusterFlowRule,
+        EngineConfig,
+        TokenStatus,
+        build_rule_table,
+        decide,
+        make_batch,
+        make_state,
+    )
+    from sentinel_tpu.engine.rules import ThresholdMode
+    from sentinel_tpu.engine.state import flow_spec
+    from sentinel_tpu.stats import window as W
+
+    cfg = EngineConfig(max_flows=64, max_namespaces=4, batch_size=64)
+    spec = flow_spec(cfg)
+    rate = 100.0  # paced flow: cost = 1000/rate = 10ms between passes
+    maxq = 400
+    cold_factor = 3
+
+    tenants = [
+        paced_tenant("paced", 0, 8, share=0.5, base_rate=800.0,
+                     max_queueing_time_ms=maxq),
+        cold_start_tenant("cold", 8, 8, share=0.5, base_rate=800.0,
+                          cold_factor=cold_factor),
+    ]
+    rules = []
+    for t in tenants:
+        for f in range(t.first_flow, t.first_flow + t.n_flows):
+            shaped = f == t.first_flow
+            rules.append(ClusterFlowRule(
+                f, rate if shaped else 1e9, ThresholdMode.GLOBAL,
+                namespace=t.name,
+                control_behavior=t.control_behavior if shaped else 0,
+                warm_up_period_sec=t.warm_up_period_sec,
+                cold_factor=t.cold_factor,
+                max_queueing_time_ms=t.max_queueing_time_ms,
+            ))
+    table, index = build_rule_table(cfg, rules)
+    state = make_state(cfg)
+    paced_slot = index.lookup(tenants[0].first_flow)
+    cold_slot = index.lookup(tenants[1].first_flow)
+    noise_slots = [index.lookup(f) for f in range(1, 8)]
+    cost_ms = 1000.0 / rate
+    rng = np.random.default_rng(seed)
+    violations = []
+
+    # -- phase A: warmup cold start ------------------------------------------
+    now = 10_000
+    cold_admitted_first_sec = 0
+    for _ in range(10):
+        batch = make_batch(cfg, [cold_slot] * 20)
+        state, v = decide(cfg, state, table, batch, jnp.int32(now))
+        cold_admitted_first_sec += int(
+            (np.asarray(v.status)[:20] == TokenStatus.OK).sum()
+        )
+        now += 100
+    cold_ceiling = int(rate / cold_factor) + 2
+    if not 1 <= cold_admitted_first_sec <= cold_ceiling:
+        violations.append(
+            f"warmup cold start admitted {cold_admitted_first_sec} in the "
+            f"first second (cold ceiling {cold_ceiling})"
+        )
+
+    # -- phase B: open-loop bursts against the paced flow --------------------
+    sched = []  # absolute scheduled pass times of the paced flow
+    waits = []
+    prev_lpt = int(W.NEVER)
+    lpt_regressions = 0
+    borrow_mismatch = 0
+    n_should_wait = n_ok = n_reject = 0
+    now += 1000
+    t_start = now
+    for step in range(400):
+        n_burst = int(rng.integers(0, 13))
+        n_noise = int(rng.integers(0, 20))
+        slots = [paced_slot] * n_burst + [
+            int(rng.choice(noise_slots)) for _ in range(n_noise)
+        ]
+        if not slots:
+            now += int(rng.integers(5, 80))
+            continue
+        batch = make_batch(cfg, slots)
+        fut_before = int(W.future_sum_at(
+            spec, state.occupy, jnp.int32(now), 0, jnp.asarray([paced_slot])
+        )[0])
+        state, v = decide(cfg, state, table, batch, jnp.int32(now))
+        st = np.asarray(v.status)[:n_burst]
+        wt = np.asarray(v.wait_ms)[:n_burst]
+        for s, w in zip(st, wt):
+            if s == TokenStatus.OK:
+                n_ok += 1
+                sched.append(now)
+            elif s == TokenStatus.SHOULD_WAIT:
+                n_should_wait += 1
+                sched.append(now + int(w))
+                waits.append(int(w))
+            else:
+                n_reject += 1
+        fut_after = int(W.future_sum_at(
+            spec, state.occupy, jnp.int32(now), 0, jnp.asarray([paced_slot])
+        )[0])
+        step_waiting = int((st == TokenStatus.SHOULD_WAIT).sum())
+        if fut_after - fut_before != step_waiting:
+            borrow_mismatch += 1
+        lpt = int(np.asarray(state.shaping.lpt)[paced_slot])
+        if lpt < prev_lpt:
+            lpt_regressions += 1
+        prev_lpt = lpt
+        now += int(rng.integers(5, 80))
+
+    sched_arr = np.sort(np.asarray(sched, np.int64))
+    gaps = np.diff(sched_arr)
+    min_gap = int(gaps.min()) if gaps.size else int(cost_ms)
+    max_wait = max(waits) if waits else 0
+    # sliding-window occupancy: for each admission, how many land within
+    # the following 1000ms (inclusive of the straddle row)
+    max_in_window = 0
+    j = 0
+    for i in range(sched_arr.size):
+        j = max(j, i)
+        while j < sched_arr.size and sched_arr[j] < sched_arr[i] + 1000:
+            j += 1
+        max_in_window = max(max_in_window, j - i)
+    window_ceiling = int(rate) + 1
+
+    if min_gap < cost_ms - 1:
+        violations.append(
+            f"paced spacing violated: min inter-admission gap {min_gap}ms "
+            f"< cost {cost_ms}ms - 1ms tolerance"
+        )
+    if max_wait > maxq:
+        violations.append(
+            f"assigned wait {max_wait}ms exceeds max_queueing_time_ms {maxq}"
+        )
+    if max_in_window > window_ceiling:
+        violations.append(
+            f"over-admission: {max_in_window} scheduled passes in a 1s "
+            f"window (ceiling {window_ceiling})"
+        )
+    if lpt_regressions:
+        violations.append(
+            f"latest_passed_time regressed {lpt_regressions} times"
+        )
+    if borrow_mismatch:
+        violations.append(
+            f"future-window borrow accounting mismatched on "
+            f"{borrow_mismatch} steps"
+        )
+    if n_should_wait == 0 or n_reject == 0:
+        violations.append(
+            "drive too gentle: the drill must exercise both SHOULD_WAIT "
+            f"and the queue-cap reject (waited={n_should_wait}, "
+            f"rejected={n_reject})"
+        )
+
+    doc = {
+        "schema": SCHEMA,
+        "seed": seed,
+        "drive_span_ms": int(now - t_start),
+        "paced": {
+            "rate_qps": rate,
+            "cost_ms": cost_ms,
+            "max_queueing_time_ms": maxq,
+            "admitted_now": n_ok,
+            "admitted_should_wait": n_should_wait,
+            "rejected": n_reject,
+            "min_gap_ms": min_gap,
+            "max_wait_ms": max_wait,
+            "max_in_1s_window": max_in_window,
+            "window_ceiling": window_ceiling,
+        },
+        "warmup": {
+            "cold_factor": cold_factor,
+            "admitted_first_sec": cold_admitted_first_sec,
+            "cold_ceiling": cold_ceiling,
+        },
+        "violations": violations,
+    }
+    if verbose:
+        print(json.dumps(doc, indent=2))
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=20260805)
+    ap.add_argument("--out-dir", default=RESULTS_DIR)
+    ap.add_argument("--no-artifact", action="store_true",
+                    help="skip writing the results JSON")
+    args = ap.parse_args()
+
+    doc = run_drill(seed=args.seed)
+    if not args.no_artifact:
+        os.makedirs(args.out_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        path = os.path.join(args.out_dir, f"shaping-{stamp}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"wrote {path}")
+    if doc["violations"]:
+        for vi in doc["violations"]:
+            print(f"GATE VIOLATED: {vi}", file=sys.stderr)
+        return 1
+    print(
+        "shaping drill ok: "
+        f"{doc['paced']['admitted_now']} pass-now, "
+        f"{doc['paced']['admitted_should_wait']} pass-later, "
+        f"{doc['paced']['rejected']} rejected; "
+        f"min gap {doc['paced']['min_gap_ms']}ms, "
+        f"max {doc['paced']['max_in_1s_window']}/1s window "
+        f"(ceiling {doc['paced']['window_ceiling']}); "
+        f"cold first-second {doc['warmup']['admitted_first_sec']} "
+        f"(ceiling {doc['warmup']['cold_ceiling']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,14 @@ class TenantSpec:
     zipf_alpha: float = 1.1
     batch: int = 32  # rows per request frame
     prioritized: bool = False  # mark this tenant's rows prioritized
+    # traffic shaping applied to this tenant's METERED flow (the Zipf-rank-1
+    # flow that carries the finite threshold): 0=none, 1=warmup, 2=pacing,
+    # 3=both — mirrors ClusterFlowRule.control_behavior so the stack builder
+    # can copy these straight onto the rule
+    control_behavior: int = 0
+    warm_up_period_sec: int = 10
+    cold_factor: int = 3
+    max_queueing_time_ms: int = 500
 
     def flow_stream(self, size: int, seed: int) -> np.ndarray:
         """Tenant-local Zipf stream mapped into this tenant's flow range
@@ -72,6 +80,34 @@ class TenantSpec:
             seed ^ (zlib.crc32(self.name.encode()) & 0x7FFFFFFF),
         )
         return (local + self.first_flow).astype(np.int64)
+
+
+def cold_start_tenant(name: str, first_flow: int, n_flows: int,
+                      share: float, base_rate: float,
+                      warm_up_period_sec: int = 10, cold_factor: int = 3,
+                      **kw) -> TenantSpec:
+    """A tenant whose metered flow starts COLD behind a warmup curve: pair
+    it with a ``ramp`` phase and the admitted rate climbs the token-slope
+    from count/cold_factor toward the full count while the offered load
+    ramps — the cache-warming / pool-filling cold-start story."""
+    return TenantSpec(
+        name, first_flow, n_flows, share, base_rate,
+        control_behavior=1, warm_up_period_sec=warm_up_period_sec,
+        cold_factor=cold_factor, **kw,
+    )
+
+
+def paced_tenant(name: str, first_flow: int, n_flows: int,
+                 share: float, base_rate: float,
+                 max_queueing_time_ms: int = 500, **kw) -> TenantSpec:
+    """A tenant whose metered flow is PACED (leaky-bucket rate limiter):
+    bursts come back as SHOULD_WAIT + wait-ms instead of blocks, spaced at
+    1000/count ms, up to the queueing cap. The drill and the scenario
+    harness read the assigned waits off this tenant's verdicts."""
+    return TenantSpec(
+        name, first_flow, n_flows, share, base_rate,
+        control_behavior=2, max_queueing_time_ms=max_queueing_time_ms, **kw,
+    )
 
 
 @dataclass

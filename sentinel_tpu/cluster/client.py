@@ -114,7 +114,8 @@ class _FlowLease:
 class TokenClient(TokenService):
     def __init__(self, host: str, port: int, timeout_ms: int = 20,
                  namespace: str = "default", lease: bool = False,
-                 lease_want: int = 256, lease_backoff_s: float = 0.1):
+                 lease_want: int = 256, lease_backoff_s: float = 0.1,
+                 wait_and_admit: bool = False):
         self.host = host
         self.port = port
         self.timeout_ms = timeout_ms
@@ -157,6 +158,12 @@ class TokenClient(TokenService):
             "expired": 0, "local_admits": 0, "wire_rows": 0,
         }
         self._rpcs = 0  # wire round trips (request/lease/ping/batch chunks)
+        # opt-in pacing cooperation: a SHOULD_WAIT verdict with a wait hint
+        # means the server already reserved the token at now+wait (paced
+        # admission / priority occupy) — sleeping out the hint and reporting
+        # OK needs no second RPC. Off by default: most callers want the
+        # hint, not the blocking.
+        self.wait_and_admit = bool(wait_and_admit)
 
     @property
     def consecutive_failures(self) -> int:
@@ -345,10 +352,26 @@ class TokenClient(TokenService):
         )
         if rsp is None:
             return TokenResult(TokenStatus.FAIL)
-        return TokenResult(
+        return self._maybe_wait(TokenResult(
             TokenStatus(rsp.status), rsp.remaining, rsp.wait_ms,
             endpoint=rsp.endpoint,
-        )
+        ))
+
+    def _maybe_wait(self, res: TokenResult) -> TokenResult:
+        """``wait_and_admit`` resolution of a SHOULD_WAIT verdict: the
+        server's charge already covers this request at ``now + wait_ms``,
+        so sleeping out the hint IS the admission."""
+        if (
+            self.wait_and_admit
+            and res.status == TokenStatus.SHOULD_WAIT
+            and res.wait_ms > 0
+        ):
+            time.sleep(res.wait_ms / 1000.0)
+            return TokenResult(
+                TokenStatus.OK, res.remaining, res.wait_ms,
+                endpoint=res.endpoint,
+            )
+        return res
 
     # -- wire rev 5: client-local admission ---------------------------------
     def _lease_admit(self, flow_id: int, acquire: int) -> Optional[TokenResult]:

@@ -181,20 +181,14 @@ def encode_move_state_blob(doc: Dict[str, object]) -> bytes:
     """``export_namespace_state()`` document → compressed wire blob (rules
     serialize with the ha.snapshot idiom, arrays with its base64+zlib
     codec)."""
+    from sentinel_tpu.engine.rules import encode_rule as _encode_rule
+
     out: Dict[str, object] = {
         "version": MOVE_STATE_VERSION,
         "namespace": doc["namespace"],
         "wall_ms": int(doc["wall_ms"]),
         "interval_ms": int(doc["interval_ms"]),
-        "rules": [
-            {
-                "flow_id": r.flow_id,
-                "count": r.count,
-                "mode": int(r.mode),
-                "namespace": r.namespace,
-            }
-            for r in doc["rules"]
-        ],
+        "rules": [_encode_rule(r) for r in doc["rules"]],
         "param_rules": [
             {
                 "flow_id": r.flow_id,
@@ -213,6 +207,12 @@ def encode_move_state_blob(doc: Dict[str, object]) -> bytes:
         "param_fids": [int(f) for f in doc["param_fids"]],
         "param_sums": _enc_array(doc["param_sums"]),
     }
+    # shaper clocks (relative-to-export-now; absent in pre-shaping exports)
+    for k in (
+        "shaping_lpt_rel", "shaping_warm_tokens", "shaping_warm_filled_rel"
+    ):
+        if k in doc:
+            out[k] = _enc_array(doc[k])
     return zlib.compress(json.dumps(out, separators=(",", ":")).encode())
 
 
@@ -221,8 +221,7 @@ def decode_move_state_blob(blob: bytes) -> Dict[str, object]:
     ``ValueError`` on any malformed input (fuzz-safe — corrupt bytes must
     never kill the destination door)."""
     from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
-    from sentinel_tpu.engine import ClusterFlowRule
-    from sentinel_tpu.engine.rules import ThresholdMode
+    from sentinel_tpu.engine.rules import decode_rule as _decode_rule
 
     try:
         out = json.loads(zlib.decompress(blob).decode())
@@ -232,13 +231,7 @@ def decode_move_state_blob(blob: bytes) -> Dict[str, object]:
             "namespace": str(out["namespace"]),
             "wall_ms": int(out["wall_ms"]),
             "interval_ms": int(out["interval_ms"]),
-            "rules": [
-                ClusterFlowRule(
-                    int(r["flow_id"]), float(r["count"]),
-                    ThresholdMode(int(r["mode"])), str(r["namespace"]),
-                )
-                for r in out["rules"]
-            ],
+            "rules": [_decode_rule(r) for r in out["rules"]],
             "param_rules": [
                 ClusterParamFlowRule(
                     int(r["flow_id"]), float(r["count"]),
@@ -255,6 +248,15 @@ def decode_move_state_blob(blob: bytes) -> Dict[str, object]:
             "ns_sum": _dec_array(out["ns_sum"]),
             "param_fids": [int(f) for f in out["param_fids"]],
             "param_sums": _dec_array(out["param_sums"]),
+            **{
+                k: _dec_array(out[k])
+                for k in (
+                    "shaping_lpt_rel",
+                    "shaping_warm_tokens",
+                    "shaping_warm_filled_rel",
+                )
+                if k in out
+            },
         }
     except ValueError:
         raise

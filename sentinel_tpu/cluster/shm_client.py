@@ -55,10 +55,11 @@ class ShmTokenClient(TokenClient):
                  namespace: str = "default", slot_payload: int = 65536,
                  n_slots: int = 16, spin_us: Optional[int] = None,
                  lease: bool = False, lease_want: int = 256,
-                 lease_backoff_s: float = 0.1):
+                 lease_backoff_s: float = 0.1, wait_and_admit: bool = False):
         super().__init__(f"shm:{shm_dir}", -1, timeout_ms, namespace,
                          lease=lease, lease_want=lease_want,
-                         lease_backoff_s=lease_backoff_s)
+                         lease_backoff_s=lease_backoff_s,
+                         wait_and_admit=wait_and_admit)
         self.shm_dir = shm_dir
         self.slot_payload = slot_payload
         self.n_slots = n_slots

@@ -629,9 +629,13 @@ class DefaultTokenService(TokenService):
         namespace's intact (``ClusterFlowRuleManager.loadRules(namespace,
         rules)`` — the shape the cluster/server/modifyFlowRules command
         edits)."""
+        import dataclasses as _dc
+
+        # replace() keeps every field (including the shaping knobs) — a
+        # positional rebuild here would silently strip control_behavior
         fixed = [
             r if r.namespace == namespace
-            else ClusterFlowRule(r.flow_id, r.count, r.mode, namespace)
+            else _dc.replace(r, namespace=namespace)
             for r in rules
         ]
         with self._rules_mutex:
@@ -714,11 +718,24 @@ class DefaultTokenService(TokenService):
             from sentinel_tpu.engine.param import NEVER as _PNEVER
             from sentinel_tpu.stats.window import rebase
 
+            from sentinel_tpu.stats.window import NEVER as _WNEVER
+
             delta = now - 60_000  # keep the last minute of history addressable
+            shp = self._state.shaping
+            d32 = jnp.int32(delta)
             self._state = EngineState(
                 flow=rebase(self._state.flow, delta),
                 occupy=rebase(self._state.occupy, delta),
                 ns=rebase(self._state.ns, delta),
+                # the shaper clocks are engine-ms too; NEVER stays NEVER
+                shaping=shp._replace(
+                    lpt=jnp.where(shp.lpt == _WNEVER, shp.lpt, shp.lpt - d32),
+                    warm_filled=jnp.where(
+                        shp.warm_filled == _WNEVER,
+                        shp.warm_filled,
+                        shp.warm_filled - d32,
+                    ),
+                ),
             )
             # the param sketch's starts are engine-ms too
             pstarts = self._param_state.starts
@@ -975,6 +992,7 @@ class DefaultTokenService(TokenService):
             _SM.record_verdict_batch(
                 status, ns_idx, ns_names,
                 latency_ms=(time.monotonic() - t_dispatch) * 1e3,
+                wait_ms=wait,
             )
             if _TR.ARMED:  # flight recorder: device step materialized
                 _TR.record(_TR.DEVICE_OUT, aux=n)
@@ -1203,6 +1221,7 @@ class DefaultTokenService(TokenService):
             _SM.record_verdict_batch(
                 status, ns_idx, ns_names,
                 latency_ms=(time.monotonic() - t_dispatch) * 1e3,
+                wait_ms=wait,
             )
             if _TR.ARMED:  # flight recorder: fused group materialized
                 _TR.record(_TR.DEVICE_OUT, aux=depth * cap)
@@ -1619,6 +1638,11 @@ class DefaultTokenService(TokenService):
         want = int(want)
         if want <= 0 or self.lease_fraction <= 0.0:
             return LeaseResult(int(TokenStatus.NOT_LEASABLE))
+        if int(getattr(rule, "control_behavior", 0)) != 0:
+            # a shaped rule's admission curve lives in the device shaper
+            # state — client-local lease admission would bypass warmup and
+            # pacing entirely, so shaped flows are simply not leasable
+            return LeaseResult(int(TokenStatus.NOT_LEASABLE))
         slot = self._index.slot_of.get(flow_id)
         if slot is None:
             return LeaseResult(int(TokenStatus.NO_RULE_EXISTS))
@@ -1982,9 +2006,17 @@ class DefaultTokenService(TokenService):
             nsum = np.asarray(
                 W.window_sum_all(spec, self._state.ns, jnp.int32(now))
             )
+            from sentinel_tpu.stats.window import NEVER as _WNEVER
+
+            lpt_h = np.asarray(self._state.shaping.lpt)
+            wtok_h = np.asarray(self._state.shaping.warm_tokens)
+            wfill_h = np.asarray(self._state.shaping.warm_filled)
             flow_ids: List[int] = []
             frows: List[np.ndarray] = []
             orows: List[np.ndarray] = []
+            lpt_rel: List[int] = []
+            wtok_rows: List[float] = []
+            wfill_rel: List[int] = []
             for r in rules:
                 slot = self._index.slot_of.get(r.flow_id)
                 if slot is None:
@@ -1992,6 +2024,17 @@ class DefaultTokenService(TokenService):
                 flow_ids.append(int(r.flow_id))
                 frows.append(fsum[slot])
                 orows.append(osum[slot])
+                # shaper clocks ship RELATIVE to now — the destination's
+                # engine epoch is its own; NEVER stays NEVER
+                lpt_rel.append(
+                    int(_WNEVER) if lpt_h[slot] == int(_WNEVER)
+                    else int(lpt_h[slot]) - now
+                )
+                wtok_rows.append(float(wtok_h[slot]))
+                wfill_rel.append(
+                    int(_WNEVER) if wfill_h[slot] == int(_WNEVER)
+                    else int(wfill_h[slot]) - now
+                )
             row = self._index.ns_of.get(namespace)
             doc: Dict[str, object] = {
                 "namespace": namespace,
@@ -2012,6 +2055,9 @@ class DefaultTokenService(TokenService):
                     np.array(nsum[row]) if row is not None
                     else np.zeros(nsum.shape[1], nsum.dtype)
                 ),
+                "shaping_lpt_rel": np.asarray(lpt_rel, np.int64),
+                "shaping_warm_tokens": np.asarray(wtok_rows, np.float32),
+                "shaping_warm_filled_rel": np.asarray(wfill_rel, np.int64),
             }
             # param sketch: per-slot live-window cell sums [depth, cells] —
             # summed over DECODED cells (sketch.decoded_counts_np), so the
@@ -2089,8 +2135,42 @@ class DefaultTokenService(TokenService):
                     None if row is None else [row],
                     None if row is None else np.asarray(doc["ns_sum"])[None],
                 )
+                # re-anchor the moved shaper clocks to THIS engine's epoch:
+                # the blob ships them relative to the source's export now
+                # (pre-shaping blobs simply carry no keys — clocks start
+                # cold, the conservative default)
+                shaping = self._state.shaping
+                lpt_rel = doc.get("shaping_lpt_rel")
+                if lpt_rel is not None and flow_ids:
+                    from sentinel_tpu.stats.window import NEVER as _WNEVER
+
+                    lpt_h = np.asarray(shaping.lpt).copy()
+                    wtok_h = np.asarray(shaping.warm_tokens).copy()
+                    wfill_h = np.asarray(shaping.warm_filled).copy()
+                    wtok_in = np.asarray(doc["shaping_warm_tokens"])
+                    wfill_in = np.asarray(doc["shaping_warm_filled_rel"])
+                    lpt_in = np.asarray(lpt_rel)
+                    for i, s in enumerate(np.asarray(slots)):
+                        lpt_h[s] = (
+                            int(_WNEVER) if lpt_in[i] == int(_WNEVER)
+                            else int(np.clip(
+                                now + int(lpt_in[i]), int(_WNEVER), 2**30
+                            ))
+                        )
+                        wtok_h[s] = wtok_in[i]
+                        wfill_h[s] = (
+                            int(_WNEVER) if wfill_in[i] == int(_WNEVER)
+                            else int(np.clip(
+                                now + int(wfill_in[i]), int(_WNEVER), 2**30
+                            ))
+                        )
+                    shaping = shaping._replace(
+                        lpt=jnp.asarray(lpt_h),
+                        warm_tokens=jnp.asarray(wtok_h),
+                        warm_filled=jnp.asarray(wfill_h),
+                    )
                 self._state = self._place_state(
-                    _ES(flow=flow, occupy=occupy, ns=ns)
+                    _ES(flow=flow, occupy=occupy, ns=ns, shaping=shaping)
                 )
                 pfids = [int(f) for f in doc.get("param_fids", [])]
                 if pfids:
@@ -2150,6 +2230,16 @@ class DefaultTokenService(TokenService):
                 "flow": _win(self._state.flow),
                 "occupy": _win(self._state.occupy),
                 "ns": _win(self._state.ns),
+                # per-flow shaper clocks (engine-ms; same epoch as starts)
+                "shaping": {
+                    "lpt": np.asarray(self._state.shaping.lpt),
+                    "warm_tokens": np.asarray(
+                        self._state.shaping.warm_tokens
+                    ),
+                    "warm_filled": np.asarray(
+                        self._state.shaping.warm_filled
+                    ),
+                },
                 "param": {
                     "starts": np.asarray(self._param_state.starts),
                     # fat cells ship RAW (bit-exact restore — for SALSA the
@@ -2224,6 +2314,9 @@ class DefaultTokenService(TokenService):
                                     self._param_state.slim)
                 p_auth = state["param"].get("slim_auth")
                 p_merges = state["param"].get("merges")
+                # pre-shaping snapshots carry no shaper clocks — restore
+                # them cold (NEVER/0), which is the conservative default
+                shaping_doc = state.get("shaping")
             self.load_rules(
                 rules,
                 ns_max_qps=float(state["ns_max_qps"]),
@@ -2236,12 +2329,26 @@ class DefaultTokenService(TokenService):
                 old_slot = state["slot_of"]
                 new_flow_c = np.zeros_like(flow_c)
                 new_occ_c = np.zeros_like(occ_c)
+                from sentinel_tpu.stats.window import NEVER as _WNEVER
+
+                n_flows = self.config.max_flows
+                new_lpt = np.full(n_flows, int(_WNEVER), np.int32)
+                new_wtok = np.zeros(n_flows, np.float32)
+                new_wfill = np.full(n_flows, int(_WNEVER), np.int32)
                 for fid, new in self._index.slot_of.items():
                     old = old_slot.get(fid)
                     if old is None:
                         continue
                     new_flow_c[new] = flow_c[old]
                     new_occ_c[new] = occ_c[old]
+                    if shaping_doc is not None:
+                        new_lpt[new] = np.asarray(shaping_doc["lpt"])[old]
+                        new_wtok[new] = np.asarray(
+                            shaping_doc["warm_tokens"]
+                        )[old]
+                        new_wfill[new] = np.asarray(
+                            shaping_doc["warm_filled"]
+                        )[old]
                 # namespace guard rows remap by name
                 old_ns = state["ns_of"]
                 new_ns_c = np.zeros_like(ns_c)
@@ -2269,10 +2376,19 @@ class DefaultTokenService(TokenService):
                             new_p_slim[new] = p_slim[old]
                         if p_merges is not None:
                             new_p_merges[new] = np.asarray(p_merges)[old]
+                from sentinel_tpu.engine.state import (
+                    ShapingState as _SHS,
+                )
+
                 self._state = self._place_state(_ES(
                     flow=_WS(jnp.asarray(flow_s), jnp.asarray(new_flow_c)),
                     occupy=_WS(jnp.asarray(occ_s), jnp.asarray(new_occ_c)),
                     ns=_WS(jnp.asarray(ns_s), jnp.asarray(new_ns_c)),
+                    shaping=_SHS(
+                        lpt=jnp.asarray(new_lpt),
+                        warm_tokens=jnp.asarray(new_wtok),
+                        warm_filled=jnp.asarray(new_wfill),
+                    ),
                 ))
                 self._param_state = self._param_state._replace(
                     starts=jnp.asarray(p_s),
@@ -2362,6 +2478,17 @@ class DefaultTokenService(TokenService):
                 delta["occupy_counts"] = host_rows(
                     self._state.occupy.counts, sl
                 )
+                # shaper clocks ride the same dirty-row keying; values are
+                # engine-ms in the shared epoch the delta already pins
+                delta["shaping_lpt"] = host_rows(
+                    self._state.shaping.lpt, sl
+                )
+                delta["shaping_warm_tokens"] = host_rows(
+                    self._state.shaping.warm_tokens, sl
+                )
+                delta["shaping_warm_filled"] = host_rows(
+                    self._state.shaping.warm_filled, sl
+                )
                 # namespace guard rows these slots feed
                 ns_names, slot_ns = self._ns_snapshot
                 rows = sorted(
@@ -2450,6 +2577,7 @@ class DefaultTokenService(TokenService):
             occupy = _rotate(self._state.occupy, delta["occupy_starts"])
             ns = _rotate(self._state.ns, delta["ns_starts"])
             flow_ids = delta.get("flow_ids")
+            shaping = self._state.shaping
             if flow_ids:
                 slots = []
                 for fid in flow_ids:
@@ -2468,6 +2596,20 @@ class DefaultTokenService(TokenService):
                         jnp.asarray(delta["occupy_counts"])
                     )
                 )
+                if "shaping_lpt" in delta:
+                    # shaper clocks are raw engine-ms: the epoch check above
+                    # already guarantees both sides share the timeline
+                    shaping = shaping._replace(
+                        lpt=shaping.lpt.at[sl].set(
+                            jnp.asarray(delta["shaping_lpt"])
+                        ),
+                        warm_tokens=shaping.warm_tokens.at[sl].set(
+                            jnp.asarray(delta["shaping_warm_tokens"])
+                        ),
+                        warm_filled=shaping.warm_filled.at[sl].set(
+                            jnp.asarray(delta["shaping_warm_filled"])
+                        ),
+                    )
             ns_names = delta.get("ns_names")
             if ns_names:
                 rows = []
@@ -2490,6 +2632,7 @@ class DefaultTokenService(TokenService):
                     jnp.asarray(delta["occupy_starts"]), occupy.counts
                 ),
                 ns=_WS(jnp.asarray(delta["ns_starts"]), ns.counts),
+                shaping=shaping,
             ))
             pstate = _rotate(self._param_state, delta["param_starts"])
             pcounts = pstate.counts

@@ -286,21 +286,28 @@ def cluster_flow_rules_from_json(text: str):
                 )
             ),
             namespace=str(d.get("namespace", "default") or "default"),
+            control_behavior=int(d.get("controlBehavior", 0)),
+            warm_up_period_sec=int(d.get("warmUpPeriodSec", 10)),
+            cold_factor=int(d.get("coldFactor", 3)),
+            max_queueing_time_ms=int(d.get("maxQueueingTimeMs", 500)),
         )
         for d in json.loads(text) or []
     ]
 
 
 def cluster_flow_rules_to_json(rules) -> str:
-    return json.dumps(
-        [
-            {
-                "flowId": r.flow_id,
-                "count": r.count,
-                "thresholdType": int(r.mode),
-                "namespace": r.namespace,
-            }
-            for r in rules
-        ],
-        indent=2,
-    )
+    docs = []
+    for r in rules:
+        d = {
+            "flowId": r.flow_id,
+            "count": r.count,
+            "thresholdType": int(r.mode),
+            "namespace": r.namespace,
+        }
+        if int(getattr(r, "control_behavior", 0)) != 0:
+            d["controlBehavior"] = int(r.control_behavior)
+            d["warmUpPeriodSec"] = int(r.warm_up_period_sec)
+            d["coldFactor"] = int(r.cold_factor)
+            d["maxQueueingTimeMs"] = int(r.max_queueing_time_ms)
+        docs.append(d)
+    return json.dumps(docs, indent=2)

@@ -39,7 +39,12 @@ import numpy as np
 
 from sentinel_tpu.engine.config import EngineConfig
 from sentinel_tpu.engine.rules import RuleTable, ThresholdMode
-from sentinel_tpu.engine.state import ClusterEvent, EngineState, flow_spec
+from sentinel_tpu.engine.state import (
+    ClusterEvent,
+    EngineState,
+    ShapingState,
+    flow_spec,
+)
 from sentinel_tpu.stats import window as W
 
 
@@ -307,16 +312,7 @@ def _decide_core(
     factor = jnp.where(
         rules.mode[safe_slot] == int(ThresholdMode.AVG_LOCAL), conn, 1.0
     )
-    # rule count is per-second (ClusterMetric.getAvg divides by interval
-    # seconds before comparing); the window budget scales by interval length
-    threshold = (
-        rules.count[safe_slot] * factor * config.exceed_count
-        * (spec.interval_ms / 1000.0)
-    )
 
-    # ------------------------------------------------------------------
-    # 3. prefix-sum admission (odd refinement count ⇒ ⊆ sequential-exact)
-    # ------------------------------------------------------------------
     passed = (
         W.window_sum_at(spec, state.flow, now, ClusterEvent.PASS, safe_slot)
         + W.window_sum_at(spec, state.occupy, now, 0, safe_slot)  # matured borrows
@@ -325,6 +321,79 @@ def _decide_core(
         # exactly like passed tokens until they expire or are credited back
         + W.window_sum_at(spec, state.flow, now, ClusterEvent.LEASED, safe_slot)
     ).astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    # 2b. traffic shaping (FlowRule.controlBehavior): WARM_UP modulates the
+    #     admission rate along the stored-token slope curve; RATE_LIMITER
+    #     rows skip window admission entirely and are paced below. Both
+    #     blocks are cond-gated on mesh-uniform "any shaped row in this
+    #     batch" predicates, so a reject-only batch pays two [N] psums and
+    #     nothing else.
+    # ------------------------------------------------------------------
+    beh = rules.behavior[safe_slot].astype(jnp.int32)
+    is_warm = (beh == 1) | (beh == 3)
+    is_pace = (beh == 2) | (beh == 3)
+    warm_rows = active & is_warm
+    pace_try = active & is_pace
+    active_window = active & ~is_pace
+    any_warm = jnp.any(psum(warm_rows.astype(jnp.int32)) > 0)
+    any_pace = jnp.any(psum(pace_try.astype(jnp.int32)) > 0)
+
+    cnt = rules.count[safe_slot]
+    cnt_safe = jnp.maximum(cnt, 1e-6)
+
+    def warm_on(_):
+        # lazy once-per-second token sync (WarmUpController.syncToken):
+        # refill below the warning line (or above it while pass qps stays
+        # under count/coldFactor), clamp to maxToken, then drain one
+        # second's worth of passes. The reference syncs with the previous
+        # second's pass QPS; here the sliding-window pass rate stands in —
+        # the scalar port in tests/test_shaping.py mirrors exactly this.
+        # A NEVER fill stamp makes the first sync see a huge idle gap and
+        # clamp to maxToken: the cold state, for free.
+        pass_qps = passed * (1000.0 / spec.interval_ms)
+        cur_sec = now - now % 1000
+        filled = state.shaping.warm_filled[safe_slot]
+        tokens = state.shaping.warm_tokens[safe_slot]
+        warn = rules.warning_token[safe_slot]
+        can_refill = (tokens < warn) | (
+            (tokens > warn) & (pass_qps < rules.cold_count[safe_slot])
+        )
+        elapsed = (cur_sec - filled).astype(jnp.float32)
+        cooled = jnp.minimum(
+            tokens + jnp.where(can_refill, elapsed * cnt_safe / 1000.0, 0.0),
+            rules.max_token[safe_slot],
+        )
+        synced = jnp.maximum(cooled - pass_qps, 0.0)
+        do_sync = warm_rows & (cur_sec > filled)
+        tokens_new = jnp.where(do_sync, synced, tokens)
+        # above the warning line the system is still cold and the allowed
+        # rate follows the slope curve (WarmUpController.canPass)
+        above = jnp.maximum(tokens_new - warn, 0.0)
+        warning_qps = 1.0 / (above * rules.slope[safe_slot] + 1.0 / cnt_safe)
+        qps_ = jnp.where(warm_rows & (tokens_new >= warn), warning_qps, cnt)
+        # duplicate same-flow rows scatter identical values (pure function
+        # of state + now), so .set stays deterministic
+        scat = jnp.where(do_sync, safe_slot, f_local)
+        wt = state.shaping.warm_tokens.at[scat].set(tokens_new, mode="drop")
+        wf = state.shaping.warm_filled.at[scat].set(cur_sec, mode="drop")
+        return qps_, wt, wf
+
+    def warm_off(_):
+        return cnt, state.shaping.warm_tokens, state.shaping.warm_filled
+
+    qps, warm_tokens_ws, warm_filled_ws = jax.lax.cond(
+        any_warm, warm_on, warm_off, None
+    )
+
+    # rule count is per-second (ClusterMetric.getAvg divides by interval
+    # seconds before comparing); the window budget scales by interval length
+    rate_qps = qps * factor * config.exceed_count
+    threshold = rate_qps * (spec.interval_ms / 1000.0)
+
+    # ------------------------------------------------------------------
+    # 3. prefix-sum admission (odd refinement count ⇒ ⊆ sequential-exact)
+    # ------------------------------------------------------------------
     if config.prefix_impl == "grouped":
         # "grouped" is only sound when the host batcher sorted the batch —
         # that guarantee arrives via decide()'s grouped flag, never via
@@ -344,12 +413,12 @@ def _decide_core(
         # floor((threshold - passed)/a) active requests
         a = jnp.max(jnp.where(live, batch.acquire, 0)).astype(jnp.float32)
         a_safe = jnp.maximum(a, 1.0)
-        rank = flow_prefix(active.astype(jnp.float32))
-        admit = active & (passed + rank * a + a <= threshold)
+        rank = flow_prefix(active_window.astype(jnp.float32))
+        admit = active_window & (passed + rank * a + a <= threshold)
         quota = jnp.floor(jnp.maximum(threshold - passed, 0.0) / a_safe)
         admitted_prefix = jnp.minimum(rank, quota) * a
     else:
-        admit = active
+        admit = active_window
         iters = config.admission_refine_iters
         if iters % 2 == 0:
             raise ValueError(
@@ -360,8 +429,75 @@ def _decide_core(
         for _ in range(iters):
             contrib = jnp.where(admit, acquire_f, 0.0)
             prefix = flow_prefix(contrib)  # earlier admitted same-flow tokens
-            admit = active & (passed + prefix + acquire_f <= threshold)
+            admit = active_window & (passed + prefix + acquire_f <= threshold)
         admitted_prefix = flow_prefix(jnp.where(admit, acquire_f, 0.0))
+
+    # ------------------------------------------------------------------
+    # 3b. pacing (RateLimiterController.canPass as a batch closed form):
+    #     within one flow only the FIRST admitted row can pull
+    #     latestPassedTime up to now, so under the all-admit assumption
+    #     L_j = max(L0, now - cost_first) + inclusive-cost-prefix_j holds
+    #     exactly; a row rejects when its wait exceeds maxQueueingTimeMs.
+    #     With uniform costs the waits are monotone within a flow, rejects
+    #     form a suffix, and one pass is exact. Mixed-acquire batches
+    #     refine like the window-admission loop plus a final tightening
+    #     recompute — the accepted set stays a subset of the
+    #     sequential-exact one, so pacing can never over-admit. All the
+    #     arithmetic is done relative to `now` so f32 stays exact (engine
+    #     ms exceeds the f32 integer range after ~4.6h; waits never do).
+    # ------------------------------------------------------------------
+    def pace_on(_):
+        cost_f = jnp.round(1000.0 * acquire_f / jnp.maximum(rate_qps, 1e-6))
+        rel0 = jnp.maximum(
+            state.shaping.lpt[safe_slot] - now, jnp.int32(-(2**20))
+        ).astype(jnp.float32)
+        maxq = rules.max_queue_ms[safe_slot].astype(jnp.float32)
+
+        def pace_pass(accept):
+            contrib = jnp.where(accept, cost_f, 0.0)
+            # a row's own cost always counts toward its hypothetical
+            # schedule (contrib only carries it into LATER rows' prefixes) —
+            # otherwise a rejected row sheds its own cost and oscillates
+            # back into the accepted set on the next refinement pass
+            incl = flow_prefix(contrib) + cost_f
+            rank_p = flow_prefix(accept.astype(jnp.float32))
+            first = accept & (rank_p == 0.0)
+            scat_first = jnp.where(first, safe_slot, f_local)
+            c_first = jnp.zeros((f_local,), jnp.float32).at[scat_first].set(
+                cost_f, mode="drop"
+            )[safe_slot]
+            # L_row - now, directly: base_rel = max(L0 - now, -cost_first)
+            l_rel = jnp.maximum(rel0, -c_first) + incl
+            return l_rel
+
+        accept = pace_try
+        l_rel = pace_pass(accept)
+        for _i in range(0 if uniform else config.admission_refine_iters):
+            accept = pace_try & (l_rel <= maxq)
+            l_rel = pace_pass(accept)
+        accept = pace_try & (l_rel <= maxq)
+        wait_i = jnp.maximum(l_rel, 0.0).astype(jnp.int32)
+        # scatter-max: the last accepted row's schedule is the flow's new
+        # latestPassedTime; non-accepted rows leave it untouched
+        scat = jnp.where(accept, safe_slot, f_local)
+        lpt_ = state.shaping.lpt.at[scat].max(
+            now + jnp.round(l_rel).astype(jnp.int32), mode="drop"
+        )
+        return accept, wait_i, lpt_
+
+    def pace_off(_):
+        return (
+            jnp.zeros((N,), bool),
+            jnp.zeros((N,), jnp.int32),
+            state.shaping.lpt,
+        )
+
+    pace_admit, pace_wait, lpt_ws = jax.lax.cond(
+        any_pace, pace_on, pace_off, None
+    )
+    pace_now = pace_admit & (pace_wait == 0)
+    pace_later = pace_admit & (pace_wait > 0)
+    pace_reject = pace_try & ~pace_admit
 
     # ------------------------------------------------------------------
     # 4. priority occupy of the next window (ClusterFlowChecker.java:84-97)
@@ -370,10 +506,13 @@ def _decide_core(
     #    property of the replicated batch and therefore a mesh-uniform
     #    predicate (safe around the pmax inside add_future)
     # ------------------------------------------------------------------
-    blocked = active & ~admit
+    blocked = active_window & ~admit
     wait_next = spec.bucket_ms - (now % spec.bucket_ms)
     any_prio = jnp.any(batch.prioritized & batch.valid)
-    try_occupy = blocked & batch.prioritized
+    # occupy borrowing stays a DEFAULT-behavior feature: a shaped rule's
+    # admission curve is the whole point, and the reference's shapers have
+    # no occupy interplay either
+    try_occupy = blocked & batch.prioritized & (beh == 0)
 
     def occupy_check(_):
         next_start = now + wait_next
@@ -411,8 +550,12 @@ def _decide_core(
     #    contribute zeros (scatter targets stay in range, so no drops
     #    needed).
     # ------------------------------------------------------------------
-    admit_i = admit.astype(jnp.int32)
-    hard_i = hard_block.astype(jnp.int32)
+    # paced rows with wait 0 pass NOW and count as ordinary PASS traffic;
+    # paced rows with a wait charge the future window below (like occupy
+    # borrows — they fold into the PASS read when their window matures, so
+    # they are never double-counted); paced rejects count as BLOCK
+    admit_i = (admit | pace_now).astype(jnp.int32)
+    hard_i = (hard_block | pace_reject).astype(jnp.int32)
     ev = ClusterEvent
     row_updates = jnp.stack(
         [
@@ -445,16 +588,24 @@ def _decide_core(
     flow_ws = flow_ws._replace(counts=flow_counts)
     # pmax over the mesh axis keeps the replicated occupy.starts identical on
     # every device even when only the owner shard sees a borrow (each shard
-    # then also zeroes its own stale counts column for the reset slot)
+    # then also zeroes its own stale counts column for the reset slot).
+    # Paced SHOULD_WAIT admissions charge the same future-window tensor at
+    # their assigned wait — the cross-batch borrow that makes open-loop
+    # bursts unable to over-admit: the tokens are pre-paid into the window
+    # where the waiter is scheduled to pass.
+    charge_wait = jnp.where(
+        can_occupy, jnp.full((N,), wait_next, jnp.int32), pace_wait
+    )
+    charge_valid = can_occupy | pace_later
     occupy_ws = jax.lax.cond(
-        any_prio,
+        any_prio | any_pace,
         lambda occ: W.add_future(
             spec, occ, now,
-            wait_ms=jnp.full((N,), wait_next, jnp.int32),
+            wait_ms=charge_wait,
             resource_ids=safe_slot,
             channel_ids=jnp.zeros((N,), jnp.int32),
             values=batch.acquire,
-            valid=can_occupy,
+            valid=charge_valid,
             combine_desired=pmax,
         ),
         lambda occ: occ,
@@ -472,12 +623,14 @@ def _decide_core(
     # 6. verdicts — owner emits status+1, psum stitches shards together
     # ------------------------------------------------------------------
     local_status = jnp.where(
-        admit,
+        admit | pace_now,
         int(TokenStatus.OK) + 1,
         jnp.where(
-            can_occupy,
+            can_occupy | pace_later,
             int(TokenStatus.SHOULD_WAIT) + 1,
-            jnp.where(hard_block, int(TokenStatus.BLOCKED) + 1, 0),
+            jnp.where(
+                hard_block | pace_reject, int(TokenStatus.BLOCKED) + 1, 0
+            ),
         ),
     ).astype(jnp.int32)
     combined = psum(local_status)
@@ -495,16 +648,26 @@ def _decide_core(
         ),
     ).astype(jnp.int8)
 
-    wait_ms = psum(jnp.where(can_occupy, wait_next, 0).astype(jnp.int32))
+    wait_ms = psum(
+        jnp.where(
+            can_occupy, wait_next, jnp.where(pace_later, pace_wait, 0)
+        ).astype(jnp.int32)
+    )
     remaining_local = jnp.clip(
         threshold - passed - admitted_prefix - jnp.where(admit, acquire_f, 0.0),
         0.0,
         2**30,
     ).astype(jnp.int32)
-    # blockedResult() in the reference always carries remaining=0
+    # blockedResult() in the reference always carries remaining=0 — and so
+    # do paced admissions (RateLimiterController has no token count to report)
     remaining = psum(jnp.where(admit, remaining_local, 0))
 
-    new_state = EngineState(flow=flow_ws, occupy=occupy_ws, ns=ns_ws)
+    new_state = EngineState(
+        flow=flow_ws, occupy=occupy_ws, ns=ns_ws,
+        shaping=ShapingState(
+            lpt=lpt_ws, warm_tokens=warm_tokens_ws, warm_filled=warm_filled_ws
+        ),
+    )
     verdicts = VerdictBatch(status=status, wait_ms=wait_ms, remaining=remaining)
     return new_state, verdicts
 

@@ -30,6 +30,18 @@ class ThresholdMode(enum.IntEnum):
     GLOBAL = 1
 
 
+class ControlBehavior(enum.IntEnum):
+    # RuleConstant.CONTROL_BEHAVIOR_*: which TrafficShapingController serves
+    # the rule. DEFAULT rejects on threshold; WARM_UP admits along the
+    # stored-token slope curve (WarmUpController); RATE_LIMITER paces
+    # admissions and answers waitInMs (RateLimiterController); the combined
+    # mode paces at the warmup curve's current rate.
+    DEFAULT = 0
+    WARM_UP = 1
+    RATE_LIMITER = 2
+    WARM_UP_RATE_LIMITER = 3
+
+
 @dataclass(frozen=True)
 class ClusterFlowRule:
     """Host-side cluster rule (``FlowRule`` + ``ClusterFlowConfig`` subset).
@@ -37,16 +49,31 @@ class ClusterFlowRule:
     ``mode`` defaults to AVG_LOCAL like the reference's
     ``ClusterFlowConfig.thresholdType`` — a rule set ported from Sentinel with
     the field omitted keeps its count × connected-clients semantics.
+
+    The shaping fields mirror ``FlowRule``'s traffic-shaping knobs and only
+    matter when ``control_behavior`` is non-DEFAULT; defaults match the
+    reference (``RuleConstant``: 10s warmup, cold factor 3, 500ms max queue).
     """
 
     flow_id: int
     count: float
     mode: ThresholdMode = ThresholdMode.AVG_LOCAL
     namespace: str = "default"
+    control_behavior: int = 0
+    warm_up_period_sec: int = 10
+    cold_factor: int = 3
+    max_queueing_time_ms: int = 500
 
 
 class RuleTable(NamedTuple):
-    """Device tensors, all shaped ``[max_flows]`` (+ ``[max_namespaces]``)."""
+    """Device tensors, all shaped ``[max_flows]`` (+ ``[max_namespaces]``).
+
+    The shaping columns are precomputed host-side from the rule's warmup
+    knobs (the reference computes them once in ``WarmUpController``'s
+    constructor, ``WarmUpController.java:94-117``) so the kernel's per-row
+    work is pure gathers + elementwise math. Rows with ``behavior == 0``
+    carry zeros — the ``jnp.where`` branch selection never reads them.
+    """
 
     valid: jax.Array  # bool — slot holds an active rule
     count: jax.Array  # float32 — rule threshold (per-client for AVG_LOCAL)
@@ -54,6 +81,12 @@ class RuleTable(NamedTuple):
     namespace_id: jax.Array  # int32
     ns_max_qps: jax.Array  # float32 [NS] — GlobalRequestLimiter threshold
     ns_connected: jax.Array  # int32 [NS] — connected client count (AVG_LOCAL)
+    behavior: jax.Array  # int8 — ControlBehavior
+    warning_token: jax.Array  # float32 — warmup warning line (stored tokens)
+    max_token: jax.Array  # float32 — warmup bucket capacity
+    slope: jax.Array  # float32 — warmup admission slope above the line
+    cold_count: jax.Array  # float32 — floor(count / cold_factor) refill gate
+    max_queue_ms: jax.Array  # int32 — pacing queue bound (ring-clamped)
 
 
 class RuleIndex:
@@ -133,6 +166,17 @@ def build_rule_table(
     namespace_id = np.zeros(config.max_flows, dtype=np.int32)
     ns_max = np.full(config.max_namespaces, float(ns_max_qps), dtype=np.float32)
     ns_conn = np.ones(config.max_namespaces, dtype=np.int32)
+    behavior = np.zeros(config.max_flows, dtype=np.int8)
+    warning_token = np.zeros(config.max_flows, dtype=np.float32)
+    max_token = np.zeros(config.max_flows, dtype=np.float32)
+    slope = np.zeros(config.max_flows, dtype=np.float32)
+    cold_count = np.zeros(config.max_flows, dtype=np.float32)
+    max_queue_ms = np.zeros(config.max_flows, dtype=np.int32)
+    # add_future can park a borrow at most n_buckets-1 windows ahead, so a
+    # pacing queue longer than that would assign waits the cross-batch
+    # charge cannot cover — clamp at build time and let docs/SHAPING.md
+    # carry the math
+    queue_cap_ms = (config.n_buckets - 1) * config.bucket_ms
     for rule in rules:
         slot = index.assign(rule.flow_id)
         ns = index.namespace_slot(rule.namespace)
@@ -140,6 +184,24 @@ def build_rule_table(
         count[slot] = rule.count
         mode[slot] = int(rule.mode)
         namespace_id[slot] = ns
+        beh = int(rule.control_behavior)
+        behavior[slot] = beh
+        if beh in (int(ControlBehavior.WARM_UP),
+                   int(ControlBehavior.WARM_UP_RATE_LIMITER)):
+            # WarmUpController.construct(): warningToken, maxToken, slope
+            c = max(float(rule.count), 1e-6)
+            cold = max(2, int(rule.cold_factor))
+            period = max(1, int(rule.warm_up_period_sec))
+            warn = int(period * c / (cold - 1))
+            warning_token[slot] = warn
+            max_token[slot] = int(warn + 2.0 * period * c / (1.0 + cold))
+            slope[slot] = (cold - 1.0) / c / max(1, max_token[slot] - warn)
+            cold_count[slot] = int(c) // cold
+        if beh in (int(ControlBehavior.RATE_LIMITER),
+                   int(ControlBehavior.WARM_UP_RATE_LIMITER)):
+            max_queue_ms[slot] = min(
+                int(rule.max_queueing_time_ms), queue_cap_ms
+            )
     for ns_name, n in (connected or {}).items():
         ns_conn[index.namespace_slot(ns_name)] = max(1, int(n))
     table = RuleTable(
@@ -149,8 +211,47 @@ def build_rule_table(
         namespace_id=jnp.asarray(namespace_id),
         ns_max_qps=jnp.asarray(ns_max),
         ns_connected=jnp.asarray(ns_conn),
+        behavior=jnp.asarray(behavior),
+        warning_token=jnp.asarray(warning_token),
+        max_token=jnp.asarray(max_token),
+        slope=jnp.asarray(slope),
+        cold_count=jnp.asarray(cold_count),
+        max_queue_ms=jnp.asarray(max_queue_ms),
     )
     return table, index
+
+
+def encode_rule(rule: ClusterFlowRule) -> dict:
+    """The wire/blob dict shape shared by snapshots and MOVE blobs. Shaping
+    keys are emitted only when non-default, so pre-shaping payloads stay
+    byte-identical for plain rules (and old decoders keep working)."""
+    d = {
+        "flow_id": int(rule.flow_id),
+        "count": float(rule.count),
+        "mode": int(rule.mode),
+        "namespace": rule.namespace,
+    }
+    if int(rule.control_behavior) != 0:
+        d["behavior"] = int(rule.control_behavior)
+        d["warmupSec"] = int(rule.warm_up_period_sec)
+        d["coldFactor"] = int(rule.cold_factor)
+        d["maxQueueMs"] = int(rule.max_queueing_time_ms)
+    return d
+
+
+def decode_rule(d: dict) -> ClusterFlowRule:
+    """Inverse of :func:`encode_rule`; tolerant of payloads written before
+    the shaping fields existed."""
+    return ClusterFlowRule(
+        flow_id=int(d["flow_id"]),
+        count=float(d["count"]),
+        mode=ThresholdMode(int(d["mode"])),
+        namespace=str(d["namespace"]),
+        control_behavior=int(d.get("behavior", 0)),
+        warm_up_period_sec=int(d.get("warmupSec", 10)),
+        cold_factor=int(d.get("coldFactor", 3)),
+        max_queueing_time_ms=int(d.get("maxQueueMs", 500)),
+    )
 
 
 def drain_pending_clear(index: RuleIndex, state) -> "object":
@@ -165,11 +266,22 @@ def drain_pending_clear(index: RuleIndex, state) -> "object":
     from sentinel_tpu.engine.state import EngineState
     from sentinel_tpu.stats.window import WindowState
 
+    from sentinel_tpu.stats.window import NEVER
+
     idx = _jnp.asarray(np.asarray(slots, dtype=np.int32))
     flow_counts = state.flow.counts.at[idx].set(0)
     occupy_counts = state.occupy.counts.at[idx].set(0)
+    shaping = state.shaping
+    # a freed slot also holds the removed flow's shaper clock — a reused
+    # slot must start cold (pacing unset, warmup bucket full on first sync)
+    shaping = shaping._replace(
+        lpt=shaping.lpt.at[idx].set(NEVER),
+        warm_tokens=shaping.warm_tokens.at[idx].set(0.0),
+        warm_filled=shaping.warm_filled.at[idx].set(NEVER),
+    )
     return EngineState(
         flow=WindowState(starts=state.flow.starts, counts=flow_counts),
         occupy=WindowState(starts=state.occupy.starts, counts=occupy_counts),
         ns=state.ns,
+        shaping=shaping,
     )

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from sentinel_tpu.engine.config import EngineConfig
-from sentinel_tpu.stats.window import WindowSpec, WindowState, make_window
+from sentinel_tpu.stats.window import NEVER, WindowSpec, WindowState, make_window
 
 
 class ClusterEvent(enum.IntEnum):
@@ -46,14 +46,36 @@ class ClusterEvent(enum.IntEnum):
 N_CLUSTER_EVENTS = len(ClusterEvent)
 
 
+class ShapingState(NamedTuple):
+    """Per-flow traffic-shaper clocks (the mutable halves of the reference's
+    ``RateLimiterController.latestPassedTime`` and ``WarmUpController``'s
+    ``storedTokens``/``lastFilledTime`` atomics, flattened to ``[max_flows]``
+    columns). ``NEVER`` marks a slot whose shaper has not run yet: pacing
+    starts unconstrained, warmup's first lazy sync sees a huge idle gap and
+    fills the bucket to ``max_token`` — the cold state."""
+
+    lpt: jax.Array  # int32 [F] — latest passed time (pacing), engine ms
+    warm_tokens: jax.Array  # float32 [F] — warmup stored tokens
+    warm_filled: jax.Array  # int32 [F] — last warmup sync second, engine ms
+
+
 class EngineState(NamedTuple):
     flow: WindowState  # [F, B, E] current windows
     occupy: WindowState  # [F, B, 1] future (borrowed) windows
     ns: WindowState  # [NS, B, 1] namespace request qps guard
+    shaping: ShapingState  # [F] per-flow shaper clocks
 
 
 def flow_spec(config: EngineConfig) -> WindowSpec:
     return WindowSpec(bucket_ms=config.bucket_ms, n_buckets=config.n_buckets)
+
+
+def make_shaping(n_flows: int) -> ShapingState:
+    return ShapingState(
+        lpt=jnp.full((n_flows,), NEVER, dtype=jnp.int32),
+        warm_tokens=jnp.zeros((n_flows,), dtype=jnp.float32),
+        warm_filled=jnp.full((n_flows,), NEVER, dtype=jnp.int32),
+    )
 
 
 def make_state(config: EngineConfig) -> EngineState:
@@ -62,4 +84,5 @@ def make_state(config: EngineConfig) -> EngineState:
         flow=make_window(spec, config.max_flows, N_CLUSTER_EVENTS),
         occupy=make_window(spec, config.max_flows, 1),
         ns=make_window(spec, config.max_namespaces, 1),
+        shaping=make_shaping(config.max_flows),
     )

@@ -79,6 +79,8 @@ _ARRAY_KEYS = frozenset(
         "flow_starts", "occupy_starts", "ns_starts", "param_starts",
         "flow_counts", "occupy_counts", "ns_counts", "param_counts",
         "param_slim",  # SF slim-twin rows: the param payload when slim is on
+        # shaper clocks (raw engine-ms, same dirty-row keying as flow_counts)
+        "shaping_lpt", "shaping_warm_tokens", "shaping_warm_filled",
     }
 )
 

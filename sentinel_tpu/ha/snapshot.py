@@ -40,8 +40,7 @@ from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.config import SentinelConfig
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
-from sentinel_tpu.engine import ClusterFlowRule
-from sentinel_tpu.engine.rules import ThresholdMode
+from sentinel_tpu.engine.rules import decode_rule, encode_rule
 from sentinel_tpu.metrics.ha import ha_metrics
 
 SNAPSHOT_VERSION = 1
@@ -90,15 +89,7 @@ def encode_snapshot(state: Dict[str, object]) -> Dict[str, object]:
         "ns_max_qps": state["ns_max_qps"],
         "connected": state["connected"],
         "namespace_set": state["namespace_set"],
-        "rules": [
-            {
-                "flow_id": r.flow_id,
-                "count": r.count,
-                "mode": int(r.mode),
-                "namespace": r.namespace,
-            }
-            for r in state["rules"]
-        ],
+        "rules": [encode_rule(r) for r in state["rules"]],
         "param_rules": [
             {
                 "flow_id": r.flow_id,
@@ -119,6 +110,12 @@ def encode_snapshot(state: Dict[str, object]) -> Dict[str, object]:
         "occupy": _enc_win(state["occupy"]),
         "ns": _enc_win(state["ns"]),
         "param": _enc_win(state["param"]),
+        # per-flow shaper clocks (absent in pre-shaping snapshots; the
+        # importer then starts those slots cold)
+        **(
+            {"shaping": _enc_win(state["shaping"])}
+            if "shaping" in state else {}
+        ),
         # hierarchy-coordinator ledger piggyback (already JSON-safe; absent
         # when no coordinator is co-located with this pod)
         **({"hier": state["hier"]} if "hier" in state else {}),
@@ -141,13 +138,7 @@ def decode_snapshot(doc: Dict[str, object]) -> Dict[str, object]:
         "ns_max_qps": float(doc["ns_max_qps"]),
         "connected": {str(k): int(v) for k, v in doc["connected"].items()},
         "namespace_set": list(doc["namespace_set"]),
-        "rules": [
-            ClusterFlowRule(
-                int(r["flow_id"]), float(r["count"]),
-                ThresholdMode(int(r["mode"])), str(r["namespace"]),
-            )
-            for r in doc["rules"]
-        ],
+        "rules": [decode_rule(r) for r in doc["rules"]],
         "param_rules": [
             ClusterParamFlowRule(
                 int(r["flow_id"]), float(r["count"]),
@@ -166,6 +157,10 @@ def decode_snapshot(doc: Dict[str, object]) -> Dict[str, object]:
         "occupy": _dec_win(doc["occupy"]),
         "ns": _dec_win(doc["ns"]),
         "param": _dec_win(doc["param"]),
+        **(
+            {"shaping": _dec_win(doc["shaping"])}
+            if "shaping" in doc else {}
+        ),
         **({"hier": doc["hier"]} if "hier" in doc else {}),
     }
 

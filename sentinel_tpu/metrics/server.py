@@ -117,6 +117,11 @@ class ServerMetrics:
         )
         self._fused_frames = 0
         self._fused_lock = threading.Lock()
+        # traffic-shaping waits: every SHOULD_WAIT verdict that carried a
+        # positive wait hint (paced admission or priority occupy) — count
+        # plus the distribution of assigned waits (whole ms, ≥ 1)
+        self.wait_assigned_ms = LatencyHistogram(lo=1.0, hi=60_000.0)
+        self._wait_assigned = 0
         self._verdicts: Dict[Tuple[str, str], int] = {}
         self._verdict_lock = threading.Lock()
         self._rate = _RateWindow()
@@ -170,6 +175,11 @@ class ServerMetrics:
     def fused_frames_total(self) -> int:
         with self._fused_lock:
             return self._fused_frames
+
+    @property
+    def wait_assigned_total(self) -> int:
+        with self._verdict_lock:
+            return self._wait_assigned
 
     # -- intake shard + host-copy counters ----------------------------------
     def count_shard_pull(
@@ -245,6 +255,7 @@ class ServerMetrics:
         ns_idx: Optional[np.ndarray],
         ns_names: Tuple[str, ...],
         latency_ms: Optional[float] = None,
+        wait_ms: Optional[np.ndarray] = None,
     ) -> None:
         """Count one materialized batch: ``status`` int8[N] TokenStatus
         codes, ``ns_idx`` int32[N] namespace row per request (-1 → no rule;
@@ -253,12 +264,24 @@ class ServerMetrics:
 
         ``latency_ms`` (decision latency shared by the whole batch) feeds
         the per-tenant SLO plane; refusal statuses are attributed there as
-        sheds either way."""
+        sheds either way. ``wait_ms`` int32[N] (the verdicts' wait hints)
+        feeds the assigned-wait counter/histogram — only positive hints
+        count, and only SHOULD_WAIT verdicts carry them."""
         status = np.asarray(status)
         n = int(status.shape[0])
         if n == 0:
             return
         self._rate.add(n)
+        if wait_ms is not None:
+            w = np.asarray(wait_ms)
+            wmask = w > 0
+            n_wait = int(wmask.sum())
+            if n_wait:
+                with self._verdict_lock:
+                    self._wait_assigned += n_wait
+                # batches repeat few distinct waits; record value-grouped
+                for v, c in zip(*np.unique(w[wmask], return_counts=True)):
+                    self.wait_assigned_ms.record(float(v), int(c))
         updates: Dict[Tuple[str, str], int] = {}
         for code, vname in VERDICT_NAMES.items():
             mask = status == code
@@ -302,7 +325,7 @@ class ServerMetrics:
         plane = slo_plane()
         tl = timeline()
         served: Dict[str, int] = {}
-        # timeline columns per namespace: [pass, block, other]
+        # timeline columns per namespace: [pass, block, other, waited]
         cols: Dict[str, List[int]] = {}
         for (vname, ns), v in updates.items():
             reason = self._SLO_SHED_REASONS.get(vname)
@@ -310,16 +333,22 @@ class ServerMetrics:
                 plane.record_shed(ns, reason, v)
                 continue
             served[ns] = served.get(ns, 0) + v
-            c = cols.setdefault(ns, [0, 0, 0])
+            c = cols.setdefault(ns, [0, 0, 0, 0])
             if vname == "pass":
                 c[0] += v
             elif vname == "block":
                 c[1] += v
+            elif vname == "should_wait":
+                # delayed admission (pacing / priority occupy): served, but
+                # attributed in its own column so a paced tenant's wall
+                # shows shaping, not mystery "other" traffic
+                c[3] += v
+                plane.record_waited(ns, v)
             else:
                 c[2] += v
         for ns, c in cols.items():
             tl.record(ns, n_pass=c[0], n_block=c[1], n_other=c[2],
-                      latency_ms=latency_ms)
+                      latency_ms=latency_ms, n_waited=c[3])
         if latency_ms is not None:
             for ns, v in served.items():
                 plane.record(ns, latency_ms, v)
@@ -471,7 +500,9 @@ class ServerMetrics:
                 "intake_ms": self.intake_ms.snapshot(),
                 "dispatch_ms": self.dispatch_ms.snapshot(),
                 "fused_depth": self.fused_depth.snapshot(),
+                "wait_assigned_ms": self.wait_assigned_ms.snapshot(),
             },
+            "waitAssignedTotal": self.wait_assigned_total,
             "gauges": self._gauge_values(),
         }
 
@@ -486,6 +517,7 @@ class ServerMetrics:
             ("intake_ms", self.intake_ms),
             ("dispatch_ms", self.dispatch_ms),
             ("fused_depth", self.fused_depth),
+            ("wait_assigned_ms", self.wait_assigned_ms),
         ):
             snap = hist.snapshot()
             out[name] = {
@@ -724,8 +756,20 @@ class ServerMetrics:
             ("sentinel_server_fused_depth",
              "Engine-batch frames per fused device dispatch.",
              self.fused_depth),
+            ("sentinel_server_wait_assigned_ms",
+             "Wait assigned per SHOULD_WAIT verdict: paced admission or "
+             "priority occupy delay (ms).",
+             self.wait_assigned_ms),
         ):
             lines.append(hist.render_prometheus(name, help_text))
+        lines.append(
+            "# HELP sentinel_server_wait_assigned_total SHOULD_WAIT "
+            "verdicts that carried a positive wait hint (cumulative)."
+        )
+        lines.append("# TYPE sentinel_server_wait_assigned_total counter")
+        lines.append(
+            f"sentinel_server_wait_assigned_total {self.wait_assigned_total}"
+        )
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -739,10 +783,12 @@ class ServerMetrics:
         self.intake_ms.reset()
         self.dispatch_ms.reset()
         self.fused_depth.reset()
+        self.wait_assigned_ms.reset()
         with self._fused_lock:
             self._fused_frames = 0
         with self._verdict_lock:
             self._verdicts.clear()
+            self._wait_assigned = 0
         with self._shed_lock:
             self._shed.clear()
         with self._shard_lock:

@@ -62,15 +62,18 @@ class TimelineSample:
     other: int = 0
     p99_ms: Optional[float] = None
     max_ms: Optional[float] = None
+    waited: int = 0  # SHOULD_WAIT: delayed admissions (pacing / occupy)
 
     def to_line(self) -> str:
         ts = self.timestamp_ms // 1000 * 1000
         ns = self.namespace.replace("|", "_")
         p99 = -1.0 if self.p99_ms is None else self.p99_ms
         mx = -1.0 if self.max_ms is None else self.max_ms
+        # waited rides as a 9th field so pre-shaping readers (8-field
+        # parsers) keep working on new files
         return (
             f"{ts}|{ns}|{self.passed}|{self.blocked}|{self.shed}|"
-            f"{self.other}|{p99:g}|{mx:g}"
+            f"{self.other}|{p99:g}|{mx:g}|{self.waited}"
         )
 
     @classmethod
@@ -87,6 +90,7 @@ class TimelineSample:
             other=int(p[5]),
             p99_ms=None if p99 < 0 else p99,
             max_ms=None if mx < 0 else mx,
+            waited=int(p[8]) if len(p) > 8 else 0,
         )
 
     def as_dict(self) -> dict:
@@ -97,6 +101,7 @@ class TimelineSample:
             "block": self.blocked,
             "shed": self.shed,
             "other": self.other,
+            "waited": self.waited,
             "p99Ms": self.p99_ms,
             "maxMs": self.max_ms,
         }
@@ -112,8 +117,8 @@ class _NsRing:
     def __init__(self, window_s: int):
         self.window_s = window_s
         self.stamp = np.zeros(window_s, np.int64)
-        # columns: pass, block, shed, other
-        self.counts = np.zeros((window_s, 4), np.int64)
+        # columns: pass, block, shed, other, waited
+        self.counts = np.zeros((window_s, 5), np.int64)
         self.lat = np.zeros((window_s, _N_LAT + 1), np.int64)
         self.lat_max = np.zeros(window_s, np.float64)
 
@@ -147,6 +152,7 @@ class _NsRing:
             other=int(c[3]),
             p99_ms=p99,
             max_ms=mx,
+            waited=int(c[4]),
         )
 
 
@@ -172,13 +178,17 @@ class MetricTimeline:
                n_shed: int = 0, n_other: int = 0,
                latency_ms: Optional[float] = None,
                lat_n: Optional[int] = None,
-               now_s: Optional[int] = None) -> None:
+               now_s: Optional[int] = None,
+               n_waited: int = 0) -> None:
         """Fold one verdict-batch contribution for ``namespace`` into the
         current second. ``latency_ms`` is the batch's shared decision
         latency, applied to ``lat_n`` rows (default: the served rows of
-        this call — pass + block + other; sheds never reached a device
-        step so they carry no latency)."""
-        if n_pass <= 0 and n_block <= 0 and n_shed <= 0 and n_other <= 0:
+        this call — pass + block + other + waited; sheds never reached a
+        device step so they carry no latency). ``n_waited`` counts
+        SHOULD_WAIT verdicts — served-with-delay (pacing / priority
+        occupy), their own column so shaping is visible per second."""
+        if (n_pass <= 0 and n_block <= 0 and n_shed <= 0 and n_other <= 0
+                and n_waited <= 0):
             return
         sec = int(now_s if now_s is not None else time.time())
         with self._lock:
@@ -191,9 +201,11 @@ class MetricTimeline:
             c[1] += max(0, n_block)
             c[2] += max(0, n_shed)
             c[3] += max(0, n_other)
+            c[4] += max(0, n_waited)
             if latency_ms is not None:
                 if lat_n is None:
-                    lat_n = max(0, n_pass) + max(0, n_block) + max(0, n_other)
+                    lat_n = (max(0, n_pass) + max(0, n_block)
+                             + max(0, n_other) + max(0, n_waited))
                 if lat_n > 0:
                     k = int(np.searchsorted(_EDGES, latency_ms))
                     ring.lat[i, k] += lat_n

@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sentinel_tpu.engine.config import EngineConfig
 from sentinel_tpu.engine.decide import RequestBatch, VerdictBatch, _decide_core
 from sentinel_tpu.engine.rules import RuleTable
-from sentinel_tpu.engine.state import EngineState
+from sentinel_tpu.engine.state import EngineState, ShapingState
 from sentinel_tpu.stats.window import WindowState
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
@@ -63,6 +63,9 @@ def _state_specs(axis: str) -> EngineState:
         flow=WindowState(starts=P(), counts=P(axis)),
         occupy=WindowState(starts=P(), counts=P(axis)),
         ns=WindowState(starts=P(), counts=P()),
+        shaping=ShapingState(
+            lpt=P(axis), warm_tokens=P(axis), warm_filled=P(axis)
+        ),
     )
 
 
@@ -74,6 +77,12 @@ def _rules_specs(axis: str) -> RuleTable:
         namespace_id=P(axis),
         ns_max_qps=P(),
         ns_connected=P(),
+        behavior=P(axis),
+        warning_token=P(axis),
+        max_token=P(axis),
+        slope=P(axis),
+        cold_count=P(axis),
+        max_queue_ms=P(axis),
     )
 
 

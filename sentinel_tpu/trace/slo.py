@@ -72,7 +72,7 @@ class _BurnWindow:
 
 
 class _Tenant:
-    __slots__ = ("hist", "windows", "shed")
+    __slots__ = ("hist", "windows", "shed", "waited")
 
     def __init__(self):
         # decision latency in ms; log buckets fine enough to resolve a
@@ -80,6 +80,10 @@ class _Tenant:
         self.hist = LatencyHistogram(lo=0.01, hi=10_000.0, per_decade=5)
         self.windows = {name: _BurnWindow(s) for name, s in _WINDOWS}
         self.shed: Dict[str, int] = {}
+        # SHOULD_WAIT verdicts: served-with-delay (pacing / priority
+        # occupy) — counted separately from sheds because the request WAS
+        # admitted; a paced tenant is shaped, not failing
+        self.waited = 0
 
 
 class SloPlane:
@@ -116,6 +120,17 @@ class SloPlane:
         over = n if latency_ms > self.objective_ms else 0
         for w in t.windows.values():
             w.record(n, over, now_s)
+
+    def record_waited(self, namespace: str, n: int = 1) -> None:
+        """n rows admitted with an assigned wait (SHOULD_WAIT). Latency /
+        burn accounting already happened via :meth:`record` — this only
+        keeps the per-tenant attribution the stats command and exporter
+        surface as ``sentinel_slo_waited_total``."""
+        if n <= 0:
+            return
+        t = self._tenant(namespace)
+        with self._lock:
+            t.waited += n
 
     def record_shed(self, namespace: str, reason: str, n: int = 1) -> None:
         """n rows refused for this tenant (OVERLOAD verdicts, brownout
@@ -192,6 +207,7 @@ class SloPlane:
                 "burnRate": rates,
                 "windows": windows,
                 "shed": dict(t.shed),
+                "waited": int(t.waited),
             }
         return {"objectiveMs": self.objective_ms, "tenants": tenants}
 
@@ -214,6 +230,7 @@ class SloPlane:
             ))
         burn_lines: List[str] = []
         shed_lines: List[str] = []
+        waited_lines: List[str] = []
         for ns in names:
             t = self._tenants[ns]
             for name, _s in _WINDOWS:
@@ -229,6 +246,11 @@ class SloPlane:
                     f'sentinel_slo_shed_total{{namespace="{_escape(ns)}"'
                     f',reason="{reason}"}} {n}'
                 )
+            if t.waited:
+                waited_lines.append(
+                    f'sentinel_slo_waited_total{{namespace="{_escape(ns)}"'
+                    f'}} {t.waited}'
+                )
         if burn_lines:
             lines.append(
                 "# HELP sentinel_slo_burn_rate Error-budget burn vs the "
@@ -243,6 +265,13 @@ class SloPlane:
             )
             lines.append("# TYPE sentinel_slo_shed_total counter")
             lines.extend(shed_lines)
+        if waited_lines:
+            lines.append(
+                "# HELP sentinel_slo_waited_total SHOULD_WAIT verdicts "
+                "(delayed admission: pacing / priority occupy) per tenant."
+            )
+            lines.append("# TYPE sentinel_slo_waited_total counter")
+            lines.extend(waited_lines)
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -276,9 +305,10 @@ def merge_fleet(snapshots: Iterable[dict]) -> dict:
                 agg = tenants.setdefault(ns, {
                     "count": 0, "p99Ms": None, "windows": {
                         name: {"total": 0, "over": 0} for name, _s in _WINDOWS
-                    }, "shed": {},
+                    }, "shed": {}, "waited": 0,
                 })
                 agg["count"] += int(t.get("count", 0))
+                agg["waited"] += int(t.get("waited", 0))
                 p99 = t.get("p99Ms")
                 if p99 is not None and (
                     agg["p99Ms"] is None or p99 > agg["p99Ms"]

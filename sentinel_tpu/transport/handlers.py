@@ -824,12 +824,19 @@ def _embedded_service():
 
 
 def _flow_rule_to_dict(rule) -> dict:
-    return {
+    d = {
         "flowId": rule.flow_id,
         "count": rule.count,
         "thresholdType": int(rule.mode),
         "namespace": rule.namespace,
     }
+    if int(getattr(rule, "control_behavior", 0)) != 0:
+        # FlowRule's traffic-shaping knobs, dashboard field names
+        d["controlBehavior"] = int(rule.control_behavior)
+        d["warmUpPeriodSec"] = int(rule.warm_up_period_sec)
+        d["coldFactor"] = int(rule.cold_factor)
+        d["maxQueueingTimeMs"] = int(rule.max_queueing_time_ms)
+    return d
 
 
 def _flow_rule_from_dict(d: dict, namespace: str):
@@ -841,6 +848,10 @@ def _flow_rule_from_dict(d: dict, namespace: str):
         count=float(d["count"]),
         mode=ThresholdMode(int(d.get("thresholdType", 0))),
         namespace=namespace,
+        control_behavior=int(d.get("controlBehavior", 0)),
+        warm_up_period_sec=int(d.get("warmUpPeriodSec", 10)),
+        cold_factor=int(d.get("coldFactor", 3)),
+        max_queueing_time_ms=int(d.get("maxQueueingTimeMs", 500)),
     )
 
 

@@ -162,6 +162,53 @@ class TestDocCrossLinks:
         assert needle in _doc_text()
 
 
+class TestShapingDocSync:
+    """docs/SHAPING.md ↔ kernel sync: the doc carries the queue-cap math
+    (rules.py defers to it) and names the verification surface."""
+
+    def _text(self):
+        with open(os.path.join(REPO, "docs", "SHAPING.md")) as f:
+            return f.read()
+
+    def test_readme_links_the_doc(self):
+        with open(os.path.join(REPO, "README.md")) as f:
+            readme = f.read()
+        assert "docs/SHAPING.md" in readme
+        assert "shaping_drill.py" in readme
+
+    @pytest.mark.parametrize("needle", [
+        # the clamp rules.py promises the doc carries
+        "(n_buckets - 1) * bucket_ms",
+        # the columns and clocks
+        "warning_token",
+        "max_queue_ms",
+        "latestPassedTime",
+        # the cross-batch charge and its mechanism
+        "add_future",
+        # client + lease surfaces
+        "wait_and_admit",
+        "NOT_LEASABLE",
+        # HA: the relative MOVE keys and the replication keys
+        "shaping_lpt_rel",
+        "shaping_lpt",
+        # verification surface
+        "sentinel-shaping-drill/1",
+        "tests/test_shaping.py",
+        "benchmarks/shaping_drill.py",
+    ])
+    def test_doc_names_the_surface(self, needle):
+        assert needle in self._text()
+
+    def test_doc_queue_cap_matches_the_kernel(self):
+        """The 900ms default-cap number in the doc is derived from config
+        defaults — keep them in sync."""
+        from sentinel_tpu.engine import EngineConfig
+
+        cfg = EngineConfig()
+        cap = (cfg.n_buckets - 1) * cfg.bucket_ms
+        assert f"**{cap} ms**" in self._text()
+
+
 class TestScenarioDocSync:
     """docs/SCENARIOS.md ↔ harness sync: the doc names the gates and the
     schema the artifact actually carries."""

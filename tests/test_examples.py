@@ -26,6 +26,8 @@ FAST_EXAMPLES = [
     "asgi_app_demo.py",
     "multi_pod_demo.py",
     "mesh_sharded_server.py",
+    "warmup_demo.py",
+    "pacing_demo.py",
 ]
 
 
@@ -41,6 +43,16 @@ def test_example_runs(script):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout.strip(), "example produced no output"
+
+
+def test_pacing_demo_spreads_the_burst():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "pacing_demo.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+    ).stdout
+    assert "SHOULD_WAIT" in out
+    assert "zero rejects" in out
 
 
 def test_namespace_partition_demo_shows_movement():

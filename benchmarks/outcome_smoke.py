@@ -1,0 +1,294 @@
+"""Outcome-feedback smoke for CI: seeded completion workload + the exact
+reconciliation gate.
+
+Two halves, mirroring ``trace-smoke``:
+
+- **Overhead half** (``--overhead-gate FRAC``): re-runs the closed-loop
+  serve smoke in its shipped state — outcome plane compiled in, no client
+  reporting — and gates served verdicts/s against the committed
+  serve-smoke floor at FRAC tolerance (CI uses 0.02). The lease/request
+  fast path pays exactly one branch (``if piggyback and buffer:``) for
+  the piggy-backed wire op, and that must stay invisible.
+
+- **Reconciliation half** (default): drives a real ``TokenServer`` door
+  with admissions, then reports seeded completions from the two
+  ``benchmarks/workload.py`` outcome profiles — *slow-dependency* (RT
+  triples over the run, success holds) on one namespace, *error-storm*
+  (40% exceptions over the middle third, flat RT) on the other, plus
+  deliberately malformed rows — over the piggy-backed ``OUTCOME_REPORT``
+  path. Gates, exactly (no tolerances):
+
+  * client ``sent`` == server accepted + dropped, and dropped == the
+    malformed rows injected;
+  * accepted == the device outcome columns' totals == the per-namespace
+    timeline ``completed`` sums == the ``sentinel_outcome_reported_total``
+    Prometheus counter (same for exceptions);
+  * the columns survive a snapshot/restore round trip and a MOVE
+    namespace export/import bit-exactly;
+  * the profiles are visible: the slow-dependency flow's windowed
+    ``rt_avg_ms`` exceeds its cold baseline, the error-storm namespace's
+    exception count is where the storm put it.
+
+Everything is deterministic under the fixed seed, which is what lets CI
+gate on equalities instead of distributions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SEED = 20260806
+SCHEMA = "sentinel-outcome-smoke/1"
+
+
+def run_reconciliation(steps: int = 30, rows_per_step: int = 64) -> dict:
+    import numpy as np
+
+    from benchmarks.workload import error_storm_profile, slow_dependency_profile
+    from sentinel_tpu.cluster.client import TokenClient
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import (
+        ClusterFlowRule,
+        DefaultTokenService,
+    )
+    from sentinel_tpu.engine.config import EngineConfig
+    from sentinel_tpu.engine.state import OutcomeChannel
+    from sentinel_tpu.ha import replication as R
+    from sentinel_tpu.metrics.server import server_metrics
+    from sentinel_tpu.metrics.timeline import reset_timeline_for_tests, timeline
+
+    # window reach must cover the whole run so the windowed device columns
+    # still hold every accepted outcome at reconcile time (2-minute reach)
+    cfg = EngineConfig(max_flows=64, bucket_ms=1000, n_buckets=120)
+    rules = (
+        [ClusterFlowRule(flow_id=f, namespace="ns-slow", count=1e9)
+         for f in range(1, 5)]
+        + [ClusterFlowRule(flow_id=f, namespace="ns-storm", count=1e9)
+           for f in range(101, 105)]
+    )
+    ns_of = {f: ("ns-slow" if f < 100 else "ns-storm")
+             for f in list(range(1, 5)) + list(range(101, 105))}
+
+    server_metrics().reset()
+    reset_timeline_for_tests()
+    svc = DefaultTokenService(cfg)
+    svc.load_rules(rules)
+    server = TokenServer(svc, port=0)
+    server.start()
+    client = TokenClient("127.0.0.1", server.port)
+
+    slow = slow_dependency_profile(invalid_p=0.05)
+    storm = error_storm_profile(invalid_p=0.05)
+    rng = np.random.default_rng(SEED)
+    expect = {"sent": 0, "invalid": 0,
+              "exceptions": {"ns-slow": 0, "ns-storm": 0},
+              "accepted": {"ns-slow": 0, "ns-storm": 0},
+              "rt_first": None, "rt_last": None}
+    try:
+        for step in range(steps):
+            frac = step / steps
+            fids_slow = rng.choice(np.arange(1, 5), size=rows_per_step)
+            fids_storm = rng.choice(np.arange(101, 105), size=rows_per_step)
+            # admissions first: outcomes always ride an already-needed frame
+            client.request_batch_arrays(
+                np.concatenate([fids_slow, fids_storm]).astype(np.int64))
+            for prof, fids in ((slow, fids_slow), (storm, fids_storm)):
+                rt, exc, invalid = prof.sample(len(fids), SEED + step, frac)
+                for f, r, e, bad in zip(fids, rt, exc, invalid):
+                    client.record_outcome(int(f), float(r), bool(e))
+                    expect["sent"] += 1
+                    if bad:
+                        expect["invalid"] += 1
+                    else:
+                        ns = ns_of[int(f)]
+                        expect["accepted"][ns] += 1
+                        if e:
+                            expect["exceptions"][ns] += 1
+                if prof is slow:
+                    ok = rt[~invalid]
+                    if ok.size:
+                        if expect["rt_first"] is None:
+                            expect["rt_first"] = float(ok.mean())
+                        expect["rt_last"] = float(ok.mean())
+        client.flush_outcomes()
+        # fire-and-forget wire op: wait (bounded) for the server to drain it
+        want = expect["sent"]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            st = svc.outcome_stats()
+            got = st["reported"] + sum(st["dropped"].values())
+            if got >= want:
+                break
+            time.sleep(0.05)
+        stats = svc.outcome_stats()
+        cstats = client.outcome_stats()
+
+        # -- the four-way reconciliation reads ---------------------------
+        state = svc.export_state()
+        counts = np.asarray(state["outcome"]["counts"])
+        device_complete = int(counts[:, :, OutcomeChannel.COMPLETE].sum())
+        device_exc = int(counts[:, :, OutcomeChannel.EXCEPTION].sum())
+        tl = {"completed": 0, "exceptions": 0}
+        for ns in ("ns-slow", "ns-storm"):
+            for s in timeline().query(namespace=ns):
+                tl["completed"] += s.completed
+                tl["exceptions"] += s.exceptions
+        prom = {}
+        for line in server_metrics().render().splitlines():
+            for fam in ("sentinel_outcome_reported_total",
+                        "sentinel_outcome_exceptions_total"):
+                if line.startswith(fam + " "):
+                    prom[fam] = int(line.split()[-1])
+
+        # -- HA drills: snapshot round trip + MOVE, bit-exact ------------
+        blob = R.encode_snapshot_blob(state)
+        restored = DefaultTokenService(cfg)
+        restored.load_rules(rules)
+        restored.import_state(R.decode_snapshot_blob(blob))
+        r_counts = np.asarray(restored.export_state()["outcome"]["counts"])
+        snapshot_exact = bool(np.array_equal(counts, r_counts))
+        mv = svc.export_namespace_state("ns-storm")
+        mv_target = DefaultTokenService(cfg)
+        mv_target.load_rules(rules)
+        mv_target.import_namespace_state(mv)
+        t_counts = np.asarray(
+            mv_target.export_state()["outcome"]["counts"])
+        move_exact = (
+            "outcome_sums" in mv
+            and int(t_counts[:, :, OutcomeChannel.COMPLETE].sum())
+            == expect["accepted"]["ns-storm"]
+        )
+        flows = stats.get("flows") or {}
+        slow_rt_avg = max(
+            (float((flows.get(f) or {}).get("rt_avg_ms", 0.0))
+             for f in range(1, 5)), default=0.0,
+        )
+        restored.close()
+        mv_target.close()
+    finally:
+        client.close()
+        server.stop()
+        svc.close()
+
+    accepted = expect["accepted"]["ns-slow"] + expect["accepted"]["ns-storm"]
+    exceptions = (expect["exceptions"]["ns-slow"]
+                  + expect["exceptions"]["ns-storm"])
+    doc = {
+        "schema": SCHEMA,
+        "seed": SEED,
+        "steps": steps,
+        "rows_per_step": rows_per_step,
+        "client": cstats,
+        "server": {"reported": stats["reported"],
+                   "exceptions": stats["exceptions"],
+                   "dropped": stats["dropped"]},
+        "expected": {"sent": expect["sent"], "accepted": accepted,
+                     "exceptions": exceptions,
+                     "invalid": expect["invalid"]},
+        "device_columns": {"complete": device_complete,
+                           "exception": device_exc},
+        "timeline": tl,
+        "prometheus": prom,
+        "snapshot_exact": snapshot_exact,
+        "move_exact": move_exact,
+        "profile_visibility": {
+            "slow_rt_avg_ms": slow_rt_avg,
+            "rt_seed_first_step": expect["rt_first"],
+            "rt_seed_last_step": expect["rt_last"],
+            "storm_exceptions": expect["exceptions"]["ns-storm"],
+        },
+    }
+
+    failures = []
+    if cstats["sent"] != expect["sent"] or cstats["dropped_overflow"]:
+        failures.append(
+            f"client sent {cstats['sent']} != recorded {expect['sent']} "
+            f"(overflow drops {cstats['dropped_overflow']})")
+    got_total = stats["reported"] + sum(stats["dropped"].values())
+    if got_total != expect["sent"]:
+        failures.append(
+            f"server saw {got_total} rows, client sent {expect['sent']}")
+    if stats["reported"] != accepted:
+        failures.append(
+            f"accepted {stats['reported']} != seeded valid {accepted}")
+    if sum(stats["dropped"].values()) != expect["invalid"]:
+        failures.append(
+            f"dropped {stats['dropped']} != injected invalid "
+            f"{expect['invalid']}")
+    if stats["exceptions"] != exceptions:
+        failures.append(
+            f"exception count {stats['exceptions']} != seeded {exceptions}")
+    if device_complete != accepted or device_exc != exceptions:
+        failures.append(
+            f"device columns ({device_complete}, {device_exc}) != "
+            f"accepted ({accepted}, {exceptions})")
+    if tl["completed"] != accepted or tl["exceptions"] != exceptions:
+        failures.append(f"timeline sums {tl} != accepted "
+                        f"({accepted}, {exceptions})")
+    if prom.get("sentinel_outcome_reported_total") != accepted or \
+            prom.get("sentinel_outcome_exceptions_total") != exceptions:
+        failures.append(f"prometheus counters {prom} != accepted "
+                        f"({accepted}, {exceptions})")
+    if not snapshot_exact:
+        failures.append("outcome columns not bit-exact across "
+                        "snapshot/restore")
+    if not move_exact:
+        failures.append("MOVE export/import lost outcome sums")
+    if not (slow_rt_avg > 0.0):
+        failures.append("slow-dependency RT never surfaced in the "
+                        "per-flow window reads")
+    if expect["exceptions"]["ns-storm"] <= expect["exceptions"]["ns-slow"]:
+        failures.append("error-storm profile produced no storm")
+    doc["failures"] = failures
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--rows-per-step", type=int, default=64)
+    ap.add_argument("--overhead-gate", type=float, default=None,
+                    metavar="FRAC",
+                    help="skip the reconciliation run; gate the closed-loop "
+                         "serve smoke (outcome plane compiled in, reporting "
+                         "off — its shipped state) at FRAC tolerance vs the "
+                         "committed serve-smoke floor (CI uses 0.02)")
+    args = ap.parse_args()
+
+    if args.overhead_gate is not None:
+        # delegate to the serve smoke's floor gate: identical measurement,
+        # tightened tolerance — the same structure trace-smoke uses
+        from benchmarks import serve_smoke
+
+        sys.argv = [
+            "serve_smoke.py",
+            "--trace-overhead-gate", str(args.overhead_gate),
+        ]
+        return serve_smoke.main()
+
+    doc = run_reconciliation(steps=args.steps,
+                             rows_per_step=args.rows_per_step)
+    print(json.dumps(doc, indent=2))
+    if doc["failures"]:
+        for f_ in doc["failures"]:
+            print(f"OUTCOME SMOKE FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(
+        f"OUTCOME SMOKE OK: {doc['expected']['sent']} reported = "
+        f"{doc['expected']['accepted']} accepted + "
+        f"{doc['expected']['invalid']} dropped; device/timeline/prometheus "
+        f"reconcile exactly; snapshot + MOVE bit-exact"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

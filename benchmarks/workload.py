@@ -214,6 +214,79 @@ class WorkloadModel:
         return np.asarray(sends, np.float64)
 
 
+@dataclass
+class OutcomeProfile:
+    """Deterministic completion-outcome generator for the outcome-feedback
+    plane: given how many admitted rows completed, produce their reported
+    (RT ms, exception) pairs. Same contract as the flow streams — identical
+    under (profile, seed) — so the outcome-smoke reconciliation gate can
+    assert exact counts, not distributions.
+
+    RT is lognormal around ``base_rt_ms`` (long-tailed, like a real
+    dependency) with a linear end-of-run multiplier ``rt_ramp`` — the
+    *slow-dependency* story is RT climbing while success holds. Exceptions
+    fire at ``exception_p`` outside the storm window and ``storm_p``
+    inside it — the *error-storm* story is a burst of failures at steady
+    RT. ``invalid_p`` emits deliberately malformed rows (negative RT /
+    NaN / over-bound) to exercise the wire-boundary validation; the smoke
+    asserts they all land in ``sentinel_outcome_dropped_total``.
+    """
+
+    name: str
+    base_rt_ms: float = 8.0
+    rt_sigma: float = 0.6
+    rt_ramp: float = 1.0
+    exception_p: float = 0.0
+    storm_p: float = 0.0
+    storm_window: tuple = (1.0 / 3.0, 2.0 / 3.0)
+    invalid_p: float = 0.0
+
+    def sample(self, n: int, seed: int, frac: float = 0.0):
+        """``n`` completions at normalized run time ``frac`` →
+        ``(rt_ms float64[n], exception bool[n], invalid bool[n])``.
+        Invalid rows carry a malformed RT (negative, NaN, or over the
+        60 s wire bound, round-robin) and are what the drop counters
+        must account for, row for row."""
+        rng = np.random.default_rng(
+            (seed ^ (zlib.crc32(self.name.encode()) & 0x7FFFFFFF))
+            + int(frac * 1_000_003)
+        )
+        frac = min(max(frac, 0.0), 1.0)
+        scale = 1.0 + (self.rt_ramp - 1.0) * frac
+        rt = rng.lognormal(
+            math.log(max(self.base_rt_ms, 1e-3) * scale),
+            self.rt_sigma, size=n,
+        )
+        lo, hi = self.storm_window
+        p_exc = self.storm_p if lo <= frac < hi else self.exception_p
+        exc = rng.random(n) < p_exc
+        invalid = rng.random(n) < self.invalid_p
+        if invalid.any():
+            idx = np.flatnonzero(invalid)
+            bad = np.array([-1.0, float("nan"), 120_000.0])
+            rt[idx] = bad[np.arange(idx.size) % 3]
+        return rt, exc, invalid
+
+
+def slow_dependency_profile(name: str = "slow-dependency",
+                            invalid_p: float = 0.0) -> OutcomeProfile:
+    """A guarded dependency degrading under load: RT triples over the run
+    (p99 climbs bucket by bucket in ``sentinel_flow_rt_p99_ms``) while the
+    success rate stays high — the case only the RT columns can see."""
+    return OutcomeProfile(name, base_rt_ms=8.0, rt_sigma=0.6, rt_ramp=3.0,
+                          exception_p=0.002, invalid_p=invalid_p)
+
+
+def error_storm_profile(name: str = "error-storm",
+                        invalid_p: float = 0.0) -> OutcomeProfile:
+    """A dependency throwing in bursts: RT stays flat but the middle third
+    of the run fails at 40% — the case only the exception columns can see
+    (``sentinel_flow_exception_qps`` spikes, RT barely moves)."""
+    return OutcomeProfile(name, base_rt_ms=5.0, rt_sigma=0.3, rt_ramp=1.0,
+                          exception_p=0.001, storm_p=0.4,
+                          invalid_p=invalid_p)
+
+
 def demand_totals(model: WorkloadModel, phase: Phase) -> Dict[str, float]:
     """Total rows each tenant offers during ``phase`` (the fairness gate's
     demand side: a tenant served below its share is only *starved* if it

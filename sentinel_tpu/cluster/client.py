@@ -15,12 +15,14 @@ background thread owns the socket read side.
 from __future__ import annotations
 
 import itertools
+import math
 import random
 import socket
 import struct
 import threading
 import time
-from typing import Dict, Optional
+from collections import deque
+from typing import Dict, List, Optional
 
 from sentinel_tpu import chaos
 from sentinel_tpu.cluster import protocol as P
@@ -90,6 +92,13 @@ _LEASE_EXPIRY_SAFETY = 0.9
 # whichever comes first) so the replacement slice lands before exhaustion
 _LEASE_RENEW_AT = 0.45
 
+# wire rev 6: locally-recorded completion outcomes awaiting coalescence
+# onto the next outbound frame. Bounded so a client that never sends again
+# (idle, or stuck behind a dead server) holds a fixed amount of memory —
+# the deque evicts the OLDEST outcome, keeping the freshest window of
+# observations, and evictions are counted (dropped_overflow).
+_OUTCOME_BUF_CAP = 8192
+
 
 class _FlowLease:
     """One cached wire-rev-5 lease: the client-local admission budget for
@@ -158,6 +167,18 @@ class TokenClient(TokenService):
             "expired": 0, "local_admits": 0, "wire_rows": 0,
         }
         self._rpcs = 0  # wire round trips (request/lease/ping/batch chunks)
+        # wire rev 6 outcome feedback: completions recorded locally and
+        # coalesced into OUTCOME_REPORT frames prepended to the next
+        # outbound request frame (zero extra round trips — the report is
+        # fire-and-forget, the server never answers it)
+        self._outcome_lock = threading.Lock()
+        self._outcome_buf: deque = deque(maxlen=_OUTCOME_BUF_CAP)
+        self._outcome_counts = {
+            "recorded": 0,   # record_outcome calls accepted into the buffer
+            "sent": 0,       # rows shipped inside OUTCOME_REPORT frames
+            "frames": 0,     # OUTCOME_REPORT frames shipped
+            "dropped_overflow": 0,  # oldest rows evicted by the buffer cap
+        }
         # opt-in pacing cooperation: a SHOULD_WAIT verdict with a wait hint
         # means the server already reserved the token at now+wait (paced
         # admission / priority occupy) — sleeping out the hint and reporting
@@ -248,6 +269,10 @@ class TokenClient(TokenService):
                 pending.event.set()
 
     def close(self) -> None:
+        try:
+            self.flush_outcomes()  # best-effort: don't strand observations
+        except Exception:
+            pass
         self._return_leases()  # best-effort: unused tokens go back early
         sock = self._sock
         if sock is not None:
@@ -549,6 +574,80 @@ class TokenClient(TokenService):
             out["rpcs"] = self._rpcs
             return out
 
+    # -- wire rev 6: completion outcome reporting ----------------------------
+    def record_outcome(
+        self, flow_id: int, rt_ms, exception: bool = False
+    ) -> None:
+        """Record one entry completion (response time in ms, exception
+        flag) locally. Nothing goes on the wire here — buffered outcomes
+        coalesce into one OUTCOME_REPORT frame prepended to the NEXT
+        outbound request frame (or shipped by :meth:`flush_outcomes`), so
+        the serve path never pays an extra round trip for telemetry."""
+        try:
+            r = float(rt_ms)
+        except (TypeError, ValueError):
+            r = float("nan")
+        # NaN/inf can't ride an int32 wire row: park at -1 so the server's
+        # wire-boundary validation drops + counts it rather than silently
+        # wrapping; finite values clamp into int32 (the server enforces
+        # the real OUTCOME_MAX_RT_MS ceiling and counts the overage)
+        rt = int(min(r, float(2**31 - 1))) if math.isfinite(r) else -1
+        with self._outcome_lock:
+            if len(self._outcome_buf) == self._outcome_buf.maxlen:
+                self._outcome_counts["dropped_overflow"] += 1
+            self._outcome_buf.append(
+                (int(flow_id), rt, bool(exception))
+            )
+            self._outcome_counts["recorded"] += 1
+
+    def _drain_outcome_frames(self) -> List[bytes]:
+        """Pull every buffered outcome and encode the coalesced
+        OUTCOME_REPORT frame(s) — normally one; more only when a burst
+        outgrew MAX_OUTCOME_PER_FRAME. Counters update on drain (the
+        frames WILL be sent by the caller or the rows are lost with the
+        connection, same contract as any fire-and-forget write)."""
+        with self._outcome_lock:
+            if not self._outcome_buf:
+                return []
+            rows = list(self._outcome_buf)
+            self._outcome_buf.clear()
+            self._outcome_counts["sent"] += len(rows)
+        frames: List[bytes] = []
+        step = P.MAX_OUTCOME_PER_FRAME
+        for lo in range(0, len(rows), step):
+            chunk = rows[lo:lo + step]
+            frames.append(P.encode_outcome_report(
+                next(self._xid),
+                [c[0] for c in chunk],
+                [c[1] for c in chunk],
+                [c[2] for c in chunk],
+            ))
+        with self._outcome_lock:
+            self._outcome_counts["frames"] += len(frames)
+        return frames
+
+    def _send_outcome_frames(self, frames: List[bytes]) -> bool:
+        """Ship already-encoded outcome frames standalone. TCP coalesces
+        them into one write; the shm subclass overrides (one ring slot
+        carries exactly one frame)."""
+        if not frames:
+            return True
+        return self._send(b"".join(frames), piggyback=False)
+
+    def flush_outcomes(self) -> bool:
+        """Force buffered outcomes onto the wire without waiting for the
+        next request (idle clients, shutdown). True when nothing was
+        pending or the write succeeded."""
+        return self._send_outcome_frames(self._drain_outcome_frames())
+
+    def outcome_stats(self) -> Dict[str, int]:
+        """Client-side outcome counters: the reconciliation gate checks
+        ``sent`` against the server's accepted totals."""
+        with self._outcome_lock:
+            out = dict(self._outcome_counts)
+            out["buffered"] = len(self._outcome_buf)
+            return out
+
     # -- hierarchy tier (pod share agent ↔ global budget coordinator) --------
     def share_op(
         self, msg_type, flow_id: int, want: int = 0,
@@ -824,7 +923,14 @@ class TokenClient(TokenService):
         finally:
             self._pending.pop(req.xid, None)
 
-    def _send(self, data: bytes) -> bool:
+    def _send(self, data: bytes, piggyback: bool = True) -> bool:
+        if piggyback and self._outcome_buf:
+            # rev-6 piggyback: buffered completion outcomes ride ahead of
+            # this frame in the SAME sendall — one syscall, zero extra
+            # round trips (the server never answers an OUTCOME_REPORT)
+            frames = self._drain_outcome_frames()
+            if frames:
+                data = b"".join(frames) + data
         if not self._ensure_connected():
             return False
         sock = self._sock

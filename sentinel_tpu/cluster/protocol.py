@@ -115,6 +115,22 @@ lease slices from a pod, one level up:
 Both doors route ``HIER_TYPES`` to the service's attached coordinator
 (``service.hierarchy``); a standby answers STANDBY like any other
 control op, so agent-side failover walks on.
+
+Codec rev 6 — batched outcome reports (the completion-telemetry plane):
+clients record per-entry completion (RT ms, success/exception) locally and
+coalesce them into ONE fire-and-forget frame, piggy-backed in front of the
+next request frame on the same connection (the shm door publishes it as its
+own ring slot — one slot carries exactly one frame). Data =
+``n:uint16`` + n × ``(flow_id:int64, rt_ms:int32, exc:uint8)``::
+
+    | flow_id: int64 | rt_ms: int32 | exc: uint8 |
+
+There is NO response frame: outcome telemetry is best-effort by design, so
+the lease/request fast path stays at zero extra RPCs and a server that
+predates rev 6 simply drops the unknown type byte. RT values are validated
+server-side at this wire boundary (negative / oversized values are counted
+into ``sentinel_outcome_dropped_total`` rather than scattered) — see
+``OUTCOME_MAX_RT_MS`` below.
 """
 
 from __future__ import annotations
@@ -129,8 +145,8 @@ import numpy as np
 from sentinel_tpu import chaos as _chaos
 
 # codec revision this build speaks: 2 deadline trailer, 3 REPL, 4 MOVE,
-# 5 LEASE + HIER share ops (the doc revisions above)
-WIRE_REV = 5
+# 5 LEASE + HIER share ops, 6 OUTCOME_REPORT (the doc revisions above)
+WIRE_REV = 6
 
 # 2-byte big-endian length prefix caps a frame at 65535 bytes; single-request
 # messages keep the reference's 1024-byte budget, BATCH_FLOW frames use the
@@ -155,6 +171,20 @@ _DEADLINE = struct.Struct(">I")
 BATCH_REQ_DTYPE = np.dtype([("flow_id", ">i8"), ("count", ">i4"), ("prio", "u1")])
 BATCH_RSP_DTYPE = np.dtype([("status", "i1"), ("remaining", ">i4"), ("wait_ms", ">i4")])
 MAX_BATCH_PER_FRAME = (MAX_FRAME - _HEAD.size - _BATCH_N.size) // BATCH_REQ_DTYPE.itemsize
+
+# rev-6 outcome rows: (flow_id, rt_ms, exc) — same 13-byte shape discipline
+# as BATCH_REQ_DTYPE so one frame coalesces ~5000 completions
+OUTCOME_ROW_DTYPE = np.dtype([("flow_id", ">i8"), ("rt_ms", ">i4"), ("exc", "u1")])
+MAX_OUTCOME_PER_FRAME = (MAX_FRAME - _HEAD.size - _BATCH_N.size) // OUTCOME_ROW_DTYPE.itemsize
+
+# wire-boundary RT validation ceiling (ms). The reference clamps recorded RT
+# at statisticMaxRt (SentinelConfig, 4900 ms default); we keep a wider valve
+# for slow-dependency telemetry but anything above it is a bogus report —
+# dropped and counted (reason="too_large"), never scattered into rt_sum.
+# The floor of the valid range is 0; negative values drop (reason="negative")
+# and non-integral garbage drops client-side before the int cast
+# (reason="non_finite").
+OUTCOME_MAX_RT_MS = 60_000
 
 
 class MsgType(enum.IntEnum):
@@ -188,6 +218,8 @@ class MsgType(enum.IntEnum):
     SHARE_GRANT = 18
     SHARE_RENEW = 19
     SHARE_RETURN = 20
+    # codec rev 6: batched fire-and-forget completion telemetry
+    OUTCOME_REPORT = 21
 
 
 # front doors route these type bytes to the replication applier instead of
@@ -218,6 +250,10 @@ SHARE_TYPES = frozenset(
 
 # everything both doors route to the attached hierarchy coordinator
 HIER_TYPES = frozenset(SHARE_TYPES | {MsgType.DEMAND_REPORT})
+
+# rev-6 outcome frames route to the token service's outcome ingester on both
+# doors; fire-and-forget (no response is ever written for these)
+OUTCOME_TYPES = frozenset({MsgType.OUTCOME_REPORT})
 
 # TokenStatus.MOVED — mirrored here as a bare int because this module must
 # stay importable without jax (socket-only processes); decode_response keys
@@ -401,6 +437,51 @@ def decode_batch_request_into(payload, ids_out, counts_out, prios_out, at=0):
     counts_out[at : at + n] = rows["count"]
     prios_out[at : at + n] = rows["prio"]
     return xid, n
+
+
+def encode_outcome_report(xid: int, flow_ids, rt_ms, excs) -> bytes:
+    """One OUTCOME_REPORT frame carrying N completion rows (rev 6).
+
+    Fire-and-forget: the server never answers. Callers coalesce buffered
+    completions and prepend this frame to the next request frame (TCP) or
+    publish it as its own ring slot (shm)."""
+    flow_ids = np.asarray(flow_ids, dtype=np.int64)
+    n = flow_ids.shape[0]
+    if n > MAX_OUTCOME_PER_FRAME:
+        raise ValueError(f"outcome batch of {n} exceeds {MAX_OUTCOME_PER_FRAME}/frame")
+    rows = np.empty(n, dtype=OUTCOME_ROW_DTYPE)
+    rows["flow_id"] = flow_ids
+    rows["rt_ms"] = np.asarray(rt_ms, dtype=np.int32)
+    rows["exc"] = np.asarray(excs, dtype=np.uint8)
+    payload_len = _HEAD.size + _BATCH_N.size + n * OUTCOME_ROW_DTYPE.itemsize
+    return (
+        _LEN.pack(payload_len)
+        + _HEAD.pack(xid, MsgType.OUTCOME_REPORT)
+        + _BATCH_N.pack(n)
+        + rows.tobytes()
+    )
+
+
+def decode_outcome_report(payload: bytes):
+    """OUTCOME_REPORT payload → (xid, flow_ids int64[N], rt_ms int32[N],
+    excs bool[N]). Caller has already checked the type byte. Raises
+    ``ValueError`` on a truncated frame (treated as a protocol error on
+    that connection, like a truncated batch frame)."""
+    xid, _ = _HEAD.unpack_from(payload, 0)
+    (n,) = _BATCH_N.unpack_from(payload, _HEAD.size)
+    off = _HEAD.size + _BATCH_N.size
+    if len(payload) < off + n * OUTCOME_ROW_DTYPE.itemsize:
+        raise ValueError(
+            f"truncated outcome frame: {n} rows declared, "
+            f"{len(payload) - off} payload bytes"
+        )
+    rows = np.frombuffer(payload, dtype=OUTCOME_ROW_DTYPE, count=n, offset=off)
+    return (
+        xid,
+        rows["flow_id"].astype(np.int64),
+        rows["rt_ms"].astype(np.int32),
+        rows["exc"].astype(bool),
+    )
 
 
 class StagingPool:

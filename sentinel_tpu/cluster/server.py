@@ -337,6 +337,28 @@ class _LoopWorker:
                         ))
                         await writer.drain()
                         continue
+                    if mtype in P.OUTCOME_TYPES:
+                        # wire rev 6 (outcome feedback): a client's coalesced
+                        # completion report, piggy-backed ahead of its next
+                        # request frame. Fire-and-forget — NO response frame,
+                        # so the lease/request fast path never waits on it.
+                        try:
+                            oxid, ofids, orts, oexcs = (
+                                P.decode_outcome_report(payload)
+                            )
+                        except Exception:
+                            record_log.warning("bad outcome frame; closing")
+                            return
+                        srv.connections.touch(address)
+                        if srv.is_standby:
+                            # outcome columns replicate from the primary;
+                            # counting here would double on promotion
+                            continue
+                        await asyncio.to_thread(
+                            srv.service.report_outcomes,
+                            ofids, orts, oexcs, oxid,
+                        )
+                        continue
                     if mtype == P.MsgType.BATCH_FLOW:
                         # vectorized decode; no per-request Python objects
                         try:

@@ -1156,6 +1156,22 @@ class NativeTokenServer:
                 return
             door.send(fd, gen, rsp_bytes)
             return
+        # rev-6 outcome reports: fire-and-forget (no door.send — the whole
+        # point is zero extra round-trips on the lease fast path). Covers
+        # both the TCP and shm doors: each routes non-data type bytes here.
+        if len(payload) >= 5 and P.peek_type(payload) in P.OUTCOME_TYPES:
+            try:
+                oxid, ofids, orts, oexcs = P.decode_outcome_report(payload)
+            except Exception:
+                record_log.warning("bad outcome frame; closing %s", address)
+                door.close_conn(fd, gen)
+                return
+            if self.is_standby:
+                # outcome columns replicate from the primary; counting here
+                # would double on promotion
+                return
+            self.service.report_outcomes(ofids, orts, oexcs, oxid)
+            return
         try:
             req = P.decode_request(payload)
         except Exception:

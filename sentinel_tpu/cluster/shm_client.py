@@ -123,6 +123,10 @@ class ShmTokenClient(TokenClient):
                 pending.event.set()
 
     def close(self) -> None:
+        try:
+            self.flush_outcomes()  # best-effort, same as TCP
+        except Exception:
+            pass
         self._return_leases()  # best-effort conservation, same as TCP
         ring = self._ring
         if ring is not None:
@@ -180,7 +184,22 @@ class ShmTokenClient(TokenClient):
             with self._send_lock:
                 ring.close()
 
-    def _send(self, data: bytes) -> bool:
+    def _send_outcome_frames(self, frames) -> bool:
+        """Rev-6 outcome frames over shm: one ring slot carries exactly ONE
+        frame (``send_frame`` strips the whole buffer's 2-byte length
+        prefix), so the TCP client's coalesced single-write is replaced by
+        one slot per frame — still fire-and-forget, still zero round
+        trips."""
+        ok = True
+        for f in frames:
+            ok = self._send(f, piggyback=False) and ok
+        return ok
+
+    def _send(self, data: bytes, piggyback: bool = True) -> bool:
+        if piggyback and self._outcome_buf:
+            # publish buffered outcomes as their own slots ahead of this
+            # request frame (no prefix-concatenation on a ring transport)
+            self._send_outcome_frames(self._drain_outcome_frames())
         if not self._ensure_connected():
             return False
         ring = self._ring

@@ -423,6 +423,27 @@ class DefaultTokenService(TokenService):
                 _self()
             )
         )
+        # rev-6 outcome plane: the donated completion-scatter step compiles
+        # lazily (reports arrive on the clients' cadence, not the serve
+        # path's — the first report pays the compile; row counts pad to a
+        # geometric shape ladder so retraces stay bounded); host counters
+        # back sentinel_outcome_reported_total /
+        # sentinel_outcome_dropped_total{reason} and the reconciliation
+        # gate. All mutated under self._lock.
+        self._outcome_step = None
+        self._outcome_counts: Dict[str, object] = {
+            "reported": 0,  # rows accepted and scattered
+            "exceptions": 0,  # subset of reported with exc=1
+            "rt_sum_ms": 0,  # host-side mirror of the accepted RT mass
+            "batches": 0,  # OUTCOME_REPORT frames ingested
+            # reason -> count; reasons: negative, too_large, unknown_flow
+            "dropped": {},
+        }
+        _SM.register_outcome_provider(
+            lambda: (lambda s: s.outcome_stats() if s is not None else {})(
+                _self()
+            )
+        )
 
     @staticmethod
     def _prep_batch(cfg, slots, acq, pr):
@@ -736,6 +757,7 @@ class DefaultTokenService(TokenService):
                         shp.warm_filled - d32,
                     ),
                 ),
+                outcome=rebase(self._state.outcome, delta),
             )
             # the param sketch's starts are engine-ms too
             pstarts = self._param_state.starts
@@ -2006,6 +2028,13 @@ class DefaultTokenService(TokenService):
             nsum = np.asarray(
                 W.window_sum_all(spec, self._state.ns, jnp.int32(now))
             )
+            # completion-outcome columns move with the flow like the shaper
+            # clocks from PR 15: live-window sums fold into the destination's
+            # current bucket, so RT/exception telemetry (and the breakers it
+            # will feed) survives a MOVE without a ring-phase contract
+            outsum = np.asarray(
+                W.window_sum_all(spec, self._state.outcome, jnp.int32(now))
+            )
             from sentinel_tpu.stats.window import NEVER as _WNEVER
 
             lpt_h = np.asarray(self._state.shaping.lpt)
@@ -2014,6 +2043,7 @@ class DefaultTokenService(TokenService):
             flow_ids: List[int] = []
             frows: List[np.ndarray] = []
             orows: List[np.ndarray] = []
+            outrows: List[np.ndarray] = []
             lpt_rel: List[int] = []
             wtok_rows: List[float] = []
             wfill_rel: List[int] = []
@@ -2024,6 +2054,7 @@ class DefaultTokenService(TokenService):
                 flow_ids.append(int(r.flow_id))
                 frows.append(fsum[slot])
                 orows.append(osum[slot])
+                outrows.append(outsum[slot])
                 # shaper clocks ship RELATIVE to now — the destination's
                 # engine epoch is its own; NEVER stays NEVER
                 lpt_rel.append(
@@ -2050,6 +2081,10 @@ class DefaultTokenService(TokenService):
                 "occupy_sums": (
                     np.stack(orows) if orows
                     else np.zeros((0, osum.shape[1]), osum.dtype)
+                ),
+                "outcome_sums": (
+                    np.stack(outrows) if outrows
+                    else np.zeros((0, outsum.shape[1]), outsum.dtype)
                 ),
                 "ns_sum": (
                     np.array(nsum[row]) if row is not None
@@ -2129,6 +2164,16 @@ class DefaultTokenService(TokenService):
                 occupy = self._fold_into_current(
                     self._state.occupy, spec, now, slots, doc["occupy_sums"]
                 )
+                # pre-outcome blobs carry no key — moved flows start with an
+                # empty completion window, the conservative default
+                out_sums = doc.get("outcome_sums")
+                outcome = (
+                    self._fold_into_current(
+                        self._state.outcome, spec, now, slots, out_sums
+                    )
+                    if out_sums is not None and slots is not None
+                    else self._state.outcome
+                )
                 row = self._index.ns_of.get(namespace)
                 ns = self._fold_into_current(
                     self._state.ns, spec, now,
@@ -2170,7 +2215,8 @@ class DefaultTokenService(TokenService):
                         warm_filled=jnp.asarray(wfill_h),
                     )
                 self._state = self._place_state(
-                    _ES(flow=flow, occupy=occupy, ns=ns, shaping=shaping)
+                    _ES(flow=flow, occupy=occupy, ns=ns, shaping=shaping,
+                        outcome=outcome)
                 )
                 pfids = [int(f) for f in doc.get("param_fids", [])]
                 if pfids:
@@ -2230,6 +2276,9 @@ class DefaultTokenService(TokenService):
                 "flow": _win(self._state.flow),
                 "occupy": _win(self._state.occupy),
                 "ns": _win(self._state.ns),
+                # per-flow completion-outcome windows (rt_sum / complete /
+                # exception / RT histogram channels; same ring epoch)
+                "outcome": _win(self._state.outcome),
                 # per-flow shaper clocks (engine-ms; same epoch as starts)
                 "shaping": {
                     "lpt": np.asarray(self._state.shaping.lpt),
@@ -2317,6 +2366,20 @@ class DefaultTokenService(TokenService):
                 # pre-shaping snapshots carry no shaper clocks — restore
                 # them cold (NEVER/0), which is the conservative default
                 shaping_doc = state.get("shaping")
+                # pre-outcome snapshots carry no completion windows —
+                # restore them empty (cold), same tolerant-absent discipline
+                outcome_doc = state.get("outcome")
+                if outcome_doc is not None:
+                    out_c = _check("outcome.counts", outcome_doc["counts"],
+                                   cur.outcome.counts)
+                    out_s = _check("outcome.starts", outcome_doc["starts"],
+                                   cur.outcome.starts)
+                else:
+                    out_c = np.zeros(
+                        tuple(cur.outcome.counts.shape),
+                        np.asarray(cur.outcome.counts[:0]).dtype,
+                    )
+                    out_s = np.asarray(cur.outcome.starts)
             self.load_rules(
                 rules,
                 ns_max_qps=float(state["ns_max_qps"]),
@@ -2329,6 +2392,7 @@ class DefaultTokenService(TokenService):
                 old_slot = state["slot_of"]
                 new_flow_c = np.zeros_like(flow_c)
                 new_occ_c = np.zeros_like(occ_c)
+                new_out_c = np.zeros_like(out_c)
                 from sentinel_tpu.stats.window import NEVER as _WNEVER
 
                 n_flows = self.config.max_flows
@@ -2341,6 +2405,7 @@ class DefaultTokenService(TokenService):
                         continue
                     new_flow_c[new] = flow_c[old]
                     new_occ_c[new] = occ_c[old]
+                    new_out_c[new] = out_c[old]
                     if shaping_doc is not None:
                         new_lpt[new] = np.asarray(shaping_doc["lpt"])[old]
                         new_wtok[new] = np.asarray(
@@ -2389,6 +2454,7 @@ class DefaultTokenService(TokenService):
                         warm_tokens=jnp.asarray(new_wtok),
                         warm_filled=jnp.asarray(new_wfill),
                     ),
+                    outcome=_WS(jnp.asarray(out_s), jnp.asarray(new_out_c)),
                 ))
                 self._param_state = self._param_state._replace(
                     starts=jnp.asarray(p_s),
@@ -2418,7 +2484,10 @@ class DefaultTokenService(TokenService):
         Idempotent; until called the dispatch paths skip the bookkeeping."""
         with self._lock:
             if self._dirty is None:
-                self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
+                self._dirty = {
+                    "flow": set(), "param": set(), "param_fat": set(),
+                    "outcome": set(),
+                }
 
     def replication_disable(self) -> None:
         with self._lock:
@@ -2450,7 +2519,11 @@ class DefaultTokenService(TokenService):
             flow_slots = sorted(self._dirty["flow"])
             param_slots = sorted(self._dirty["param"])
             param_fat_slots = sorted(self._dirty.get("param_fat", ()))
-            self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
+            outcome_slots = sorted(self._dirty.get("outcome", ()))
+            self._dirty = {
+                "flow": set(), "param": set(), "param_fat": set(),
+                "outcome": set(),
+            }
             now = self._engine_now()  # pins the epoch, runs a due rebase
             delta: Dict[str, object] = {
                 "gen": int(self._state_gen),
@@ -2460,6 +2533,7 @@ class DefaultTokenService(TokenService):
                 "flow_starts": np.asarray(self._state.flow.starts),
                 "occupy_starts": np.asarray(self._state.occupy.starts),
                 "ns_starts": np.asarray(self._state.ns.starts),
+                "outcome_starts": np.asarray(self._state.outcome.starts),
                 "param_starts": np.asarray(self._param_state.starts),
             }
             # row gathers go through the shard-aware host collector: on a
@@ -2499,6 +2573,18 @@ class DefaultTokenService(TokenService):
                     delta["ns_counts"] = host_rows(
                         self._state.ns.counts, np.asarray(rows, np.int32)
                     )
+            if outcome_slots:
+                # completion-outcome rows ride the same dirty-row keying,
+                # tracked separately from flow rows — admission traffic and
+                # completion reports dirty different slots on different
+                # cadences, and mixing the sets would ship full flow rows
+                # for every piggy-backed outcome batch
+                osl = np.asarray(outcome_slots, np.int32)
+                orev = {v: k for k, v in self._index.slot_of.items()}
+                delta["outcome_fids"] = [int(orev[s]) for s in outcome_slots]
+                delta["outcome_counts"] = host_rows(
+                    self._state.outcome.counts, osl
+                )
             if param_slots:
                 pr = np.asarray(param_slots, np.int32)
                 prev = {
@@ -2576,6 +2662,13 @@ class DefaultTokenService(TokenService):
             flow = _rotate(self._state.flow, delta["flow_starts"])
             occupy = _rotate(self._state.occupy, delta["occupy_starts"])
             ns = _rotate(self._state.ns, delta["ns_starts"])
+            # pre-outcome senders ship no outcome_starts: keep the local
+            # ring untouched (it is empty on such a standby anyway)
+            out_starts = delta.get("outcome_starts")
+            outcome = (
+                _rotate(self._state.outcome, out_starts)
+                if out_starts is not None else self._state.outcome
+            )
             flow_ids = delta.get("flow_ids")
             shaping = self._state.shaping
             if flow_ids:
@@ -2610,6 +2703,20 @@ class DefaultTokenService(TokenService):
                             jnp.asarray(delta["shaping_warm_filled"])
                         ),
                     )
+            outcome_fids = delta.get("outcome_fids")
+            if outcome_fids:
+                oslots = []
+                for fid in outcome_fids:
+                    s = self._index.slot_of.get(int(fid))
+                    if s is None:
+                        raise ValueError(f"delta names unknown flow {fid}")
+                    oslots.append(s)
+                osl = jnp.asarray(np.asarray(oslots, np.int32))
+                outcome = outcome._replace(
+                    counts=outcome.counts.at[osl].set(
+                        jnp.asarray(delta["outcome_counts"])
+                    )
+                )
             ns_names = delta.get("ns_names")
             if ns_names:
                 rows = []
@@ -2633,6 +2740,10 @@ class DefaultTokenService(TokenService):
                 ),
                 ns=_WS(jnp.asarray(delta["ns_starts"]), ns.counts),
                 shaping=shaping,
+                outcome=(
+                    _WS(jnp.asarray(out_starts), outcome.counts)
+                    if out_starts is not None else outcome
+                ),
             ))
             pstate = _rotate(self._param_state, delta["param_starts"])
             pcounts = pstate.counts
@@ -2710,16 +2821,25 @@ class DefaultTokenService(TokenService):
         return stats
 
     def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:
-        from sentinel_tpu.engine.state import ClusterEvent, flow_spec
+        from sentinel_tpu.engine.state import (
+            ClusterEvent,
+            OutcomeChannel,
+            flow_spec,
+        )
         from sentinel_tpu.stats import window as W
 
         with self._lock:
             now = self._engine_now()
             spec = flow_spec(self.config)
             sums = np.asarray(W.window_sum_all(spec, self._state.flow, jnp.int32(now)))
+            osums = np.asarray(
+                W.window_sum_all(spec, self._state.outcome, jnp.int32(now))
+            )
             interval_s = spec.interval_ms / 1000.0
             out = {}
             for fid, slot in self._index.slot_of.items():
+                n_complete = float(osums[slot, OutcomeChannel.COMPLETE])
+                rt_sum = float(osums[slot, OutcomeChannel.RT_SUM])
                 out[fid] = {
                     "pass_qps": float(sums[slot, ClusterEvent.PASS]) / interval_s,
                     "block_qps": float(sums[slot, ClusterEvent.BLOCK]) / interval_s,
@@ -2727,6 +2847,14 @@ class DefaultTokenService(TokenService):
                     # hierarchy tier reads this for fleet-wide occupancy:
                     # live LEASED charge (client leases + share holds)
                     "leased_tokens": float(sums[slot, ClusterEvent.LEASED]),
+                    # completion-outcome plane (MetricNode success/exception
+                    # parity): windowed success rate, exception rate, avg RT
+                    "success_qps": n_complete / interval_s,
+                    "exception_qps": (
+                        float(osums[slot, OutcomeChannel.EXCEPTION])
+                        / interval_s
+                    ),
+                    "rt_avg_ms": rt_sum / n_complete if n_complete else 0.0,
                 }
                 rule = self._rule_of.get(fid)
                 mv = (
@@ -2740,4 +2868,196 @@ class DefaultTokenService(TokenService):
                     # aggregate_snapshots can drop this pod's stale copy
                     # instead of double-reporting during the redirect window.
                     out[fid]["moved_epoch"] = float(mv[1])
+            return out
+
+    # -- rev-6 completion-outcome ingest (OUTCOME_REPORT wire op) ------------
+    def report_outcomes(self, flow_ids, rt_ms, exceptions, xid: int = 0) -> int:
+        """Ingest one batched completion report: validate at the wire
+        boundary, scatter accepted rows into the per-flow outcome window via
+        the donated fused step, and feed every host metric plane (timeline,
+        SLO burn, flight recorder, ServerMetrics counters).
+
+        Returns the number of rows accepted. Fire-and-forget from the wire's
+        point of view — both doors call this with no response frame, so the
+        lease/request fast path stays at zero extra RPCs.
+
+        Wire-boundary validation (never scattered, counted into
+        ``sentinel_outcome_dropped_total{reason}``):
+
+        - ``negative``: RT < 0 after the int cast (also where a client's
+          NaN/int-cast garbage lands — the cast maps non-finite to INT_MIN)
+        - ``non_finite``: RT arrived as a non-finite float (in-process
+          callers; the wire always carries int32)
+        - ``too_large``: RT > ``protocol.OUTCOME_MAX_RT_MS`` — a bogus
+          report that would poison ``rt_sum`` for the whole window
+        - ``unknown_flow``: no rule slot holds this flow_id
+        """
+        from sentinel_tpu.cluster import protocol as P
+
+        flow_ids = np.asarray(flow_ids, np.int64).reshape(-1)
+        k = int(flow_ids.shape[0])
+        rt_in = np.asarray(rt_ms).reshape(-1)
+        exc_in = np.asarray(exceptions).reshape(-1).astype(bool)
+        if rt_in.shape[0] != k or exc_in.shape[0] != k:
+            raise ValueError("outcome report arrays must share one length")
+        if rt_in.dtype.kind == "f":
+            finite = np.isfinite(rt_in)
+            # non-finite floats must not reach the int cast (UB-ish numpy
+            # warning + garbage); park them at -1, counted separately below
+            rt = np.where(finite, rt_in, -1.0).astype(np.int64)
+        else:
+            finite = np.ones(k, bool)
+            rt = rt_in.astype(np.int64)
+        negative = finite & (rt < 0)
+        too_large = finite & (rt > P.OUTCOME_MAX_RT_MS)
+        slots = self.lookup_slots(flow_ids)
+        unknown = slots < 0
+        valid = finite & ~negative & ~too_large & ~unknown
+        n_ok = int(valid.sum())
+        drops = (
+            ("non_finite", int((~finite).sum())),
+            ("negative", int(negative.sum())),
+            ("too_large", int((too_large & ~negative).sum())),
+            ("unknown_flow", int((unknown & finite & ~negative & ~too_large).sum())),
+        )
+        # pad to a geometric shape ladder so the jitted scatter retraces a
+        # bounded number of times, not once per distinct report size
+        cap = 64
+        while cap < k:
+            cap *= 4
+        pad = cap - k
+        f = self.config.max_flows
+        slots_p = np.concatenate(
+            [np.where(valid, slots, f).astype(np.int32),
+             np.full(pad, f, np.int32)]
+        )
+        rt_p = np.concatenate(
+            [np.where(valid, rt, 0).astype(np.int32),
+             np.zeros(pad, np.int32)]
+        )
+        exc_p = np.concatenate(
+            [(exc_in & valid).astype(np.int32), np.zeros(pad, np.int32)]
+        )
+        valid_p = np.concatenate([valid, np.zeros(pad, bool)])
+        with self._lock:
+            for reason, n in drops:
+                if n:
+                    d = self._outcome_counts["dropped"]
+                    d[reason] = d.get(reason, 0) + n
+            self._outcome_counts["batches"] += 1
+            if n_ok:
+                if self._outcome_step is None:
+                    from sentinel_tpu.engine.outcome import (
+                        outcome_step_donating,
+                    )
+
+                    self._outcome_step = outcome_step_donating(self.config)
+                now = self._engine_now()
+                self._state = self._outcome_step(
+                    self._state,
+                    jnp.asarray(slots_p),
+                    jnp.asarray(rt_p),
+                    jnp.asarray(exc_p),
+                    jnp.asarray(valid_p),
+                    jnp.int32(now),
+                )
+                self._outcome_counts["reported"] += n_ok
+                n_exc = int((exc_in & valid).sum())
+                self._outcome_counts["exceptions"] += n_exc
+                self._outcome_counts["rt_sum_ms"] += int(rt[valid].sum())
+                if self._dirty is not None:
+                    self._dirty.setdefault("outcome", set()).update(
+                        int(s) for s in np.unique(slots[valid])
+                    )
+            ns_names, slot_ns = self._ns_snapshot
+        if _TR.ARMED:
+            _TR.record(_TR.OUTCOME, xid=xid, aux=n_ok)
+        if not n_ok:
+            return 0
+        log_cluster("outcome_reported", count=n_ok)
+        # per-namespace fan-out to the timeline + SLO burn planes (host-side
+        # aggregation off the already-validated rows; no device read)
+        from sentinel_tpu.metrics.timeline import timeline as _timeline
+        from sentinel_tpu.trace.slo import slo_plane as _slo_plane
+
+        ns_idx = slot_ns[slots[valid]]
+        rt_ok = rt[valid]
+        exc_ok = exc_in[valid]
+        tl = _timeline()
+        plane = _slo_plane()
+        for ni in np.unique(ns_idx):
+            if ni < 0:
+                continue
+            name = ns_names[int(ni)]
+            m = ns_idx == ni
+            rts = rt_ok[m]
+            n_exc_ns = int(exc_ok[m].sum())
+            tl.record(
+                name, 0, 0, 0, 0,
+                n_complete=int(m.sum()),
+                n_exception=n_exc_ns,
+                rt_sum_ms=float(rts.sum()),
+            )
+            plane.record_completion(name, rts, n_exception=n_exc_ns)
+        return n_ok
+
+    def outcome_stats(self) -> Dict[str, object]:
+        """Host snapshot of the outcome plane: ingest counters (the
+        reconciliation gate's server-side truth) plus per-flow windowed
+        RT/exception reads for the ``sentinel_flow_rt_*`` scrape families.
+        Pulled by the process-wide ``ServerMetrics`` on every scrape."""
+        from sentinel_tpu.engine.state import (
+            N_RT_BUCKETS,
+            OutcomeChannel,
+            RT_BUCKET_UPPER_MS,
+            flow_spec,
+        )
+        from sentinel_tpu.stats import window as W
+
+        with self._lock:
+            c = self._outcome_counts
+            out: Dict[str, object] = {
+                "reported": int(c["reported"]),
+                "exceptions": int(c["exceptions"]),
+                "rt_sum_ms": int(c["rt_sum_ms"]),
+                "batches": int(c["batches"]),
+                "dropped": dict(c["dropped"]),
+            }
+            if not self._index.slot_of:
+                out["flows"] = {}
+                return out
+            now = self._engine_now()
+            spec = flow_spec(self.config)
+            sums = np.asarray(
+                W.window_sum_all(spec, self._state.outcome, jnp.int32(now))
+            )
+            interval_s = spec.interval_ms / 1000.0
+            h0 = int(OutcomeChannel.RT_HIST0)
+            flows: Dict[int, Dict[str, float]] = {}
+            for fid, slot in self._index.slot_of.items():
+                complete = int(sums[slot, OutcomeChannel.COMPLETE])
+                exc = int(sums[slot, OutcomeChannel.EXCEPTION])
+                if not complete and not exc:
+                    continue  # idle flows stay off the scrape surface
+                rt_sum = float(sums[slot, OutcomeChannel.RT_SUM])
+                hist = sums[slot, h0 : h0 + N_RT_BUCKETS]
+                total = int(hist.sum())
+                if total:
+                    target = -(-99 * total // 100)  # ceil(0.99 * total)
+                    b = int(np.searchsorted(np.cumsum(hist), target))
+                    b = min(b, N_RT_BUCKETS - 1)
+                    edge = RT_BUCKET_UPPER_MS[b]
+                    p99 = (
+                        float(edge) if edge != float("inf")
+                        else float((1 << N_RT_BUCKETS) - 1)
+                    )
+                else:
+                    p99 = 0.0
+                flows[int(fid)] = {
+                    "complete_qps": complete / interval_s,
+                    "exception_qps": exc / interval_s,
+                    "rt_avg_ms": rt_sum / complete if complete else 0.0,
+                    "rt_p99_ms": p99,
+                }
+            out["flows"] = flows
             return out

@@ -667,6 +667,10 @@ def _decide_core(
         shaping=ShapingState(
             lpt=lpt_ws, warm_tokens=warm_tokens_ws, warm_filled=warm_filled_ws
         ),
+        # completion outcomes are written by the decoupled outcome step
+        # (engine/outcome.py), never by the admission kernel — the serve
+        # path's donated buffers just flow through
+        outcome=state.outcome,
     )
     verdicts = VerdictBatch(status=status, wait_ms=wait_ms, remaining=remaining)
     return new_state, verdicts

@@ -1,0 +1,107 @@
+"""Fused completion-outcome scatter: the device half of the outcome plane.
+
+Clients report ``(flow, rt_ms, exception)`` completions in batches (the
+OUTCOME_REPORT wire op, piggy-backed on request frames); the token service
+funnels every decoded batch through the donated step built here. One step
+performs a single window roll plus four scatter-adds into the per-flow
+``state.outcome`` window ([F, B, N_OUTCOME_CHANNELS]):
+
+- ``RT_SUM``     += rt_ms          (windowed RT accumulator, "Give Me Some
+                                    Slack"-style sliding measurement)
+- ``COMPLETE``   += 1
+- ``EXCEPTION``  += exception
+- ``RT_HIST0+b`` += 1 where ``b = clip(floor(log2(rt+1)), 0, NB-1)`` — the
+  SALSA-style coarse log2 histogram cell for device-side p99.
+
+The step is deliberately DECOUPLED from the admission kernel: completions
+arrive on their own cadence (whenever a client's next frame carries a
+piggy-backed report), and fusing them into ``decide`` would put a
+data-dependent extra scatter on the serve path's critical step. Instead the
+outcome step donates the full EngineState exactly like ``decide_donating`` —
+the admission windows alias straight through, only ``outcome`` is rewritten —
+so the serve path pays nothing while reporting is idle and the outcome path
+reuses the same buffer-donation discipline.
+
+Rows are pre-validated on the host (see ``TokenService.report_outcomes``:
+negative / non-finite / oversized RTs are dropped and counted before they
+reach the device); the kernel additionally masks ``valid=False`` rows by
+routing them to an out-of-range resource id, which ``mode="drop"`` scatters
+discard — padding rows cost nothing and can never poison a live slot.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.engine.config import EngineConfig
+from sentinel_tpu.engine.state import (
+    EngineState,
+    N_RT_BUCKETS,
+    OutcomeChannel,
+    flow_spec,
+)
+from sentinel_tpu.stats import window as W
+
+
+def rt_bucket(rt_ms: jax.Array) -> jax.Array:
+    """Log2 histogram cell for an RT in ms: ``clip(floor(log2(rt+1)), 0,
+    NB-1)`` — computed with integer bit-length semantics (no float log), so
+    the device and the scalar reference in tests agree bit-exactly."""
+    r = jnp.maximum(jnp.asarray(rt_ms, jnp.int32), 0) + 1  # >= 1
+    # floor(log2(r)) == (bit length of r) - 1; 31 - clz(r) without a clz
+    # primitive: compare against the 31 powers of two reachable by int32.
+    powers = jnp.asarray([1 << k for k in range(1, 31)], jnp.int32)
+    blog = jnp.sum(r[:, None] >= powers[None, :], axis=1).astype(jnp.int32)
+    return jnp.clip(blog, 0, N_RT_BUCKETS - 1)
+
+
+def _outcome_core(
+    config: EngineConfig,
+    state: EngineState,
+    slots: jax.Array,  # int32 [K] rule-slot ids (out-of-range = dropped)
+    rt_ms: jax.Array,  # int32 [K] clamped response times
+    exc: jax.Array,  # int32 [K] 1 = exception, 0 = success
+    valid: jax.Array,  # bool [K]
+    now: jax.Array,  # int32 engine ms
+) -> EngineState:
+    spec = flow_spec(config)
+    k = slots.shape[0]
+    # invalid rows scatter to row F, which mode="drop" discards entirely
+    safe_slot = jnp.where(valid, slots, jnp.int32(config.max_flows))
+    ones = jnp.ones((k,), jnp.int32)
+    rows = jnp.stack(
+        [
+            jnp.asarray(rt_ms, jnp.int32),
+            ones,
+            jnp.asarray(exc, jnp.int32),
+        ],
+        axis=1,
+    )
+    ws = W.add_event_rows(
+        spec, state.outcome, now, safe_slot, rows,
+        channels=(
+            int(OutcomeChannel.RT_SUM),
+            int(OutcomeChannel.COMPLETE),
+            int(OutcomeChannel.EXCEPTION),
+        ),
+    )
+    # histogram cell: one extra scatter with a traced channel id (the roll
+    # inside add_events is a no-op — the slot was refreshed just above)
+    ws = W.add_events(
+        spec, ws, now,
+        resource_ids=safe_slot,
+        channel_ids=int(OutcomeChannel.RT_HIST0) + rt_bucket(rt_ms),
+        values=ones,
+    )
+    return state._replace(outcome=ws)
+
+
+def outcome_step_donating(config: EngineConfig):
+    """Build the jitted donated step ``(state, slots, rt, exc, valid, now)
+    -> state'``. The full EngineState is donated (the admission windows
+    alias through untouched), mirroring ``decide_donating``'s contract:
+    the caller's lock must make the passed state the only live reference."""
+    return jax.jit(partial(_outcome_core, config), donate_argnums=(0,))

@@ -279,9 +279,12 @@ def drain_pending_clear(index: RuleIndex, state) -> "object":
         warm_tokens=shaping.warm_tokens.at[idx].set(0.0),
         warm_filled=shaping.warm_filled.at[idx].set(NEVER),
     )
+    # a reused slot must not inherit the removed flow's completion history
+    outcome_counts = state.outcome.counts.at[idx].set(0)
     return EngineState(
         flow=WindowState(starts=state.flow.starts, counts=flow_counts),
         occupy=WindowState(starts=state.occupy.starts, counts=occupy_counts),
         ns=state.ns,
         shaping=shaping,
+        outcome=WindowState(starts=state.outcome.starts, counts=outcome_counts),
     )

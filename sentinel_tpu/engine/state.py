@@ -46,6 +46,41 @@ class ClusterEvent(enum.IntEnum):
 N_CLUSTER_EVENTS = len(ClusterEvent)
 
 
+class OutcomeChannel(enum.IntEnum):
+    """Completion-outcome channels of the per-flow outcome window.
+
+    The reference's ``MetricBucket`` records four event classes per bucket
+    (pass/block/success/RT + exception, ``MetricBucket.java``); the admission
+    half lives in :class:`ClusterEvent`, and these columns are the completion
+    half, fed by the batched OUTCOME_REPORT wire op. ``RT_SUM`` accumulates
+    milliseconds (int32 — reports are clamp-validated at the wire boundary so
+    a bucket cannot overflow), ``COMPLETE`` / ``EXCEPTION`` count completions.
+    Channels ``RT_HIST0 .. RT_HIST0 + N_RT_BUCKETS - 1`` are a coarse
+    log2-bucketed RT histogram (SALSA-style compact cells, arXiv:2102.12531):
+    a completion with RT ``r`` ms lands in bucket
+    ``clip(floor(log2(r + 1)), 0, N_RT_BUCKETS - 1)``, so bucket ``j`` spans
+    ``[2^j - 1, 2^(j+1) - 1)`` ms and the last bucket absorbs the tail. That
+    is enough resolution for a device-side p99 read without per-flow sketch
+    state."""
+
+    RT_SUM = 0
+    COMPLETE = 1
+    EXCEPTION = 2
+    RT_HIST0 = 3
+
+
+# log2 RT histogram cells; bucket 11 spans [2047, inf) ms. Upper edges are
+# 2^(j+1) - 1 ms (see OutcomeChannel docstring).
+N_RT_BUCKETS = 12
+N_OUTCOME_CHANNELS = int(OutcomeChannel.RT_HIST0) + N_RT_BUCKETS
+
+# Upper edge (ms, inclusive-exclusive) of each RT histogram bucket; the last
+# bucket is open-ended. Host-side p99 reads walk this table.
+RT_BUCKET_UPPER_MS = tuple(
+    (1 << (j + 1)) - 1 for j in range(N_RT_BUCKETS - 1)
+) + (float("inf"),)
+
+
 class ShapingState(NamedTuple):
     """Per-flow traffic-shaper clocks (the mutable halves of the reference's
     ``RateLimiterController.latestPassedTime`` and ``WarmUpController``'s
@@ -64,6 +99,7 @@ class EngineState(NamedTuple):
     occupy: WindowState  # [F, B, 1] future (borrowed) windows
     ns: WindowState  # [NS, B, 1] namespace request qps guard
     shaping: ShapingState  # [F] per-flow shaper clocks
+    outcome: WindowState  # [F, B, N_OUTCOME_CHANNELS] completion outcomes
 
 
 def flow_spec(config: EngineConfig) -> WindowSpec:
@@ -85,4 +121,5 @@ def make_state(config: EngineConfig) -> EngineState:
         occupy=make_window(spec, config.max_flows, 1),
         ns=make_window(spec, config.max_namespaces, 1),
         shaping=make_shaping(config.max_flows),
+        outcome=make_window(spec, config.max_flows, N_OUTCOME_CHANNELS),
     )

@@ -81,6 +81,9 @@ _ARRAY_KEYS = frozenset(
         "param_slim",  # SF slim-twin rows: the param payload when slim is on
         # shaper clocks (raw engine-ms, same dirty-row keying as flow_counts)
         "shaping_lpt", "shaping_warm_tokens", "shaping_warm_filled",
+        # completion-outcome columns (own dirty set: reporting cadence is
+        # decoupled from the admission windows')
+        "outcome_starts", "outcome_counts",
     }
 )
 

@@ -116,6 +116,12 @@ def encode_snapshot(state: Dict[str, object]) -> Dict[str, object]:
             {"shaping": _enc_win(state["shaping"])}
             if "shaping" in state else {}
         ),
+        # per-flow completion-outcome columns (absent in pre-outcome
+        # snapshots; the importer then starts those columns cold)
+        **(
+            {"outcome": _enc_win(state["outcome"])}
+            if "outcome" in state else {}
+        ),
         # hierarchy-coordinator ledger piggyback (already JSON-safe; absent
         # when no coordinator is co-located with this pod)
         **({"hier": state["hier"]} if "hier" in state else {}),
@@ -160,6 +166,10 @@ def decode_snapshot(doc: Dict[str, object]) -> Dict[str, object]:
         **(
             {"shaping": _dec_win(doc["shaping"])}
             if "shaping" in doc else {}
+        ),
+        **(
+            {"outcome": _dec_win(doc["outcome"])}
+            if "outcome" in doc else {}
         ),
         **({"hier": doc["hier"]} if "hier" in doc else {}),
     }

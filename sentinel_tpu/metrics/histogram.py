@@ -154,17 +154,20 @@ class LatencyHistogram:
 
     # -- Prometheus exposition ----------------------------------------------
     def render_prometheus(
-        self, name: str, help_text: str, labels: str = ""
+        self, name: str, help_text: str, labels: str = "",
+        header: bool = True,
     ) -> str:
         """0.0.4 ``histogram`` exposition: cumulative ``_bucket{le=...}``
         series + ``_sum`` / ``_count``. ``labels`` is a pre-rendered
-        ``key="value"`` list (no braces) merged with the ``le`` label."""
+        ``key="value"`` list (no braces) merged with the ``le`` label.
+        Pass ``header=False`` from the second labelled instance of a
+        family on — the text format allows one HELP/TYPE per family."""
         counts, total, s, _vmax = self._frozen()
         sep = "," if labels else ""
         lines = [
             f"# HELP {name} {help_text}",
             f"# TYPE {name} histogram",
-        ]
+        ] if header else []
         cum = 0
         for bound, c in zip(self.bounds, counts):
             cum += c

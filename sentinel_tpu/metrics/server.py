@@ -161,6 +161,12 @@ class ServerMetrics:
         self._lease_lock = threading.Lock()
         self._hier_provider: Optional[Callable[[], dict]] = None
         self._hier_lock = threading.Lock()
+        # wire-rev-6 outcome observability: the live token service registers
+        # a zero-arg provider returning its outcome_stats() block (reported/
+        # exception/drop counters + per-flow windowed RT reads off the device
+        # outcome columns). Same most-recent-wins weakref model as the rest.
+        self._outcome_provider: Optional[Callable[[], dict]] = None
+        self._outcome_lock = threading.Lock()
 
     # -- fused dispatch counters --------------------------------------------
     def record_fused(self, depth: int) -> None:
@@ -469,6 +475,27 @@ class ServerMetrics:
         except Exception:
             return {}  # a torn-down service's reader must not 500 a scrape
 
+    # -- outcome provider ---------------------------------------------------
+    def register_outcome_provider(self, fn: Callable[[], dict]) -> None:
+        """Install the zero-arg reader for the token service's completion
+        outcome stats (``DefaultTokenService.outcome_stats`` shape:
+        cumulative reported/exception/drop counters plus per-flow windowed
+        complete/exception QPS and RT avg/p99 read from the device outcome
+        columns). Most recent registration wins; providers return ``{}``
+        once their service is gone."""
+        with self._outcome_lock:
+            self._outcome_provider = fn
+
+    def outcome_stats(self) -> dict:
+        with self._outcome_lock:
+            fn = self._outcome_provider
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:
+            return {}  # a torn-down service's reader must not 500 a scrape
+
     # -- snapshots ----------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON shape served by the ``clusterServerStats`` command — the
@@ -492,6 +519,7 @@ class ServerMetrics:
             "shm": self.shm_stats(),
             "lease": self.lease_stats(),
             "hier": self.hier_stats(),
+            "outcome": self.outcome_stats(),
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -718,6 +746,63 @@ class ServerMetrics:
                         f'sentinel_hier_share_tokens{{flow="{fid}"}} '
                         f"{int(shares[fid] or 0)}"
                     )
+        outcome = self.outcome_stats()
+        for mname, skey, help_text in (
+            ("sentinel_outcome_reported_total", "reported",
+             "Completion outcomes accepted into the device outcome columns "
+             "(OUTCOME_REPORT rows past validation) (cumulative)."),
+            ("sentinel_outcome_exceptions_total", "exceptions",
+             "Accepted completion outcomes flagged as exceptions "
+             "(cumulative)."),
+            ("sentinel_outcome_batches_total", "batches",
+             "OUTCOME_REPORT batches ingested (cumulative)."),
+            ("sentinel_outcome_rt_sum_ms_total", "rt_sum_ms",
+             "Sum of accepted reported response times (ms, cumulative) — "
+             "divide rates for the fleet RT average."),
+        ):
+            lines.append(f"# HELP {mname} {help_text}")
+            lines.append(f"# TYPE {mname} counter")
+            lines.append(f"{mname} {int(outcome.get(skey, 0) or 0)}")
+        lines.append(
+            "# HELP sentinel_outcome_dropped_total Reported outcomes "
+            "rejected at the wire boundary, by reason (negative / "
+            "non_finite / too_large / unknown_flow) (cumulative)."
+        )
+        lines.append("# TYPE sentinel_outcome_dropped_total counter")
+        dropped = outcome.get("dropped") or {}
+        if dropped:
+            for reason, count in sorted(dropped.items()):
+                lines.append(
+                    "sentinel_outcome_dropped_total"
+                    f'{{reason="{_escape(str(reason))}"}} {int(count)}'
+                )
+        else:
+            # zero-sample so the series exists before the first bad report
+            lines.append(
+                'sentinel_outcome_dropped_total{reason="negative"} 0'
+            )
+        flows = outcome.get("flows") or {}
+        if flows:
+            for mname, fkey, help_text in (
+                ("sentinel_flow_complete_qps", "complete_qps",
+                 "Windowed reported completions per second, per flow "
+                 "(device outcome columns)."),
+                ("sentinel_flow_exception_qps", "exception_qps",
+                 "Windowed reported exceptions per second, per flow."),
+                ("sentinel_flow_rt_avg_ms", "rt_avg_ms",
+                 "Windowed average reported RT per flow (ms)."),
+                ("sentinel_flow_rt_p99_ms", "rt_p99_ms",
+                 "Windowed p99 reported RT per flow (ms), from the "
+                 "device-side log2 RT histogram (bucket upper edge)."),
+            ):
+                lines.append(f"# HELP {mname} {help_text}")
+                lines.append(f"# TYPE {mname} gauge")
+                for fid in sorted(flows, key=int):
+                    vals = flows[fid] or {}
+                    lines.append(
+                        f'{mname}{{flow_id="{int(fid)}"}} '
+                        f"{float(vals.get(fkey, 0.0) or 0.0):g}"
+                    )
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -803,6 +888,8 @@ class ServerMetrics:
             self._lease_provider = None
         with self._hier_lock:
             self._hier_provider = None
+        with self._outcome_lock:
+            self._outcome_provider = None
         self._rate.reset()
 
 

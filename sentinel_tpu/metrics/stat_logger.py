@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import os
 import threading
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from sentinel_tpu.core import clock as _clock
@@ -226,6 +227,84 @@ def _writer_drain_barrier(timeout_s: float = 5.0) -> None:
     except _queue_mod.Full:
         return  # writer is wedged; don't hang shutdown on it
     done.wait(timeout_s)
+
+
+@dataclass
+class StatEntry:
+    """One parsed stat-log line (``ts|key1,key2|count[,total]``)."""
+
+    timestamp_ms: int
+    key: Tuple[str, ...]
+    count: int
+    total: Optional[float] = None
+
+    @classmethod
+    def from_line(cls, line: str) -> "StatEntry":
+        ts_s, joined, tail = line.rstrip("\n").split("|", 2)
+        if "," in tail:
+            count_s, total_s = tail.split(",", 1)
+            return cls(int(ts_s), tuple(joined.split(",")),
+                       int(count_s), float(total_s))
+        return cls(int(ts_s), tuple(joined.split(",")), int(tail))
+
+
+class StatLogSearcher:
+    """Time-range search over one stat log's rotation chain.
+
+    The complement ``RollingFileWriter`` lacks: reads ``<path>.N`` …
+    ``<path>.1`` then ``<path>`` (oldest backup first — ``_roll`` shifts
+    upward, so higher suffix = older data) and yields entries whose
+    window start falls in ``[begin_ms, end_ms]``. Mirrors what
+    ``MetricSearcher`` does for the per-resource metric log, minus the
+    ``.idx`` seek: stat files are written a whole sealed window at a
+    time, so a linear scan is the honest cost model.
+    """
+
+    def __init__(self, path: str, max_backups: int = 3):
+        self.path = path
+        self.max_backups = max_backups
+
+    def _chain(self) -> List[str]:
+        paths = [f"{self.path}.{i}"
+                 for i in range(self.max_backups, 0, -1)]
+        paths.append(self.path)
+        return [p for p in paths if os.path.exists(p)]
+
+    def find(self, begin_ms: int, end_ms: int,
+             key_prefix: Optional[Tuple[str, ...]] = None,
+             max_lines: int = 12_000) -> List[StatEntry]:
+        out: List[StatEntry] = []
+        for path in self._chain():
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            entry = StatEntry.from_line(line)
+                        except (ValueError, IndexError):
+                            continue  # torn tail from a crash mid-append
+                        if not begin_ms <= entry.timestamp_ms <= end_ms:
+                            continue
+                        if key_prefix and \
+                                entry.key[:len(key_prefix)] != key_prefix:
+                            continue
+                        out.append(entry)
+                        if len(out) >= max_lines:
+                            return out
+            except OSError:
+                continue
+        return out
+
+
+def search_stat_log(name: str, begin_ms: int, end_ms: int,
+                    key_prefix: Optional[Tuple[str, ...]] = None,
+                    log_dir: Optional[str] = None,
+                    max_backups: int = 3) -> List[StatEntry]:
+    """Range-search a named stat log (e.g. ``CLUSTER_LOG`` for the
+    ``outcome_reported`` lines) without needing the live logger."""
+    log_dir = log_dir or default_stat_log_dir()
+    return StatLogSearcher(
+        os.path.join(log_dir, f"{name}.log"), max_backups=max_backups
+    ).find(begin_ms, end_ms, key_prefix=key_prefix)
 
 
 _registry_lock = threading.Lock()

@@ -63,6 +63,9 @@ class TimelineSample:
     p99_ms: Optional[float] = None
     max_ms: Optional[float] = None
     waited: int = 0  # SHOULD_WAIT: delayed admissions (pacing / occupy)
+    completed: int = 0  # reported completions landing this second
+    exceptions: int = 0  # completions that ended in a business exception
+    rt_sum_ms: int = 0  # summed completion RT (avg = rt_sum_ms / completed)
 
     def to_line(self) -> str:
         ts = self.timestamp_ms // 1000 * 1000
@@ -70,10 +73,12 @@ class TimelineSample:
         p99 = -1.0 if self.p99_ms is None else self.p99_ms
         mx = -1.0 if self.max_ms is None else self.max_ms
         # waited rides as a 9th field so pre-shaping readers (8-field
-        # parsers) keep working on new files
+        # parsers) keep working on new files; the outcome columns
+        # (completed/exceptions/rt_sum) ride as fields 10-12 the same way
         return (
             f"{ts}|{ns}|{self.passed}|{self.blocked}|{self.shed}|"
-            f"{self.other}|{p99:g}|{mx:g}|{self.waited}"
+            f"{self.other}|{p99:g}|{mx:g}|{self.waited}|"
+            f"{self.completed}|{self.exceptions}|{self.rt_sum_ms}"
         )
 
     @classmethod
@@ -91,6 +96,9 @@ class TimelineSample:
             p99_ms=None if p99 < 0 else p99,
             max_ms=None if mx < 0 else mx,
             waited=int(p[8]) if len(p) > 8 else 0,
+            completed=int(p[9]) if len(p) > 9 else 0,
+            exceptions=int(p[10]) if len(p) > 10 else 0,
+            rt_sum_ms=int(p[11]) if len(p) > 11 else 0,
         )
 
     def as_dict(self) -> dict:
@@ -104,6 +112,12 @@ class TimelineSample:
             "waited": self.waited,
             "p99Ms": self.p99_ms,
             "maxMs": self.max_ms,
+            "completed": self.completed,
+            "exceptions": self.exceptions,
+            "rtSumMs": self.rt_sum_ms,
+            "rtAvgMs": (
+                self.rt_sum_ms / self.completed if self.completed else None
+            ),
         }
 
 
@@ -117,8 +131,9 @@ class _NsRing:
     def __init__(self, window_s: int):
         self.window_s = window_s
         self.stamp = np.zeros(window_s, np.int64)
-        # columns: pass, block, shed, other, waited
-        self.counts = np.zeros((window_s, 5), np.int64)
+        # columns: pass, block, shed, other, waited, completed, exceptions,
+        # rt_sum_ms
+        self.counts = np.zeros((window_s, 8), np.int64)
         self.lat = np.zeros((window_s, _N_LAT + 1), np.int64)
         self.lat_max = np.zeros(window_s, np.float64)
 
@@ -153,6 +168,9 @@ class _NsRing:
             p99_ms=p99,
             max_ms=mx,
             waited=int(c[4]),
+            completed=int(c[5]),
+            exceptions=int(c[6]),
+            rt_sum_ms=int(c[7]),
         )
 
 
@@ -179,16 +197,23 @@ class MetricTimeline:
                latency_ms: Optional[float] = None,
                lat_n: Optional[int] = None,
                now_s: Optional[int] = None,
-               n_waited: int = 0) -> None:
+               n_waited: int = 0,
+               n_complete: int = 0,
+               n_exception: int = 0,
+               rt_sum_ms: float = 0.0) -> None:
         """Fold one verdict-batch contribution for ``namespace`` into the
         current second. ``latency_ms`` is the batch's shared decision
         latency, applied to ``lat_n`` rows (default: the served rows of
         this call — pass + block + other + waited; sheds never reached a
         device step so they carry no latency). ``n_waited`` counts
         SHOULD_WAIT verdicts — served-with-delay (pacing / priority
-        occupy), their own column so shaping is visible per second."""
+        occupy), their own column so shaping is visible per second.
+        ``n_complete``/``n_exception``/``rt_sum_ms`` fold a batched
+        completion report (the rev-6 outcome plane) into the second the
+        report LANDED — the admission columns describe the decision path,
+        these describe what happened after."""
         if (n_pass <= 0 and n_block <= 0 and n_shed <= 0 and n_other <= 0
-                and n_waited <= 0):
+                and n_waited <= 0 and n_complete <= 0 and n_exception <= 0):
             return
         sec = int(now_s if now_s is not None else time.time())
         with self._lock:
@@ -202,6 +227,9 @@ class MetricTimeline:
             c[2] += max(0, n_shed)
             c[3] += max(0, n_other)
             c[4] += max(0, n_waited)
+            c[5] += max(0, n_complete)
+            c[6] += max(0, n_exception)
+            c[7] += max(0, int(rt_sum_ms))
             if latency_ms is not None:
                 if lat_n is None:
                     lat_n = (max(0, n_pass) + max(0, n_block)

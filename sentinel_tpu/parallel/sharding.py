@@ -66,6 +66,7 @@ def _state_specs(axis: str) -> EngineState:
         shaping=ShapingState(
             lpt=P(axis), warm_tokens=P(axis), warm_filled=P(axis)
         ),
+        outcome=WindowState(starts=P(), counts=P(axis)),
     )
 
 

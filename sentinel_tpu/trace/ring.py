@@ -51,6 +51,7 @@ MOVE = 12        # MOVE begin/commit/abort (aux = phase: 0/1/2)
 PROMOTE = 13     # standby promoted to primary
 BROWNOUT = 14    # admission ladder escalated (aux = level)
 SHM_POLL = 15    # shm ring door poll/doorbell activity (aux = frames)
+OUTCOME = 16     # batched completion report ingested (aux = rows accepted)
 
 STAGE_NAMES: Dict[int, str] = {
     CLIENT_IN: "client_in",
@@ -68,6 +69,7 @@ STAGE_NAMES: Dict[int, str] = {
     PROMOTE: "promote",
     BROWNOUT: "brownout",
     SHM_POLL: "shm_poll",
+    OUTCOME: "outcome",
 }
 
 # one ring row: 24 bytes, fixed

@@ -13,7 +13,12 @@ say WHICH tenant ate the budget. This plane keys everything by namespace:
   distinguish a transient spike from a sustained bleed,
 - per-tenant **shed/over-admission attribution**: refusals (OVERLOAD,
   brownout sheds, too_many_request) counted per namespace, so "who got
-  shed" and "who caused the shedding" are answerable separately.
+  shed" and "who caused the shedding" are answerable separately,
+- per-tenant **completion outcomes** (wire-rev-6 OUTCOME_REPORT): reported
+  response times feed a second histogram + burn-rate pair against the RT
+  objective (``sentinel.tpu.slo.rt.p99.ms``, default 100.0) — the
+  latency-burn SLO window over what the protected dependency actually
+  served, not just how fast the verdict was — plus exception counts.
 
 Surfaced through the Prometheus exporter (``sentinel_slo_*``),
 ``clusterServerStats`` (``slo`` block), black-box dumps, and
@@ -30,6 +35,10 @@ from typing import Dict, Iterable, List, Optional
 from sentinel_tpu.metrics.histogram import LatencyHistogram
 
 KEY_OBJECTIVE_MS = "sentinel.tpu.slo.p99.ms"
+# completion-RT objective: the p99 bound on what protected calls REPORT
+# back (OUTCOME_REPORT rt_ms), as opposed to the decision-latency objective
+# above which bounds the admission verdict itself
+KEY_RT_OBJECTIVE_MS = "sentinel.tpu.slo.rt.p99.ms"
 # the p99 objective tolerates 1% of requests over the bound — that 1% IS
 # the error budget the burn rate is measured against
 BUDGET_FRACTION = 0.01
@@ -72,7 +81,8 @@ class _BurnWindow:
 
 
 class _Tenant:
-    __slots__ = ("hist", "windows", "shed", "waited")
+    __slots__ = ("hist", "windows", "shed", "waited",
+                 "rt_hist", "rt_windows", "completed", "exceptions")
 
     def __init__(self):
         # decision latency in ms; log buckets fine enough to resolve a
@@ -84,6 +94,12 @@ class _Tenant:
         # occupy) — counted separately from sheds because the request WAS
         # admitted; a paced tenant is shaped, not failing
         self.waited = 0
+        # reported completion RT (OUTCOME_REPORT): wider range than the
+        # decision histogram — a protected dependency can take seconds
+        self.rt_hist = LatencyHistogram(lo=0.1, hi=100_000.0, per_decade=5)
+        self.rt_windows = {name: _BurnWindow(s) for name, s in _WINDOWS}
+        self.completed = 0
+        self.exceptions = 0
 
 
 class SloPlane:
@@ -91,12 +107,18 @@ class SloPlane:
     recording path is one dict lookup + histogram record + two window
     adds per (namespace, batch)."""
 
-    def __init__(self, objective_ms: Optional[float] = None):
-        if objective_ms is None:
-            from sentinel_tpu.core.config import SentinelConfig
+    def __init__(self, objective_ms: Optional[float] = None,
+                 rt_objective_ms: Optional[float] = None):
+        from sentinel_tpu.core.config import SentinelConfig
 
+        if objective_ms is None:
             objective_ms = SentinelConfig.get_float(KEY_OBJECTIVE_MS, 2.0)
+        if rt_objective_ms is None:
+            rt_objective_ms = SentinelConfig.get_float(
+                KEY_RT_OBJECTIVE_MS, 100.0
+            )
         self.objective_ms = float(objective_ms)
+        self.rt_objective_ms = float(rt_objective_ms)
         self._lock = threading.Lock()
         self._tenants: Dict[str, _Tenant] = {}
 
@@ -131,6 +153,32 @@ class SloPlane:
         t = self._tenant(namespace)
         with self._lock:
             t.waited += n
+
+    def record_completion(self, namespace: str, rts, n_exception: int = 0,
+                          now_s: Optional[int] = None) -> None:
+        """A batch of reported completions for this tenant: ``rts`` is an
+        array-like of response times in ms (already validated/clamped at
+        the wire boundary). Feeds the RT histogram and the latency-burn
+        windows against ``rt_objective_ms``; exceptions are counted but do
+        NOT burn the RT budget twice (an exception's RT is still a real
+        observation of the dependency)."""
+        import numpy as np
+
+        r = np.asarray(rts, dtype=np.float64)
+        n = int(r.shape[0])
+        if n == 0 and n_exception <= 0:
+            return
+        t = self._tenant(namespace)
+        if n:
+            # batches repeat few distinct RTs (whole ms); record grouped
+            for v, c in zip(*np.unique(r, return_counts=True)):
+                t.rt_hist.record(float(v), int(c))
+            over = int((r > self.rt_objective_ms).sum())
+            for w in t.rt_windows.values():
+                w.record(n, over, now_s)
+        with self._lock:
+            t.completed += n
+            t.exceptions += max(0, int(n_exception))
 
     def record_shed(self, namespace: str, reason: str, n: int = 1) -> None:
         """n rows refused for this tenant (OVERLOAD verdicts, brownout
@@ -199,6 +247,16 @@ class SloPlane:
                     round((over / total) / BUDGET_FRACTION, 4)
                     if total else None
                 )
+            rh = t.rt_hist.snapshot()
+            rt_rates = {}
+            rt_windows = {}
+            for name, _s in _WINDOWS:
+                total, over = t.rt_windows[name].totals()
+                rt_windows[name] = {"total": total, "over": over}
+                rt_rates[name] = (
+                    round((over / total) / BUDGET_FRACTION, 4)
+                    if total else None
+                )
             tenants[ns] = {
                 "count": h["count"],
                 "p50Ms": h["p50"],
@@ -208,8 +266,19 @@ class SloPlane:
                 "windows": windows,
                 "shed": dict(t.shed),
                 "waited": int(t.waited),
+                "completed": int(t.completed),
+                "exceptions": int(t.exceptions),
+                "rtP50Ms": rh["p50"],
+                "rtP99Ms": rh["p99"],
+                "rtMaxMs": rh["max"],
+                "rtBurnRate": rt_rates,
+                "rtWindows": rt_windows,
             }
-        return {"objectiveMs": self.objective_ms, "tenants": tenants}
+        return {
+            "objectiveMs": self.objective_ms,
+            "rtObjectiveMs": self.rt_objective_ms,
+            "tenants": tenants,
+        }
 
     def render(self) -> str:
         """Prometheus 0.0.4 exposition of the whole plane."""
@@ -218,19 +287,37 @@ class SloPlane:
             "latency objective.",
             "# TYPE sentinel_slo_objective_ms gauge",
             f"sentinel_slo_objective_ms {self.objective_ms:g}",
+            "# HELP sentinel_slo_rt_objective_ms Configured per-tenant p99 "
+            "objective on reported completion RT.",
+            "# TYPE sentinel_slo_rt_objective_ms gauge",
+            f"sentinel_slo_rt_objective_ms {self.rt_objective_ms:g}",
         ]
         with self._lock:
             names = sorted(self._tenants)
-        for ns in names:
+        for i, ns in enumerate(names):
             t = self._tenants[ns]
             lines.append(t.hist.render_prometheus(
                 "sentinel_slo_latency_ms",
                 "Per-tenant decision latency (enqueue to verdict).",
                 labels=f'namespace="{_escape(ns)}"',
+                header=(i == 0),  # one HELP/TYPE per family, not per tenant
             ))
+        first = True
+        for ns in names:
+            t = self._tenants[ns]
+            if t.rt_hist.count:
+                lines.append(t.rt_hist.render_prometheus(
+                    "sentinel_slo_rt_ms",
+                    "Per-tenant reported completion RT (OUTCOME_REPORT).",
+                    labels=f'namespace="{_escape(ns)}"',
+                    header=first,
+                ))
+                first = False
         burn_lines: List[str] = []
+        rt_burn_lines: List[str] = []
         shed_lines: List[str] = []
         waited_lines: List[str] = []
+        exc_lines: List[str] = []
         for ns in names:
             t = self._tenants[ns]
             for name, _s in _WINDOWS:
@@ -239,6 +326,14 @@ class SloPlane:
                     rate = (over / total) / BUDGET_FRACTION
                     burn_lines.append(
                         f'sentinel_slo_burn_rate{{namespace="{_escape(ns)}"'
+                        f',window="{name}"}} {rate:g}'
+                    )
+                total, over = t.rt_windows[name].totals()
+                if total:
+                    rate = (over / total) / BUDGET_FRACTION
+                    rt_burn_lines.append(
+                        f'sentinel_slo_rt_burn_rate'
+                        f'{{namespace="{_escape(ns)}"'
                         f',window="{name}"}} {rate:g}'
                     )
             for reason, n in sorted(t.shed.items()):
@@ -251,6 +346,11 @@ class SloPlane:
                     f'sentinel_slo_waited_total{{namespace="{_escape(ns)}"'
                     f'}} {t.waited}'
                 )
+            if t.exceptions:
+                exc_lines.append(
+                    f'sentinel_slo_exceptions_total'
+                    f'{{namespace="{_escape(ns)}"}} {t.exceptions}'
+                )
         if burn_lines:
             lines.append(
                 "# HELP sentinel_slo_burn_rate Error-budget burn vs the "
@@ -258,6 +358,14 @@ class SloPlane:
             )
             lines.append("# TYPE sentinel_slo_burn_rate gauge")
             lines.extend(burn_lines)
+        if rt_burn_lines:
+            lines.append(
+                "# HELP sentinel_slo_rt_burn_rate Error-budget burn of "
+                "reported completion RT vs the RT objective "
+                "(1.0 = sustainable)."
+            )
+            lines.append("# TYPE sentinel_slo_rt_burn_rate gauge")
+            lines.extend(rt_burn_lines)
         if shed_lines:
             lines.append(
                 "# HELP sentinel_slo_shed_total Refused rows attributed "
@@ -272,6 +380,13 @@ class SloPlane:
             )
             lines.append("# TYPE sentinel_slo_waited_total counter")
             lines.extend(waited_lines)
+        if exc_lines:
+            lines.append(
+                "# HELP sentinel_slo_exceptions_total Reported completion "
+                "exceptions per tenant (OUTCOME_REPORT exc flag)."
+            )
+            lines.append("# TYPE sentinel_slo_exceptions_total counter")
+            lines.extend(exc_lines)
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -294,6 +409,7 @@ def merge_fleet(snapshots: Iterable[dict]) -> dict:
     conservative bound is the honest one). Malformed pod payloads
     contribute nothing, mirroring aggregate_snapshots' fault contract."""
     objective = None
+    rt_objective = None
     tenants: Dict[str, dict] = {}
     for snap in snapshots:
         try:
@@ -301,23 +417,33 @@ def merge_fleet(snapshots: Iterable[dict]) -> dict:
                 snap = snap()
             if objective is None:
                 objective = snap.get("objectiveMs")
+            if rt_objective is None:
+                rt_objective = snap.get("rtObjectiveMs")
             for ns, t in snap.get("tenants", {}).items():
                 agg = tenants.setdefault(ns, {
                     "count": 0, "p99Ms": None, "windows": {
                         name: {"total": 0, "over": 0} for name, _s in _WINDOWS
                     }, "shed": {}, "waited": 0,
+                    "completed": 0, "exceptions": 0, "rtP99Ms": None,
+                    "rtWindows": {
+                        name: {"total": 0, "over": 0} for name, _s in _WINDOWS
+                    },
                 })
                 agg["count"] += int(t.get("count", 0))
                 agg["waited"] += int(t.get("waited", 0))
-                p99 = t.get("p99Ms")
-                if p99 is not None and (
-                    agg["p99Ms"] is None or p99 > agg["p99Ms"]
-                ):
-                    agg["p99Ms"] = p99
-                for name, _s in _WINDOWS:
-                    w = t.get("windows", {}).get(name, {})
-                    agg["windows"][name]["total"] += int(w.get("total", 0))
-                    agg["windows"][name]["over"] += int(w.get("over", 0))
+                agg["completed"] += int(t.get("completed", 0))
+                agg["exceptions"] += int(t.get("exceptions", 0))
+                for key in ("p99Ms", "rtP99Ms"):
+                    v = t.get(key)
+                    if v is not None and (
+                        agg[key] is None or v > agg[key]
+                    ):
+                        agg[key] = v
+                for wkey in ("windows", "rtWindows"):
+                    for name, _s in _WINDOWS:
+                        w = t.get(wkey, {}).get(name, {})
+                        agg[wkey][name]["total"] += int(w.get("total", 0))
+                        agg[wkey][name]["over"] += int(w.get("over", 0))
                 for reason, n in t.get("shed", {}).items():
                     agg["shed"][reason] = agg["shed"].get(reason, 0) + int(n)
         except Exception:
@@ -325,15 +451,21 @@ def merge_fleet(snapshots: Iterable[dict]) -> dict:
 
             record_log.exception("fleet SLO merge: pod snapshot dropped")
     for agg in tenants.values():
-        rates = {}
-        for name, _s in _WINDOWS:
-            w = agg["windows"][name]
-            rates[name] = (
-                round((w["over"] / w["total"]) / BUDGET_FRACTION, 4)
-                if w["total"] else None
-            )
-        agg["burnRate"] = rates
-    return {"objectiveMs": objective, "tenants": tenants}
+        for wkey, rkey in (("windows", "burnRate"),
+                           ("rtWindows", "rtBurnRate")):
+            rates = {}
+            for name, _s in _WINDOWS:
+                w = agg[wkey][name]
+                rates[name] = (
+                    round((w["over"] / w["total"]) / BUDGET_FRACTION, 4)
+                    if w["total"] else None
+                )
+            agg[rkey] = rates
+    return {
+        "objectiveMs": objective,
+        "rtObjectiveMs": rt_objective,
+        "tenants": tenants,
+    }
 
 
 # -- singleton ----------------------------------------------------------------

@@ -161,6 +161,27 @@ class TestDocCrossLinks:
     def test_doc_covers_trace_surface(self, needle):
         assert needle in _doc_text()
 
+    @pytest.mark.parametrize("needle", [
+        # the wire op and its validation taxonomy
+        "OUTCOME_REPORT",
+        "`sentinel_outcome_dropped_total`",
+        "`unknown_flow`",
+        # the device columns and their reads
+        "`sentinel_flow_rt_p99_ms`",
+        "`sentinel_flow_exception_qps`",
+        # the RT-objective half of the SLO plane
+        "sentinel.tpu.slo.rt.p99.ms",
+        "`sentinel_slo_rt_burn_rate`",
+        # rotating-log search surface
+        "search_stat_log",
+        # the reconciliation gate and its runners
+        "tests/test_outcome.py",
+        "examples/outcome_demo.py",
+        "`outcome-smoke`",
+    ])
+    def test_doc_covers_outcome_surface(self, needle):
+        assert needle in _doc_text()
+
 
 class TestShapingDocSync:
     """docs/SHAPING.md ↔ kernel sync: the doc carries the queue-cap math

@@ -28,6 +28,7 @@ FAST_EXAMPLES = [
     "mesh_sharded_server.py",
     "warmup_demo.py",
     "pacing_demo.py",
+    "outcome_demo.py",
 ]
 
 
@@ -43,6 +44,17 @@ def test_example_runs(script):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout.strip(), "example produced no output"
+
+
+def test_outcome_demo_moves_the_rt_gauge():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "outcome_demo.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+    ).stdout
+    assert "outcome loop closed" in out
+    assert "'negative': 1" in out  # the bogus report was validated away
+    assert "extra RPCs: 0" in out
 
 
 def test_pacing_demo_spreads_the_burst():

@@ -179,3 +179,158 @@ class TestBuildInfoAndSloSeries:
         assert "sentinel_slo_objective_ms" in text
         assert "sentinel_slo_burn_rate" not in text
         assert "sentinel_slo_shed_total" not in text
+
+
+_SAMPLE_RE = None  # compiled lazily in _parse_exposition
+
+
+def _parse_exposition(text):
+    """Parse a 0.0.4 text exposition into (helps, types, samples).
+
+    Asserts the structural invariants a strict scraper enforces as it
+    goes: at most one HELP and one TYPE line per family, TYPE naming a
+    known kind, every sample line shaped ``name{labels} value``.
+    """
+    import re
+
+    global _SAMPLE_RE
+    if _SAMPLE_RE is None:
+        _SAMPLE_RE = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"      # metric name
+            r"(\{[^{}]*\})?"                     # optional label set
+            r" (-?[0-9.eE+]+|\+Inf|-Inf|NaN)$"  # value
+        )
+    helps, types, samples = {}, {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert name not in helps, f"duplicate HELP for family {name}"
+            helps[name] = line
+        elif line.startswith("# TYPE "):
+            parts = line.split(" ")
+            name, kind = parts[2], parts[3]
+            assert name not in types, f"duplicate TYPE for family {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), f"bad TYPE kind {kind} for {name}"
+            samples_so_far = {s[0] for s in samples}
+            assert not any(s.startswith(name) for s in samples_so_far
+                           if s == name), \
+                f"TYPE for {name} appears after its samples"
+            types[name] = kind
+        elif line.startswith("#"):
+            continue  # free-form comment
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            samples.append((m.group(1), m.group(2) or "", m.group(3)))
+    return helps, types, samples
+
+
+def _family_of(sample_name, types):
+    """Resolve a sample to its declared family (histogram/summary samples
+    carry the _bucket/_sum/_count suffix of their family name)."""
+    if sample_name in types:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if types.get(base) in ("histogram", "summary"):
+                return base
+    return None
+
+
+class TestExpositionConformance:
+    """Strict-parser conformance over the FULL scrape body: every family
+    declared exactly once with HELP+TYPE, every sample attributable to a
+    declared family, label syntax well-formed — including the outcome
+    and per-flow RT series fed from the device outcome columns."""
+
+    @pytest.fixture(autouse=True)
+    def clean_slo(self):
+        from sentinel_tpu.trace.slo import reset_slo_plane_for_tests
+
+        reset_slo_plane_for_tests()
+        yield
+        reset_slo_plane_for_tests()
+
+    def _drive(self):
+        """Populate every section: local traffic, SLO tenants, and a token
+        service with accepted + dropped outcome reports on two flows."""
+        from sentinel_tpu.cluster.token_service import (
+            ClusterFlowRule,
+            DefaultTokenService,
+        )
+        from sentinel_tpu.engine.config import EngineConfig
+
+        FlowRuleManager.load_rules([FlowRule(resource="api", count=100.0)])
+        with sentinel.entry("api"):
+            pass
+        svc = DefaultTokenService(EngineConfig(max_flows=32))
+        svc.load_rules([
+            ClusterFlowRule(flow_id=11, namespace="nsA", count=100.0),
+            ClusterFlowRule(flow_id=22, namespace="nsB", count=100.0),
+        ])
+        svc.report_outcomes([11, 11, 22, 22], [3, 5, 8, 13],
+                            [False, False, True, False])
+        svc.report_outcomes([11, 999], [-4, 7], [False, False])  # drops too
+        return svc
+
+    def test_full_scrape_is_conformant(self):
+        svc = self._drive()
+        text = render()
+        assert text.endswith("\n") and "# EOF" not in text  # 0.0.4, not OM
+        helps, types, samples = _parse_exposition(text)
+        assert set(helps) == set(types), (
+            "HELP/TYPE mismatch: "
+            f"{set(helps) ^ set(types)}"
+        )
+        for name, labelset, value in samples:
+            fam = _family_of(name, types)
+            assert fam is not None, f"sample {name} has no declared family"
+            if labelset:
+                body = labelset[1:-1]
+                assert body == "" or all(
+                    "=" in pair for pair in body.split('",')
+                ), f"malformed labels on {name}: {labelset}"
+            float(value.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        # counter families follow the _total convention (SLO/outcome/client)
+        for fam, kind in types.items():
+            if kind == "counter":
+                assert fam.endswith("_total"), \
+                    f"counter family {fam} missing _total suffix"
+        del svc
+
+    def test_outcome_families_present_with_headers(self):
+        svc = self._drive()
+        text = render()
+        _, types, samples = _parse_exposition(text)
+        for fam in (
+            "sentinel_outcome_reported_total",
+            "sentinel_outcome_exceptions_total",
+            "sentinel_outcome_batches_total",
+            "sentinel_outcome_rt_sum_ms_total",
+            "sentinel_outcome_dropped_total",
+            "sentinel_flow_complete_qps",
+            "sentinel_flow_exception_qps",
+            "sentinel_flow_rt_avg_ms",
+            "sentinel_flow_rt_p99_ms",
+            "sentinel_slo_rt_ms",
+            "sentinel_slo_exceptions_total",
+        ):
+            assert fam in types, f"family {fam} not declared"
+        names = {s[0] for s in samples}
+        assert "sentinel_flow_rt_p99_ms" in names
+        assert "sentinel_slo_rt_ms_bucket" in names
+        del svc
+
+    def test_multi_tenant_histograms_single_header(self):
+        # two tenants with RT data: the sentinel_slo_rt_ms family must
+        # still declare HELP/TYPE exactly once (regression: the histogram
+        # helper used to emit headers per labelled instance)
+        svc = self._drive()
+        text = render()
+        assert text.count("# TYPE sentinel_slo_rt_ms histogram") == 1
+        assert text.count("# TYPE sentinel_slo_latency_ms histogram") <= 1
+        del svc

@@ -1,0 +1,467 @@
+"""Outcome-feedback plane: device scatter vs scalar reference, wire-boundary
+validation, exact reconciliation, and HA drills.
+
+Tentpole suite for the completion-telemetry PR. Layers under test, bottom up:
+
+- ``rt_bucket`` and the fused outcome scatter agree **bit-exactly** with a
+  pure-Python/numpy reference (the integer bit-length log2 and a hand
+  accumulation of every channel, histogram cells included);
+- ``report_outcomes`` validates at the wire boundary: ``non_finite`` /
+  ``negative`` / ``too_large`` / ``unknown_flow`` rows are dropped and
+  counted, never scattered;
+- the reconciliation invariant, in-process (no sockets): rows accepted ==
+  device column totals == timeline sums == the Prometheus ``_total``
+  counters, with zero tolerance;
+- outcome columns survive a snapshot/restore round trip, ship in
+  replication deltas (own dirty set, clean after one export), and fold
+  through a namespace MOVE;
+- the rev-6 codec round-trips and rejects torn frames; the client buffer
+  evicts oldest on overflow and chunks drains at ``MAX_OUTCOME_PER_FRAME``;
+- the SLO plane's ``record_completion`` burns the latency-RT windows
+  against the RT objective.
+
+The socket path (piggy-backed frames through a live ``TokenServer``) is
+exercised end to end by ``benchmarks/outcome_smoke.py`` and
+``examples/outcome_demo.py``; this file stays in-process to keep the
+equalities sharp and the suite fast.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sentinel_tpu.cluster import protocol as P
+from sentinel_tpu.cluster.client import _OUTCOME_BUF_CAP, TokenClient
+from sentinel_tpu.cluster.token_service import (
+    ClusterFlowRule,
+    DefaultTokenService,
+)
+from sentinel_tpu.engine.config import EngineConfig
+from sentinel_tpu.engine.outcome import outcome_step_donating, rt_bucket
+from sentinel_tpu.engine.state import (
+    N_OUTCOME_CHANNELS,
+    N_RT_BUCKETS,
+    OutcomeChannel,
+    flow_spec,
+    make_state,
+)
+from sentinel_tpu.ha import replication as R
+from sentinel_tpu.metrics.server import server_metrics
+from sentinel_tpu.metrics.timeline import reset_timeline_for_tests, timeline
+from sentinel_tpu.stats import window as W
+from sentinel_tpu.trace.slo import reset_slo_plane_for_tests, slo_plane
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+# window reach of 2 minutes: every outcome reported during a test is still
+# inside the sliding window when the reconciliation reads happen
+CFG = EngineConfig(max_flows=16, max_namespaces=4, bucket_ms=1000,
+                   n_buckets=120)
+
+
+def ref_bucket(rt_ms: int) -> int:
+    """Scalar reference for the log2 histogram cell: pure Python integer
+    bit-length, no floats anywhere."""
+    r = max(int(rt_ms), 0) + 1
+    return min(r.bit_length() - 1, N_RT_BUCKETS - 1)
+
+
+def _service(rules=None):
+    svc = DefaultTokenService(CFG)
+    svc.load_rules(rules if rules is not None
+                   else [ClusterFlowRule(flow_id=1, count=1e9)])
+    return svc
+
+
+def _two_ns_rules():
+    return [
+        ClusterFlowRule(flow_id=1, count=1e9, namespace="nsA"),
+        ClusterFlowRule(flow_id=2, count=1e9, namespace="nsA"),
+        ClusterFlowRule(flow_id=8, count=1e9, namespace="nsB"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# device kernel vs scalar reference
+# ---------------------------------------------------------------------------
+
+
+class TestRtBucketScalarReference:
+    EDGES = [0, 1, 2, 3, 4, 7, 8, 15, 16, 63, 64, 1023, 1024,
+             4094, 4095, 4096, 59_999, 60_000, 10**9]
+
+    def test_edges_bit_exact(self):
+        got = np.asarray(rt_bucket(jnp.asarray(self.EDGES, jnp.int32)))
+        want = np.asarray([ref_bucket(v) for v in self.EDGES])
+        np.testing.assert_array_equal(got, want)
+
+    def test_random_int32_bit_exact(self):
+        rng = np.random.default_rng(0xB0C4)
+        vals = rng.integers(0, 2**31 - 2, size=512)
+        got = np.asarray(rt_bucket(jnp.asarray(vals, jnp.int32)))
+        want = np.asarray([ref_bucket(int(v)) for v in vals])
+        np.testing.assert_array_equal(got, want)
+
+    def test_negative_clamps_to_cell_zero(self):
+        got = np.asarray(rt_bucket(jnp.asarray([-1, -999], jnp.int32)))
+        np.testing.assert_array_equal(got, [0, 0])
+
+    def test_top_cell_saturates(self):
+        # everything at/above 2^(NB-1)-1 lands in the last cell
+        lo = (1 << (N_RT_BUCKETS - 1)) - 1
+        got = np.asarray(rt_bucket(jnp.asarray([lo, lo * 50], jnp.int32)))
+        np.testing.assert_array_equal(got, [N_RT_BUCKETS - 1] * 2)
+
+
+class TestOutcomeScatterScalarReference:
+    def test_scatter_matches_numpy_accumulation(self):
+        cfg = EngineConfig(max_flows=8, max_namespaces=2)
+        state = make_state(cfg)
+        step = outcome_step_donating(cfg)
+        slots = np.asarray([0, 1, 0, 3, 5, 2], np.int32)
+        rt = np.asarray([5, 100, 7, 999, 3, 60_000], np.int32)
+        exc = np.asarray([0, 1, 0, 0, 1, 1], np.int32)
+        valid = np.asarray([1, 1, 1, 1, 0, 1], bool)  # row 4 masked out
+        out = step(state, jnp.asarray(slots), jnp.asarray(rt),
+                   jnp.asarray(exc), jnp.asarray(valid), jnp.int32(0))
+        sums = np.asarray(
+            W.window_sum_all(flow_spec(cfg), out.outcome, jnp.int32(0))
+        )[: cfg.max_flows]
+
+        want = np.zeros((cfg.max_flows, N_OUTCOME_CHANNELS), np.int64)
+        for s, r, e, v in zip(slots, rt, exc, valid):
+            if not v:
+                continue
+            want[s, OutcomeChannel.RT_SUM] += int(r)
+            want[s, OutcomeChannel.COMPLETE] += 1
+            want[s, OutcomeChannel.EXCEPTION] += int(e)
+            want[s, OutcomeChannel.RT_HIST0 + ref_bucket(int(r))] += 1
+        np.testing.assert_array_equal(sums, want)
+        # the masked row's slot saw nothing
+        assert sums[5].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# wire-boundary validation
+# ---------------------------------------------------------------------------
+
+
+class TestValidationTaxonomy:
+    def test_mixed_batch_drop_reasons(self):
+        svc = _service()
+        try:
+            n = svc.report_outcomes(
+                np.asarray([1, 1, 1, 1, 999]),
+                np.asarray([5.0, float("nan"), -3.0,
+                            P.OUTCOME_MAX_RT_MS + 1.0, 5.0]),
+                np.asarray([True, False, False, False, False]),
+            )
+            assert n == 1
+            st = svc.outcome_stats()
+            assert st["reported"] == 1
+            assert st["exceptions"] == 1
+            assert st["rt_sum_ms"] == 5
+            assert st["dropped"] == {"non_finite": 1, "negative": 1,
+                                     "too_large": 1, "unknown_flow": 1}
+            # the accepted row is readable per flow, keyed by INT flow id
+            f = st["flows"][1]
+            assert f["rt_avg_ms"] == 5.0
+            assert f["exception_qps"] > 0.0
+        finally:
+            svc.close()
+
+    def test_ceiling_is_inclusive(self):
+        svc = _service()
+        try:
+            assert svc.report_outcomes(
+                [1], [P.OUTCOME_MAX_RT_MS], [False]) == 1
+            assert svc.outcome_stats()["dropped"] == {}
+        finally:
+            svc.close()
+
+    def test_client_parked_nan_lands_as_negative(self):
+        # the client parks non-finite RTs at -1 before the int32 wire row;
+        # server-side that is indistinguishable from a negative report
+        svc = _service()
+        try:
+            assert svc.report_outcomes(
+                [1], np.asarray([-1], np.int32), [False]) == 0
+            assert svc.outcome_stats()["dropped"] == {"negative": 1}
+        finally:
+            svc.close()
+
+    def test_length_mismatch_raises(self):
+        svc = _service()
+        try:
+            with pytest.raises(ValueError):
+                svc.report_outcomes([1, 1], [5.0], [False])
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the reconciliation invariant, in-process
+# ---------------------------------------------------------------------------
+
+
+class TestReconciliation:
+    def test_four_surfaces_agree_exactly(self):
+        server_metrics().reset()
+        reset_timeline_for_tests()
+        svc = _service(_two_ns_rules())
+        rng = np.random.default_rng(20260806)
+        sent = accepted = exceptions = rt_sum = invalid = 0
+        try:
+            for _ in range(6):
+                fids = rng.choice([1, 2, 8, 404], size=32)  # 404: no rule
+                rt = rng.integers(0, 300, size=32).astype(float)
+                rt[rng.random(32) < 0.1] = -7.0  # injected invalid rows
+                exc = rng.random(32) < 0.25
+                svc.report_outcomes(fids, rt, exc)
+                for f, r, e in zip(fids, rt, exc):
+                    sent += 1
+                    if r < 0 or f == 404:
+                        invalid += 1
+                    else:
+                        accepted += 1
+                        exceptions += int(e)
+                        rt_sum += int(r)
+            st = svc.outcome_stats()
+            assert st["reported"] == accepted
+            assert st["exceptions"] == exceptions
+            assert st["rt_sum_ms"] == rt_sum
+            assert sum(st["dropped"].values()) == invalid
+            assert st["reported"] + sum(st["dropped"].values()) == sent
+
+            counts = np.asarray(svc.export_state()["outcome"]["counts"])
+            assert int(counts[:, :, OutcomeChannel.COMPLETE].sum()) == accepted
+            assert int(counts[:, :, OutcomeChannel.EXCEPTION].sum()) == exceptions
+            assert int(counts[:, :, OutcomeChannel.RT_SUM].sum()) == rt_sum
+            # histogram cells account for every accepted row exactly once
+            h0 = int(OutcomeChannel.RT_HIST0)
+            assert int(counts[:, :, h0:].sum()) == accepted
+
+            tl = {"completed": 0, "exceptions": 0}
+            for ns in ("nsA", "nsB"):
+                for s in timeline().query(namespace=ns):
+                    tl["completed"] += s.completed
+                    tl["exceptions"] += s.exceptions
+            assert tl == {"completed": accepted, "exceptions": exceptions}
+
+            prom = {}
+            for line in server_metrics().render().splitlines():
+                for fam in ("sentinel_outcome_reported_total",
+                            "sentinel_outcome_exceptions_total"):
+                    if line.startswith(fam + " "):
+                        prom[fam] = int(line.split()[-1])
+                if line.startswith("sentinel_outcome_dropped_total{"):
+                    prom["dropped"] = prom.get("dropped", 0) + int(
+                        line.split()[-1])
+            assert prom["sentinel_outcome_reported_total"] == accepted
+            assert prom["sentinel_outcome_exceptions_total"] == exceptions
+            assert prom.get("dropped", 0) == invalid
+        finally:
+            svc.close()
+            server_metrics().reset()
+            reset_timeline_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# HA drills: snapshot / replication delta / MOVE
+# ---------------------------------------------------------------------------
+
+
+class TestOutcomeColumnsAcrossHA:
+    def _loaded_primary(self):
+        svc = _service(_two_ns_rules())
+        svc.report_outcomes(
+            [1, 1, 2, 8, 8], [5, 10, 20, 40, 80],
+            [False, True, False, False, True])
+        return svc
+
+    def test_snapshot_round_trip_bit_exact(self):
+        prim = self._loaded_primary()
+        restored = DefaultTokenService(CFG)
+        restored.load_rules(_two_ns_rules())
+        try:
+            blob = R.encode_snapshot_blob(prim.export_state())
+            restored.import_state(R.decode_snapshot_blob(blob))
+            a = prim.export_state()["outcome"]
+            b = restored.export_state()["outcome"]
+            np.testing.assert_array_equal(np.asarray(a["counts"]),
+                                          np.asarray(b["counts"]))
+            np.testing.assert_array_equal(np.asarray(a["starts"]),
+                                          np.asarray(b["starts"]))
+            assert restored.outcome_stats()["flows"][8]["rt_avg_ms"] == 60.0
+        finally:
+            prim.close()
+            restored.close()
+
+    def test_pre_outcome_snapshot_restores_cold(self):
+        # a rev-5 snapshot (no outcome key) must still import: the columns
+        # simply come up cold
+        prim = self._loaded_primary()
+        restored = DefaultTokenService(CFG)
+        restored.load_rules(_two_ns_rules())
+        try:
+            snap = prim.export_state()
+            snap.pop("outcome")
+            restored.import_state(
+                R.decode_snapshot_blob(R.encode_snapshot_blob(snap)))
+            counts = np.asarray(restored.export_state()["outcome"]["counts"])
+            assert counts.sum() == 0
+        finally:
+            prim.close()
+            restored.close()
+
+    def test_replication_delta_converges_and_cleans_dirty(self):
+        prim = self._loaded_primary()
+        standby = DefaultTokenService(CFG)
+        standby.load_rules(_two_ns_rules())
+        try:
+            # bootstrap first: deltas only apply inside a matching epoch
+            standby.import_state(R.decode_snapshot_blob(
+                R.encode_snapshot_blob(prim.export_state())))
+            prim.replication_enable()
+            prim.export_delta()  # drain pre-bootstrap dirt
+            prim.report_outcomes([2, 8], [33, 44], [True, False])
+            delta = prim.export_delta()
+            assert delta.get("outcome_fids")
+            standby.apply_replication_delta(delta)
+            np.testing.assert_array_equal(
+                np.asarray(prim.export_state()["outcome"]["counts"]),
+                np.asarray(standby.export_state()["outcome"]["counts"]))
+            # dirty set drained: a quiet second delta ships no outcome rows
+            assert not prim.export_delta().get("outcome_fids")
+        finally:
+            prim.close()
+            standby.close()
+
+    def test_move_folds_outcome_sums(self):
+        prim = self._loaded_primary()
+        target = DefaultTokenService(CFG)
+        target.load_rules(_two_ns_rules())
+        try:
+            mv = prim.export_namespace_state("nsB")
+            assert "outcome_sums" in mv
+            target.import_namespace_state(mv)
+            f8 = target.outcome_stats()["flows"][8]
+            assert f8["rt_avg_ms"] == 60.0       # (40 + 80) / 2
+            assert f8["exception_qps"] > 0.0
+            # nsA flows did not ride the MOVE
+            assert 1 not in target.outcome_stats()["flows"]
+        finally:
+            prim.close()
+            target.close()
+
+
+# ---------------------------------------------------------------------------
+# rev-6 codec + client-side buffer
+# ---------------------------------------------------------------------------
+
+
+def _payload(frame: bytes) -> bytes:
+    return frame[P._LEN.size:]
+
+
+class TestOutcomeWireCodec:
+    def test_round_trip(self):
+        fids = [1, 2**40, 7]
+        rt = [0, P.OUTCOME_MAX_RT_MS, 123]
+        exc = [True, False, True]
+        xid, f2, r2, e2 = P.decode_outcome_report(
+            _payload(P.encode_outcome_report(42, fids, rt, exc)))
+        assert xid == 42
+        np.testing.assert_array_equal(f2, fids)
+        np.testing.assert_array_equal(r2, rt)
+        np.testing.assert_array_equal(e2, exc)
+
+    def test_truncated_frame_raises(self):
+        payload = _payload(P.encode_outcome_report(1, [1, 2], [5, 6],
+                                                   [False, False]))
+        with pytest.raises(ValueError):
+            P.decode_outcome_report(payload[:-1])
+
+    def test_oversized_batch_refused_at_encode(self):
+        n = P.MAX_OUTCOME_PER_FRAME + 1
+        with pytest.raises(ValueError):
+            P.encode_outcome_report(1, np.ones(n, np.int64),
+                                    np.ones(n, np.int32), np.zeros(n, bool))
+
+    def test_empty_frame_round_trips(self):
+        xid, f, r, e = P.decode_outcome_report(
+            _payload(P.encode_outcome_report(7, [], [], [])))
+        assert xid == 7 and len(f) == len(r) == len(e) == 0
+
+
+class TestClientOutcomeBuffer:
+    def test_overflow_evicts_oldest_and_counts(self):
+        # never connects: record/drain are purely local
+        client = TokenClient("127.0.0.1", 1)
+        for i in range(_OUTCOME_BUF_CAP + 3):
+            client.record_outcome(5, float(i), exception=False)
+        st = client.outcome_stats()
+        assert st["recorded"] == _OUTCOME_BUF_CAP + 3
+        assert st["dropped_overflow"] == 3
+        assert st["buffered"] == _OUTCOME_BUF_CAP
+
+        frames = client._drain_outcome_frames()
+        assert len(frames) == -(-_OUTCOME_BUF_CAP // P.MAX_OUTCOME_PER_FRAME)
+        rows = [P.decode_outcome_report(_payload(f)) for f in frames]
+        assert sum(len(r[1]) for r in rows) == _OUTCOME_BUF_CAP
+        # oldest three were evicted: the first surviving rt is 3
+        assert rows[0][2][0] == 3
+        st = client.outcome_stats()
+        assert st["sent"] == _OUTCOME_BUF_CAP
+        assert st["frames"] == len(frames)
+        assert st["buffered"] == 0
+
+    def test_non_finite_rt_parks_at_minus_one(self):
+        client = TokenClient("127.0.0.1", 1)
+        client.record_outcome(5, float("nan"))
+        client.record_outcome(5, float("inf"))
+        client.record_outcome(5, "not-a-number")
+        frames = client._drain_outcome_frames()
+        _, _, rt, _ = P.decode_outcome_report(_payload(frames[0]))
+        np.testing.assert_array_equal(rt, [-1, -1, -1])
+
+    def test_finite_rt_clamps_into_int32(self):
+        client = TokenClient("127.0.0.1", 1)
+        client.record_outcome(5, 1e18)  # absurd but finite
+        _, _, rt, _ = P.decode_outcome_report(
+            _payload(client._drain_outcome_frames()[0]))
+        assert rt[0] == 2**31 - 1  # server drops it as too_large
+
+
+# ---------------------------------------------------------------------------
+# SLO plane: completion-RT burn
+# ---------------------------------------------------------------------------
+
+
+class TestSloRecordCompletion:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        reset_slo_plane_for_tests()
+        yield
+        reset_slo_plane_for_tests()
+
+    def test_rt_burn_counts_over_objective(self):
+        p = slo_plane()
+        assert p.rt_objective_ms == 100.0  # default objective
+        p.record_completion("api", [5.0, 250.0, 99.0], n_exception=1)
+        snap = p.snapshot()
+        t = snap["tenants"]["api"]
+        assert t["completed"] == 3
+        assert t["exceptions"] == 1
+        for w in t["rtWindows"].values():
+            assert w["total"] == 3
+            assert w["over"] == 1  # only the 250ms completion burned
+        body = p.render()
+        assert "sentinel_slo_rt_ms" in body
+        assert "sentinel_slo_exceptions_total" in body
+
+    def test_empty_batch_is_a_noop(self):
+        p = slo_plane()
+        p.record_completion("api", [])
+        assert "api" not in p.snapshot()["tenants"]

@@ -6,8 +6,11 @@ import pytest
 
 from sentinel_tpu.metrics.stat_logger import (
     RollingFileWriter,
+    StatEntry,
     StatLogger,
+    StatLogSearcher,
     reset_registry_for_tests,
+    search_stat_log,
     stat_logger,
 )
 
@@ -70,6 +73,56 @@ class TestRollingFileWriter:
         w.write_lines(["d" * 30])  # oldest (a) dropped
         assert not os.path.exists(path + ".3")
         assert "b" in open(path + ".2").read()
+
+
+class TestStatLogSearch:
+    def test_entry_parses_both_line_formats(self):
+        e = StatEntry.from_line("1700000000000|res,origin|3\n")
+        assert (e.timestamp_ms, e.key, e.count, e.total) == (
+            1700000000000, ("res", "origin"), 3, None)
+        v = StatEntry.from_line("1700000001000|rt|2,20.5")
+        assert (v.count, v.total) == (2, 20.5)
+
+    def test_search_spans_rotation_boundary(self, tmp_path):
+        # three windows written across a forced roll: window 0 lands in
+        # .2, window 1 in .1, window 2 in the live file — a range query
+        # covering all three must stitch them back in time order
+        path = str(tmp_path / "s.log")
+        w = RollingFileWriter(path, max_bytes=40, max_backups=3)
+        for i in range(3):
+            w.write_lines([f"{1000 * (i + 1)}|outcome_reported|{i + 1}"])
+        assert os.path.exists(path + ".2"), "roll did not happen"
+        found = StatLogSearcher(path, max_backups=3).find(0, 10_000)
+        assert [e.timestamp_ms for e in found] == [1000, 2000, 3000]
+        assert [e.count for e in found] == [1, 2, 3]
+        # range bounds are inclusive and filter per-window
+        mid = StatLogSearcher(path, max_backups=3).find(2000, 2000)
+        assert [e.count for e in mid] == [2]
+
+    def test_key_prefix_filter_and_torn_lines(self, tmp_path):
+        path = str(tmp_path / "k.log")
+        w = RollingFileWriter(path, max_bytes=10_000, max_backups=1)
+        w.write_lines([
+            "1000|outcome_reported,42|7",
+            "1000|lease_grant|3",
+            "garbage line without pipes",
+            "1000|outcome_reported,43|2,55",
+        ])
+        got = StatLogSearcher(path).find(
+            0, 5000, key_prefix=("outcome_reported",))
+        assert [e.key for e in got] == [("outcome_reported", "42"),
+                                       ("outcome_reported", "43")]
+        assert got[1].total == 55.0
+
+    def test_named_search_helper(self, manual_clock, tmp_path):
+        lg = StatLogger("searched", interval_ms=1000, log_dir=str(tmp_path))
+        base = manual_clock.now_ms() // 1000 * 1000
+        manual_clock.set_ms(base)
+        lg.stat("outcome_reported", count=16)
+        lg.flush()
+        got = search_stat_log("searched", base, base + 999,
+                              log_dir=str(tmp_path))
+        assert len(got) == 1 and got[0].count == 16
 
 
 class TestBlockLogWiring:

@@ -109,7 +109,8 @@ class TestLineRoundTrip:
     def test_none_latency_uses_sentinel(self):
         s = TimelineSample(T0 * 1000, "a", passed=1)
         line = s.to_line()
-        assert line.endswith("|-1|-1|0")
+        # ...|p99|max|waited|completed|exceptions|rtSumMs
+        assert line.endswith("|-1|-1|0|0|0|0")
         r = TimelineSample.from_line(line)
         assert r.p99_ms is None and r.max_ms is None
 
@@ -119,6 +120,13 @@ class TestLineRoundTrip:
             f"{T0 * 1000}|a|5|2|9|1|2.154|7.5")
         assert r.passed == 5 and r.waited == 0
         assert r.p99_ms == 2.154 and r.max_ms == 7.5
+
+    def test_pre_outcome_9_field_line_parses(self):
+        # files written before the outcome columns existed have 9 fields
+        r = TimelineSample.from_line(
+            f"{T0 * 1000}|a|5|2|9|1|2.154|7.5|3")
+        assert r.passed == 5 and r.waited == 3
+        assert r.completed == 0 and r.exceptions == 0 and r.rt_sum_ms == 0
 
     def test_namespace_separator_is_escaped(self):
         s = TimelineSample(T0 * 1000, "a|b", passed=1)

@@ -658,7 +658,18 @@ def _measure(cfg: dict) -> None:
 
         res = {}
         N = 1024
+        # the Pallas kernel only compiles on TPU; anywhere else it runs
+        # under the interpreter, which times the interpreter (~50×, see
+        # BENCH_r05), not the kernel. Stamp impl+mode into every cell and
+        # mark the pair non-comparable when the modes differ, so nothing
+        # downstream reads an interpret number as a kernel regression.
+        backend = jax.default_backend()
+        modes = {}
         for impl in ("jax", "pallas"):
+            modes[impl] = (
+                "compiled" if impl == "jax" or backend == "tpu"
+                else "interpret"
+            )
             if _budget_left() < STAGE_FLOOR_S:
                 res[impl] = "skipped: child budget exhausted"
                 continue
@@ -692,14 +703,29 @@ def _measure(cfg: dict) -> None:
                 jax.block_until_ready(f(st0, jnp.int32(now)))
                 t0 = time.perf_counter()
                 jax.block_until_ready(f(st0, jnp.int32(now)))
-                res[impl] = round(
-                    (time.perf_counter() - t0) / iters * 1e3, 4
-                )
+                res[impl] = {
+                    "step_ms": round(
+                        (time.perf_counter() - t0) / iters * 1e3, 4
+                    ),
+                    "impl": impl,
+                    "mode": modes[impl],
+                }
             except Exception as e:  # pragma: no cover - env dependent
                 # a Pallas remote-compile failure is itself the fate
                 # evidence; it must not discard the jax number
                 res[impl] = f"error: {type(e).__name__}: {e}"[:160]
         res["batch"] = N
+        both_timed = all(
+            isinstance(res.get(i), dict) for i in ("jax", "pallas")
+        )
+        res["comparable"] = both_timed and (
+            modes["jax"] == modes["pallas"]
+        )
+        if both_timed and not res["comparable"]:
+            res["note"] = (
+                "modes differ (pallas ran interpret off-TPU): cells are "
+                "NOT a kernel comparison and gate nothing"
+            )
         doc["extra"]["param_pallas_vs_xla_step_ms"] = res
 
     stage("param_pallas_vs_xla", _param)

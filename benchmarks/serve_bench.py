@@ -107,20 +107,28 @@ def build_server(n_flows: int = 100_000, max_batch: int = 16384,
                  serve_buckets=(4096, 16384), native: bool = True,
                  port: int = 0, n_dispatchers: int = 2,
                  fuse_depth: int = 4, intake_shards: int = 1,
-                 mesh_devices: int = 0, shm_dir=None):
+                 mesh_devices: int = 0, shm_dir=None,
+                 decide_impl: str = "auto"):
     """Service (100k rules — the headline's problem size) + front door.
 
     ``mesh_devices > 0`` backs the service with a flow-sharded mesh over
     that many devices (the caller must have made them visible — see
     :func:`force_virtual_cpu_devices` for the CPU-mesh recipe); the front
-    door and everything behind it is unchanged, which is the point."""
+    door and everything behind it is unchanged, which is the point.
+
+    ``decide_impl`` selects the decide backend (``EngineConfig``):
+    "auto" runs the production selector with the Pallas megakernel
+    compiled into the build — off-TPU it resolves to "xla", which is
+    exactly what the serve-smoke floor gates; "pallas" forces the
+    megakernel (interpret mode off-TPU: correctness runs only)."""
     from sentinel_tpu.cluster.server import TokenServer
     from sentinel_tpu.cluster.token_service import DefaultTokenService
     from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
     from sentinel_tpu.engine.rules import ThresholdMode
 
     config = EngineConfig(
-        max_flows=n_flows, max_namespaces=64, batch_size=max_batch
+        max_flows=n_flows, max_namespaces=64, batch_size=max_batch,
+        decide_impl=decide_impl,
     )
     mesh = None
     if mesh_devices:

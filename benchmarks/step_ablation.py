@@ -36,6 +36,177 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+def hbm_bytes_model(config, batch_size: int, pconfig=None) -> dict:
+    """First-order analytic model of HBM bytes touched per decide step,
+    split per subsystem — the byte half of the north-star per-stage budget
+    (``northstar_bench.py`` embeds this next to the measured times).
+
+    Accounting rules (stated so the numbers are auditable, not mystical):
+
+    - HBM moves whole transactions, not cells: every access is charged
+      ``max(bytes_requested, TXN)`` with ``TXN = 32`` (conservative —
+      real TPU HBM bursts are larger, which only widens the gap);
+    - a strided gather across a row (``window_sum_at`` pulls one channel
+      column at stride ``E`` cells) touches every transaction the row
+      spans, so it is charged the full ``[B, E]`` row;
+    - scatter-add is an RMW — read transaction + write transaction per
+      touched cell, even into a donated buffer;
+    - roll is the conditional one-column staleness zero — charged
+      separately as ``per_roll`` since its cadence is bucket-boundary
+      crossings, not steps;
+    - ``ops`` counts distinct HBM-touching accesses per batch row — the
+      serialized scatter/gather chain length the roofline blames for the
+      latency (each is its own dependency-ordered traversal in XLA; the
+      megakernel folds them into one resident-in-VMEM pass).
+
+    Two impls are modeled. ``xla`` is the shipped ``_decide_core``
+    pipeline: each subsystem issues its own gathers and scatters, so a
+    batch row's flow window is traversed once per subsystem op that
+    touches it. ``pallas`` is the one-HBM-traversal megakernel
+    (``ops/decide_pallas.py``): each referenced row's ``[B, E]`` flow
+    window and ``[B, 1]`` occupy row are DMA'd into VMEM once, all
+    subsystem math runs on the resident copy, and only the current
+    bucket column of each written segment goes back — plus the XLA
+    epilogue's [N]-sized scatters (shaping clocks, ns guard, verdict
+    stitching), which stay outside the kernel by design.
+
+    The ``sketch`` and ``outcome`` planes ride separate batches
+    (PARAM_FLOW dispatches and OUTCOME_REPORT frames), so their rows are
+    per *their* batch row, reported under ``off_step_planes``.
+    """
+    from sentinel_tpu.engine.state import (
+        N_CLUSTER_EVENTS,
+        N_OUTCOME_CHANNELS,
+    )
+
+    if pconfig is None:
+        from sentinel_tpu.engine.param import ParamConfig
+
+        pconfig = ParamConfig()
+    N = batch_size
+    F = config.max_flows
+    B = config.n_buckets
+    E = N_CLUSTER_EVENTS
+    NS = config.max_namespaces
+    C = 4  # bytes per cell
+    TXN = 32  # HBM transaction granularity charged per access
+
+    def t(requested):  # one access of `requested` contiguous bytes
+        return max(int(requested), TXN)
+
+    flow_row = B * E * C  # [B, E] bucket row, contiguous
+    occ_row = B * 1 * C
+
+    def sub(read, write, ops, per_roll=0):
+        return {
+            "read": int(read), "write": int(write),
+            "total": int(read + write), "ops": int(ops),
+            "per_roll": int(per_roll),
+        }
+
+    xla = {
+        # PASS admission gather (strided column -> whole row) + 4 event
+        # scatter-RMWs + the cond OCCUPIED_PASS channel; roll zeroes one
+        # [F, E] column
+        "windows": sub(
+            read=N * (t(flow_row) + 5 * TXN),
+            write=N * 5 * TXN,
+            ops=1 + 5,
+            per_roll=2 * F * E * C,
+        ),
+        # future-ring gather (expiring + matured share it) + add_future RMW
+        "occupancy": sub(
+            read=N * (t(occ_row) + TXN),
+            write=N * TXN,
+            ops=1 + 1,
+            per_roll=2 * F * 1 * C,
+        ),
+        # 3 clock columns gathered at the batch rows, 3 scattered back (RMW)
+        "shaping": sub(
+            read=N * (3 * TXN + 3 * TXN), write=N * 3 * TXN, ops=3 + 3,
+        ),
+        # per-namespace qps window: gather at ns ids + dense column add
+        "ns_guard": sub(
+            read=N * t(occ_row) + t(NS * C),
+            write=t(NS * C),
+            ops=1 + 1,
+        ),
+    }
+    # megakernel: one DMA in per referenced row (flow [B,E] + occupy
+    # [B,1]; the 16 rule/shaping scalar columns stream in as contiguous
+    # [N] VMEM blocks), one current-column DMA out per written segment
+    # (<= N rows), then the epilogue's [N]-sized scatters
+    pallas = {
+        "windows": sub(
+            read=N * t(flow_row) + 16 * N * C,
+            write=N * t(E * C),
+            ops=1 + 1,
+            per_roll=2 * F * E * C,
+        ),
+        "occupancy": sub(
+            read=N * t(occ_row),
+            write=N * TXN,  # add_future RMW stays in the epilogue
+            ops=1 + 1,
+            per_roll=2 * F * 1 * C,
+        ),
+        # clock reads ride the 16-column block load; writes are epilogue
+        # scatter-RMWs
+        "shaping": sub(read=N * 3 * TXN, write=N * 3 * TXN, ops=3),
+        "ns_guard": sub(  # epilogue, identical to the XLA arm
+            read=N * t(occ_row) + t(NS * C),
+            write=t(NS * C),
+            ops=1 + 1,
+        ),
+    }
+    for impl in (xla, pallas):
+        impl["total"] = sub(
+            read=sum(s["read"] for s in impl.values()),
+            write=sum(s["write"] for s in impl.values()),
+            ops=sum(s["ops"] for s in impl.values()),
+            per_roll=sum(s["per_roll"] for s in impl.values()),
+        )
+    d, w = pconfig.depth, pconfig.width
+    sd, sw = pconfig.slim_depth, pconfig.slim_width
+    off_step = {
+        # per PARAM_FLOW batch row: d hashed cells RMW (fat) + estimate
+        # read + slim twin RMW when enabled
+        "sketch": sub(
+            read=N * (2 * d * TXN + (sd * TXN if pconfig.slim_enabled
+                                     else 0)),
+            write=N * (d * TXN + (sd * TXN if pconfig.slim_enabled
+                                  else 0)),
+            ops=2 * d + (2 * sd if pconfig.slim_enabled else 0),
+            per_roll=2 * d * w * C + (2 * sd * sw * C
+                                      if pconfig.slim_enabled else 0),
+        ),
+        # per OUTCOME_REPORT row: RT_SUM + COMPLETE + EXCEPTION + one
+        # log2 histogram bucket, all scatter-RMW
+        "outcome": sub(
+            read=N * 4 * TXN, write=N * 4 * TXN, ops=4,
+            per_roll=2 * F * N_OUTCOME_CHANNELS * C,
+        ),
+    }
+    return {
+        "batch_size": N,
+        "cell_bytes": C,
+        "txn_bytes": TXN,
+        "per_step": {"xla": xla, "pallas": pallas},
+        "per_decision": {
+            "xla_bytes": round(xla["total"]["total"] / N, 2),
+            "pallas_bytes": round(pallas["total"]["total"] / N, 2),
+            "bytes_reduction": round(
+                xla["total"]["total"] / max(1, pallas["total"]["total"]), 3
+            ),
+            "xla_hbm_ops": xla["total"]["ops"],
+            "pallas_hbm_ops": pallas["total"]["ops"],
+            "ops_reduction": round(
+                xla["total"]["ops"] / max(1, pallas["total"]["ops"]), 3
+            ),
+        },
+        "off_step_planes": off_step,
+    }
+
+
 def build_variants(config, table, stacked, n_flows):
     """Variant bodies with signature ``(state, (t, k)) -> (state, y)``.
 
@@ -226,6 +397,9 @@ def measure(batch_size: int = 32768, n_flows: int = 100_000,
         "batch_size": batch_size,
         "n_flows": n_flows,
         "iters": [iters_lo, iters_hi],
+        # analytic per-subsystem HBM budget next to the measured times —
+        # northstar_bench.py lifts this into its per-stage budget
+        "hbm_bytes": hbm_bytes_model(config, batch_size),
         "step_ms": {},
     }
 

@@ -78,6 +78,7 @@ class NativeTokenServer:
         max_batch: int = 16384,
         n_dispatchers: int = 2,
         fuse_depth: int = 4,
+        max_device_inflight: int = 2,
         intake_shards: int = 1,
         intake_timeout_ms: int = 20,
         idle_ttl_s: Optional[float] = 600.0,
@@ -127,6 +128,15 @@ class NativeTokenServer:
         # folds into one dispatch (each pull is itself up to max_batch
         # rows) — the host-prep budget of the adaptive frame fusion
         self.fuse_depth = max(1, int(fuse_depth))
+        # double-buffering bound: fused groups dispatched but not yet
+        # materialized. 2 overlaps the next group's host prep (queue
+        # drain, concat, shed masks, staging) with the previous group's
+        # device compute; higher depths only add verdict latency, since
+        # dispatch order is already the state-chain order. 1 restores
+        # the serialized lane.
+        self.max_device_inflight = max(1, int(max_device_inflight))
+        self._device_inflight = 0
+        self._device_cv = threading.Condition()
         # intake poll granularity only — the C++ door wakes the waiter the
         # moment the first frame queues, so this never delays a ready frame
         self.intake_timeout_ms = max(1, int(intake_timeout_ms))
@@ -198,6 +208,7 @@ class NativeTokenServer:
             max_batch=self.max_batch,
             n_dispatchers=self.n_dispatchers,
             fuse_depth=self.fuse_depth,
+            max_device_inflight=self.max_device_inflight,
             intake_shards=self.intake_shards,
             intake_timeout_ms=self.intake_timeout_ms,
             idle_ttl_s=self.idle_ttl_s,
@@ -369,6 +380,7 @@ class NativeTokenServer:
             "reply_lane_depth": lambda: float(
                 self._reply_q.qsize() if self._reply_q else 0
             ),
+            "device_inflight": lambda: float(self._device_inflight),
             "connections": lambda: sum(
                 len(addrs) for addrs in self.connections.snapshot().values()
             ),
@@ -742,6 +754,60 @@ class NativeTokenServer:
             if self._lane_put(q, self._SENTINEL):
                 self._dispatch_sem.release()
 
+    # -- device pipelining ---------------------------------------------------
+    def _acquire_device_permit(self) -> bool:
+        """Block until a dispatch slot frees (``max_device_inflight``
+        bound). Returns True when another fused group was already in
+        flight — i.e. this group's host prep just ran overlapped with
+        device compute that a depth-1 lane would have serialized behind.
+        On abandoned shutdown the wait gives up and over-admits; the
+        release path tolerates it."""
+        with self._device_cv:
+            while (
+                self._device_inflight >= self.max_device_inflight
+                and not self._abandon.is_set()
+            ):
+                self._device_cv.wait(timeout=0.1)
+            overlapped = self._device_inflight > 0
+            self._device_inflight += 1
+            return overlapped
+
+    def _release_device_permit(self) -> None:
+        with self._device_cv:
+            self._device_inflight = max(0, self._device_inflight - 1)
+            self._device_cv.notify()
+
+    def _tracked_dispatch(self, dispatch, ids, counts, prios):
+        """Issue one device dispatch under the inflight bound.
+
+        Returns ``(mat, release, overlapped)``: ``mat`` materializes the
+        verdicts and releases the permit (exactly once, even if the
+        materialize raises); ``release`` is the idempotent escape hatch
+        for paths that never call ``mat`` (dispatch exception handled by
+        the caller, abandoned-shutdown drop). ``overlapped`` reports
+        whether the permit wait found earlier work still in flight."""
+        overlapped = self._acquire_device_permit()
+        done = [False]
+
+        def release():
+            if not done[0]:
+                done[0] = True
+                self._release_device_permit()
+
+        try:
+            inner = dispatch(ids, counts, prios)
+        except Exception:
+            release()
+            raise
+
+        def mat():
+            try:
+                return inner()
+            finally:
+                release()
+
+        return mat, release, overlapped
+
     def _device_loop(self) -> None:
         """Lane 2: the only thread issuing device work — dispatch order IS
         state-chain order. Drains every queued pull (bounded by
@@ -749,7 +815,11 @@ class NativeTokenServer:
         service's fusion ladder folds the full engine frames inside into a
         single chained scan step. Dispatch returns before the device
         finishes (async), so this lane loops back to prep the next group
-        while the reply lanes block on the verdicts.
+        while the reply lanes block on the verdicts. Up to
+        ``max_device_inflight`` fused groups may be dispatched and not yet
+        materialized — the permit wait applies backpressure beyond that,
+        and the overlap the pipeline wins is accounted in
+        ``overlap_saved_ms_total``.
 
         With intake sharding the drain is the UNION of the shard queues:
         the semaphore counts queued pulls across all of them, and a
@@ -845,6 +915,8 @@ class NativeTokenServer:
                             _TR.DISPATCH, p[3][2], aux=len(pulls)
                         )
                 t0 = time.perf_counter()
+                permit_rel = None
+                overlapped = False
                 try:
                     if level >= BrownoutLevel.DEGRADE:
                         # brownout floor: no device dispatch at all; a BDP
@@ -887,7 +959,11 @@ class NativeTokenServer:
                                 mask = None
                         if mask is None:
                             if dispatch is not None:
-                                mat = dispatch(ids, counts, prios)
+                                mat, permit_rel, overlapped = (
+                                    self._tracked_dispatch(
+                                        dispatch, ids, counts, prios
+                                    )
+                                )
                             else:
                                 # SPI implementations without the dispatch/
                                 # materialize split run synchronously here
@@ -904,8 +980,11 @@ class NativeTokenServer:
                             keep = np.nonzero(~mask)[0]
                             if keep.size:
                                 if dispatch is not None:
-                                    inner = dispatch(
-                                        ids[keep], counts[keep], prios[keep]
+                                    inner, permit_rel, overlapped = (
+                                        self._tracked_dispatch(
+                                            dispatch, ids[keep],
+                                            counts[keep], prios[keep],
+                                        )
                                     )
                                 else:
                                     res = service.request_batch_arrays(
@@ -939,19 +1018,28 @@ class NativeTokenServer:
                                 return status, remaining, wait
                 except Exception:
                     record_log.exception("device step failed; failing batch")
+                    if permit_rel is not None:
+                        permit_rel()
                     n = n_rows
                     mat = lambda n=n: (  # noqa: E731
                         np.full(n, int(TokenStatus.FAIL), np.int8),
                         np.zeros(n, np.int32),
                         np.zeros(n, np.int32),
                     )
-                _SM.dispatch_ms.record((time.perf_counter() - t0) * 1e3)
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                _SM.dispatch_ms.record(dt_ms)
+                if overlapped:
+                    # this group's whole dispatch arm ran while the prior
+                    # group still computed — the pipelining win
+                    _SM.count_overlap_saved_ms(dt_ms)
                 if not self._lane_put(
                     self._reply_q, (pulls, lengths, mat)
                 ):
                     # abandoned shutdown drop: nobody will materialize or
                     # answer these rows — account for them and park the
                     # staging blocks the reply lane would have returned
+                    if permit_rel is not None:
+                        permit_rel()
                     self.overload.note_done(n_rows)
                     _SM.count_shed("lane_abandon", n_rows)
                     if self._staging is not None:

@@ -32,6 +32,16 @@ class EngineConfig(NamedTuple):
     # (matmul ≤ 2048, sort above). Grouped host batches bypass this and use
     # the sort-free "grouped" impl (see decide()'s grouped flag).
     prefix_impl: str = "auto"
+    # decision-step backend: "xla" (the `_decide_core` pipeline — one XLA
+    # pass per subsystem), "pallas" (the one-HBM-traversal megakernel in
+    # ops/decide_pallas.py: window reads, roll, admission math and the
+    # event scatters fused into a single kernel over the flow plane), or
+    # "auto" (SENTINEL_DECIDE_IMPL env var wins; off-TPU picks "xla"
+    # outright — interpret-mode pallas is orders of magnitude slower; on
+    # TPU both are micro-probed once per process and the faster wins).
+    # The pallas step requires grouped batches; non-grouped callers fall
+    # back to "xla" regardless of this setting.
+    decide_impl: str = "auto"
 
     @property
     def interval_ms(self) -> int:

@@ -177,6 +177,155 @@ from sentinel_tpu.engine.prefix import segment_prefix_builder as _segment_prefix
 from sentinel_tpu.ops.scan_mm import blocked_cumsum as _blocked_cumsum
 
 
+def _warmup_curve(
+    spec,
+    now,
+    passed,
+    cnt,
+    cnt_safe,
+    warn,
+    max_token,
+    slope,
+    cold_count,
+    filled,
+    tokens,
+    warm_rows,
+):
+    """WARM_UP lazy token sync + slope curve on gathered ``[N]`` columns.
+
+    Shared verbatim by the XLA pipeline (``_decide_core``'s ``warm_on``
+    branch) and the Pallas megakernel (``ops/decide_pallas.py``) so the two
+    backends stay *bitwise* equal: the op sequence here IS the parity
+    contract. Returns ``(qps, tokens_new, do_sync, cur_sec)``; rows outside
+    ``warm_rows`` come back with ``qps = cnt`` and ``do_sync = False`` (the
+    cond-off values), which is what makes computing this unconditionally in
+    the kernel equivalent to the XLA path's ``lax.cond`` gating.
+    """
+    # lazy once-per-second token sync (WarmUpController.syncToken):
+    # refill below the warning line (or above it while pass qps stays
+    # under count/coldFactor), clamp to maxToken, then drain one
+    # second's worth of passes. The reference syncs with the previous
+    # second's pass QPS; here the sliding-window pass rate stands in —
+    # the scalar port in tests/test_shaping.py mirrors exactly this.
+    # A NEVER fill stamp makes the first sync see a huge idle gap and
+    # clamp to maxToken: the cold state, for free.
+    pass_qps = passed * (1000.0 / spec.interval_ms)
+    cur_sec = now - now % 1000
+    can_refill = (tokens < warn) | ((tokens > warn) & (pass_qps < cold_count))
+    elapsed = (cur_sec - filled).astype(jnp.float32)
+    cooled = jnp.minimum(
+        tokens + jnp.where(can_refill, elapsed * cnt_safe / 1000.0, 0.0),
+        max_token,
+    )
+    synced = jnp.maximum(cooled - pass_qps, 0.0)
+    do_sync = warm_rows & (cur_sec > filled)
+    tokens_new = jnp.where(do_sync, synced, tokens)
+    # above the warning line the system is still cold and the allowed
+    # rate follows the slope curve (WarmUpController.canPass)
+    above = jnp.maximum(tokens_new - warn, 0.0)
+    warning_qps = 1.0 / (above * slope + 1.0 / cnt_safe)
+    qps = jnp.where(warm_rows & (tokens_new >= warn), warning_qps, cnt)
+    return qps, tokens_new, do_sync, cur_sec
+
+
+def _occupy_feasible(
+    config,
+    try_occupy,
+    passed,
+    expiring,
+    admitted_prefix,
+    waiting,
+    occ_prefix,
+    acquire_f,
+    threshold,
+):
+    """The priority-occupy headroom check (``ClusterFlowChecker.canOccupy``)
+    on gathered ``[N]`` columns — shared by both decide backends (see
+    :func:`_warmup_curve` for why)."""
+    # admitted_prefix: tokens admitted earlier in THIS batch land in the
+    # current bucket, which is still valid at the next window — without
+    # this term a borrow could overcommit the window the batch just filled
+    return try_occupy & (
+        passed - expiring + admitted_prefix + waiting + occ_prefix + acquire_f
+        <= config.max_occupy_ratio * threshold
+    )
+
+
+def _ns_guard(config, spec, ns_state, rules, now, psum, owned, safe_slot, live):
+    """Namespace guard (request-count qps, ``GlobalRequestLimiter.java:46``)
+    — computed identically on every device from global inputs. Shared by
+    both decide backends (it is [N]/[NS]-sized prologue math; the Pallas
+    megakernel never touches the tiny replicated namespace window).
+
+    Returns ``(ns_id, ns_ok, seg_ns_sum)`` where ``seg_ns_sum`` is the
+    per-namespace segment-sum closure reused for the guard-counter update.
+    """
+    ns_id = psum(jnp.where(owned, rules.namespace_id[safe_slot], 0))
+    live_f = live.astype(jnp.float32)
+    # per-namespace totals: on TPU a one-hot matvec (the MXU eats it, a
+    # 64-wide scatter serializes); off-TPU the scatter-add wins ~4× and
+    # skips materializing the [N, NS] one-hot on the fast path entirely
+    on_tpu = jax.default_backend() == "tpu"
+
+    def _ns_one_hot():
+        return (
+            ns_id[:, None] == jnp.arange(config.max_namespaces)[None, :]
+        ).astype(jnp.float32)
+
+    def seg_ns_sum(vals):
+        if on_tpu:
+            # XLA CSE dedupes the identical one-hot across call sites
+            return jnp.einsum(
+                "nk,n->k", _ns_one_hot(), vals,
+                precision=jax.lax.Precision.HIGHEST,  # exact int counts
+            )
+        return jnp.zeros(
+            (config.max_namespaces,), jnp.float32
+        ).at[ns_id].add(vals)
+    # Dense per-namespace view ([NS], cheap): a request's verdict needs the
+    # per-request in-batch prefix ONLY when a namespace's budget boundary
+    # falls inside this batch. With already = valid-window count and
+    # total = live requests of that namespace in the batch:
+    #   fits-all:   already + total <= budget  → every request passes
+    #   none-pass:  already + 1     >  budget  → every request blocks
+    # and both reduce to ok = (already + 1 <= budget) applied per
+    # namespace. Only a boundary-crossing namespace (already+total >
+    # budget AND already+1 <= budget) needs the [N, NS] cumsum — rare in
+    # steady state, so it lives behind a cond. All inputs here are global
+    # (ns window replicated, ns_id/live psum-stitched), making the
+    # predicate mesh-uniform and the cond safe under shard_map.
+    ns_live_tot = seg_ns_sum(live_f)
+    ns_ids_dense = jnp.arange(config.max_namespaces, dtype=jnp.int32)
+    ns_already_dense = W.window_sum_at(
+        spec, ns_state, now, 0, ns_ids_dense
+    ).astype(jnp.float32)
+    ns_budget_dense = rules.ns_max_qps * (spec.interval_ms / 1000.0)
+    crossing = (
+        (ns_live_tot > 0)
+        & (ns_already_dense + ns_live_tot > ns_budget_dense)
+        & (ns_already_dense + 1.0 <= ns_budget_dense)
+    )
+
+    def ns_ok_precise(_):
+        ns_incl = _blocked_cumsum(_ns_one_hot() * live_f[:, None])
+        ns_prefix = (
+            jnp.take_along_axis(ns_incl, ns_id[:, None], axis=1)[:, 0]
+            - live_f
+        )
+        ns_already = ns_already_dense[ns_id]
+        ns_budget = ns_budget_dense[ns_id]
+        return (ns_already + ns_prefix + 1.0) <= ns_budget
+
+    def ns_ok_fast(_):
+        ok_ns = (ns_already_dense + 1.0) <= ns_budget_dense
+        return ok_ns[ns_id]
+
+    ns_ok = jax.lax.cond(
+        jnp.any(crossing), ns_ok_precise, ns_ok_fast, None
+    )
+    return ns_id, ns_ok, seg_ns_sum
+
+
 def _decide_core(
     config: EngineConfig,
     state: EngineState,
@@ -234,77 +383,12 @@ def _decide_core(
 
     acquire_f = batch.acquire.astype(jnp.float32)
 
-    # ------------------------------------------------------------------
-    # 1. namespace guard (request-count qps, GlobalRequestLimiter.java:46)
-    #    — computed identically on every device from global inputs
-    # ------------------------------------------------------------------
-    ns_id = psum(jnp.where(owned, rules.namespace_id[safe_slot], 0))
-    live_f = live.astype(jnp.float32)
-    # per-namespace totals: on TPU a one-hot matvec (the MXU eats it, a
-    # 64-wide scatter serializes); off-TPU the scatter-add wins ~4× and
-    # skips materializing the [N, NS] one-hot on the fast path entirely
-    on_tpu = jax.default_backend() == "tpu"
-
-    def _ns_one_hot():
-        return (
-            ns_id[:, None] == jnp.arange(config.max_namespaces)[None, :]
-        ).astype(jnp.float32)
-
-    def seg_ns_sum(vals):
-        if on_tpu:
-            # XLA CSE dedupes the identical one-hot across call sites
-            return jnp.einsum(
-                "nk,n->k", _ns_one_hot(), vals,
-                precision=jax.lax.Precision.HIGHEST,  # exact int counts
-            )
-        return jnp.zeros(
-            (config.max_namespaces,), jnp.float32
-        ).at[ns_id].add(vals)
-    # Dense per-namespace view ([NS], cheap): a request's verdict needs the
-    # per-request in-batch prefix ONLY when a namespace's budget boundary
-    # falls inside this batch. With already = valid-window count and
-    # total = live requests of that namespace in the batch:
-    #   fits-all:   already + total <= budget  → every request passes
-    #   none-pass:  already + 1     >  budget  → every request blocks
-    # and both reduce to ok = (already + 1 <= budget) applied per
-    # namespace. Only a boundary-crossing namespace (already+total >
-    # budget AND already+1 <= budget) needs the [N, NS] cumsum — rare in
-    # steady state, so it lives behind a cond. All inputs here are global
-    # (ns window replicated, ns_id/live psum-stitched), making the
-    # predicate mesh-uniform and the cond safe under shard_map.
-    ns_live_tot = seg_ns_sum(live_f)
-    ns_ids_dense = jnp.arange(config.max_namespaces, dtype=jnp.int32)
-    ns_already_dense = W.window_sum_at(
-        spec, state.ns, now, 0, ns_ids_dense
-    ).astype(jnp.float32)
-    ns_budget_dense = rules.ns_max_qps * (spec.interval_ms / 1000.0)
-    crossing = (
-        (ns_live_tot > 0)
-        & (ns_already_dense + ns_live_tot > ns_budget_dense)
-        & (ns_already_dense + 1.0 <= ns_budget_dense)
-    )
-
-    def ns_ok_precise(_):
-        ns_incl = _blocked_cumsum(_ns_one_hot() * live_f[:, None])
-        ns_prefix = (
-            jnp.take_along_axis(ns_incl, ns_id[:, None], axis=1)[:, 0]
-            - live_f
-        )
-        ns_already = ns_already_dense[ns_id]
-        ns_budget = ns_budget_dense[ns_id]
-        return (ns_already + ns_prefix + 1.0) <= ns_budget
-
-    def ns_ok_fast(_):
-        ok_ns = (ns_already_dense + 1.0) <= ns_budget_dense
-        return ok_ns[ns_id]
-
-    ns_ok = jax.lax.cond(
-        jnp.any(crossing), ns_ok_precise, ns_ok_fast, None
+    ns_id, ns_ok, seg_ns_sum = _ns_guard(
+        config, spec, state.ns, rules, now, psum, owned, safe_slot, live
     )
     too_many = live & ~ns_ok
     ns_admitted = live & ns_ok  # global mask — identical on every device
     active = ns_admitted & owned  # flow evaluation happens on the owner
-
     # ------------------------------------------------------------------
     # 2. per-request threshold (ClusterFlowChecker.java:38-48)
     # ------------------------------------------------------------------
@@ -343,35 +427,16 @@ def _decide_core(
     cnt_safe = jnp.maximum(cnt, 1e-6)
 
     def warm_on(_):
-        # lazy once-per-second token sync (WarmUpController.syncToken):
-        # refill below the warning line (or above it while pass qps stays
-        # under count/coldFactor), clamp to maxToken, then drain one
-        # second's worth of passes. The reference syncs with the previous
-        # second's pass QPS; here the sliding-window pass rate stands in —
-        # the scalar port in tests/test_shaping.py mirrors exactly this.
-        # A NEVER fill stamp makes the first sync see a huge idle gap and
-        # clamp to maxToken: the cold state, for free.
-        pass_qps = passed * (1000.0 / spec.interval_ms)
-        cur_sec = now - now % 1000
-        filled = state.shaping.warm_filled[safe_slot]
-        tokens = state.shaping.warm_tokens[safe_slot]
-        warn = rules.warning_token[safe_slot]
-        can_refill = (tokens < warn) | (
-            (tokens > warn) & (pass_qps < rules.cold_count[safe_slot])
-        )
-        elapsed = (cur_sec - filled).astype(jnp.float32)
-        cooled = jnp.minimum(
-            tokens + jnp.where(can_refill, elapsed * cnt_safe / 1000.0, 0.0),
+        qps_, tokens_new, do_sync, cur_sec = _warmup_curve(
+            spec, now, passed, cnt, cnt_safe,
+            rules.warning_token[safe_slot],
             rules.max_token[safe_slot],
+            rules.slope[safe_slot],
+            rules.cold_count[safe_slot],
+            state.shaping.warm_filled[safe_slot],
+            state.shaping.warm_tokens[safe_slot],
+            warm_rows,
         )
-        synced = jnp.maximum(cooled - pass_qps, 0.0)
-        do_sync = warm_rows & (cur_sec > filled)
-        tokens_new = jnp.where(do_sync, synced, tokens)
-        # above the warning line the system is still cold and the allowed
-        # rate follows the slope curve (WarmUpController.canPass)
-        above = jnp.maximum(tokens_new - warn, 0.0)
-        warning_qps = 1.0 / (above * rules.slope[safe_slot] + 1.0 / cnt_safe)
-        qps_ = jnp.where(warm_rows & (tokens_new >= warn), warning_qps, cnt)
         # duplicate same-flow rows scatter identical values (pure function
         # of state + now), so .set stays deterministic
         scat = jnp.where(do_sync, safe_slot, f_local)
@@ -529,13 +594,9 @@ def _decide_core(
         )
         occ_contrib = jnp.where(try_occupy, acquire_f, 0.0)
         occ_prefix = flow_prefix(occ_contrib)  # conservative: all triers count
-        # admitted_prefix: tokens admitted earlier in THIS batch land in the
-        # current bucket, which is still valid at the next window — without
-        # this term a borrow could overcommit the window the batch just filled
-        return try_occupy & (
-            passed - expiring + admitted_prefix + waiting + occ_prefix
-            + acquire_f
-            <= config.max_occupy_ratio * threshold
+        return _occupy_feasible(
+            config, try_occupy, passed, expiring, admitted_prefix, waiting,
+            occ_prefix, acquire_f, threshold,
         )
 
     can_occupy = jax.lax.cond(
@@ -676,6 +737,92 @@ def _decide_core(
     return new_state, verdicts
 
 
+_AUTO_DECIDE_IMPL: dict = {}  # backend platform → probed choice (per process)
+
+
+def resolve_decide_impl(impl: str) -> str:
+    """Resolve ``EngineConfig.decide_impl`` to a concrete step backend
+    ("xla" | "pallas") — same selection discipline as
+    ``engine.param.resolve_param_impl``.
+
+    "auto" picks per platform: the ``SENTINEL_DECIDE_IMPL`` env var wins if
+    set; off-TPU the XLA pipeline is chosen outright (interpret-mode pallas
+    exists for parity testing, not serving); on TPU both steps are
+    micro-probed once per process and the faster one is cached. A megakernel
+    that fails to compile (Mosaic version skew) simply loses the probe.
+    """
+    if impl in ("xla", "pallas"):
+        return impl
+    if impl != "auto":
+        raise ValueError(
+            f"unknown decide impl {impl!r}; use 'auto'|'xla'|'pallas'"
+        )
+    import os
+
+    env = os.environ.get("SENTINEL_DECIDE_IMPL", "").strip().lower()
+    if env in ("xla", "pallas"):
+        return env
+    platform = jax.default_backend()
+    choice = _AUTO_DECIDE_IMPL.get(platform)
+    if choice is None:
+        choice = "xla" if platform != "tpu" else _probe_decide_impl()
+        _AUTO_DECIDE_IMPL[platform] = choice
+    return choice
+
+
+def _probe_decide_impl() -> str:
+    """Time one warm grouped step of each backend on the live backend (small
+    probe shapes — the comparison is kernel-vs-kernel, not absolute)."""
+    import time as _time
+
+    from sentinel_tpu.engine.rules import build_rule_table
+    from sentinel_tpu.engine.state import make_state
+
+    best_dt = None
+    choice = "xla"
+    for name in ("xla", "pallas"):
+        cfg = EngineConfig(
+            max_flows=256, batch_size=64, decide_impl=name
+        )
+        try:
+            core = _core_for(cfg, grouped=True)
+            step = jax.jit(
+                partial(core, cfg, axis_name=None, grouped=True,
+                        uniform=False)
+            )
+            state = make_state(cfg)
+            rules, _ = build_rule_table(cfg, [])
+            batch = make_batch(cfg, [0, 1, 2])
+            _, v = step(state, rules, batch, jnp.int32(1000))  # compile+warm
+            jax.block_until_ready(v.status)
+            t0 = _time.perf_counter()
+            for _ in range(3):
+                _, v = step(state, rules, batch, jnp.int32(1000))
+            jax.block_until_ready(v.status)
+            dt = _time.perf_counter() - t0
+        except Exception:
+            continue  # backend unusable here: the other wins
+        if best_dt is None or dt < best_dt:
+            best_dt, choice = dt, name
+    return choice
+
+
+def _core_for(config: EngineConfig, grouped: bool):
+    """The decide-core callable for this config's resolved backend.
+
+    The Pallas megakernel depends on the grouped-batch contract (same-flow
+    rows contiguous — its segment-tail read-modify-write scatter is only
+    race-free then), so non-grouped callers always get the XLA pipeline.
+    Batches above the kernel's VMEM cap also fall back inside the pallas
+    core itself (see ``ops/decide_pallas.py``).
+    """
+    if grouped and resolve_decide_impl(config.decide_impl) == "pallas":
+        from sentinel_tpu.ops.decide_pallas import decide_core_pallas
+
+        return decide_core_pallas
+    return _decide_core
+
+
 @partial(jax.jit, static_argnames=("config", "grouped", "uniform"))
 def decide(
     config: EngineConfig,
@@ -692,7 +839,7 @@ def decide(
     :func:`_decide_core`); the host batcher sets them per batch when its
     layout guarantees hold, selecting one of four compiled variants.
     """
-    return _decide_core(
+    return _core_for(config, grouped)(
         config, state, rules, batch, now, axis_name=None,
         grouped=grouped, uniform=uniform,
     )
@@ -713,7 +860,7 @@ def decide_donating(config: EngineConfig, grouped: bool = False,
     """
     return jax.jit(
         partial(
-            _decide_core, config, axis_name=None,
+            _core_for(config, grouped), config, axis_name=None,
             grouped=grouped, uniform=uniform,
         ),
         donate_argnums=(0,),
@@ -742,7 +889,7 @@ def decide_fused_donating(config: EngineConfig, depth: int,
     if depth < 1:
         raise ValueError(f"fused depth must be >= 1, got {depth}")
     core = partial(
-        _decide_core, config, axis_name=None, grouped=grouped,
+        _core_for(config, grouped), config, axis_name=None, grouped=grouped,
         uniform=uniform,
     )
 

@@ -92,7 +92,7 @@ class ServerMetrics:
     _GAUGE_NAMES = (
         "queue_depth", "inflight_batches", "connections",
         "dispatch_lane_depth", "reply_lane_depth",
-        "shm_ring_occupancy",
+        "shm_ring_occupancy", "device_inflight",
     )
 
     def __init__(self):
@@ -140,6 +140,11 @@ class ServerMetrics:
         # report bytes-copied-per-verdict
         self._copy_bytes = 0
         self._copy_lock = threading.Lock()
+        # double-buffered device lane: host prep/dispatch time spent while
+        # an earlier fused group was still computing on device — work a
+        # depth-1 lane would have serialized behind block_until_ready
+        self._overlap_ms = 0.0
+        self._overlap_lock = threading.Lock()
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._gauge_lock = threading.Lock()
         # sketch observability: the live token service registers a zero-arg
@@ -215,6 +220,20 @@ class ServerMetrics:
     def host_copy_bytes_total(self) -> int:
         with self._copy_lock:
             return self._copy_bytes
+
+    def count_overlap_saved_ms(self, ms: float) -> None:
+        """``ms`` of host prep/dispatch that ran while an earlier fused
+        group was still in flight on device (the pipelined device lane's
+        measured win over a serialized depth-1 lane)."""
+        if ms <= 0:
+            return
+        with self._overlap_lock:
+            self._overlap_ms += float(ms)
+
+    @property
+    def overlap_saved_ms_total(self) -> float:
+        with self._overlap_lock:
+            return self._overlap_ms
 
     # -- shed counters ------------------------------------------------------
     def count_shed(self, reason: str, n: int = 1) -> None:
@@ -512,6 +531,7 @@ class ServerMetrics:
             "shedTotal": self.shed_total,
             "shedByReason": self.shed_totals(),
             "hostCopyBytesTotal": self.host_copy_bytes_total,
+            "overlapSavedMsTotal": round(self.overlap_saved_ms_total, 3),
             "intakeShards": {
                 str(k): v for k, v in sorted(self.shard_totals().items())
             },
@@ -558,6 +578,7 @@ class ServerMetrics:
         out["fused_frames_total"] = self.fused_frames_total
         out["shed_total"] = self.shed_totals()
         out["host_copy_bytes_total"] = self.host_copy_bytes_total
+        out["overlap_saved_ms_total"] = round(self.overlap_saved_ms_total, 3)
         out["intake_shards"] = {
             str(k): v for k, v in sorted(self.shard_totals().items())
         }
@@ -625,6 +646,17 @@ class ServerMetrics:
         lines.append(
             f"sentinel_server_host_copy_bytes_total "
             f"{self.host_copy_bytes_total}"
+        )
+        lines.append(
+            "# HELP sentinel_server_overlap_saved_ms_total Host prep/"
+            "dispatch time spent while an earlier fused group was still "
+            "computing on device — serialized time a depth-1 device lane "
+            "would have added (ms, cumulative)."
+        )
+        lines.append("# TYPE sentinel_server_overlap_saved_ms_total counter")
+        lines.append(
+            "sentinel_server_overlap_saved_ms_total "
+            f"{self.overlap_saved_ms_total:g}"
         )
         shards = self.shard_totals()
         if shards:
@@ -815,6 +847,9 @@ class ServerMetrics:
             ("shm_ring_occupancy",
              "Fraction of shm request-ring slots occupied across attached "
              "segments (sampled; 0 when no shm door is serving)."),
+            ("device_inflight",
+             "Fused groups dispatched to the device and not yet "
+             "materialized (bounded by max_device_inflight)."),
         ):
             lines.append(f"# HELP sentinel_server_{name} {help_text}")
             lines.append(f"# TYPE sentinel_server_{name} gauge")

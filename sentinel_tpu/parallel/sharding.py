@@ -23,7 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sentinel_tpu.engine.config import EngineConfig
-from sentinel_tpu.engine.decide import RequestBatch, VerdictBatch, _decide_core
+from sentinel_tpu.engine.decide import RequestBatch, VerdictBatch, _core_for
 from sentinel_tpu.engine.rules import RuleTable
 from sentinel_tpu.engine.state import EngineState, ShapingState
 from sentinel_tpu.stats.window import WindowState
@@ -180,9 +180,14 @@ def make_sharded_decide(
             f"max_flows={config.max_flows} must be divisible by mesh size {n}"
         )
 
+    # decide_impl-aware: the Pallas megakernel runs per shard inside the
+    # shard_map body (its psums ride the [N]-sized verdict stitching exactly
+    # like the XLA pipeline's — the kernel itself never sees a collective)
+    core = _core_for(config, grouped)
+
     if depth is None:
         def step(state, rules, batch, now):
-            return _decide_core(
+            return core(
                 config, state, rules, batch, now, axis_name=axis,
                 grouped=grouped, uniform=uniform,
             )
@@ -192,7 +197,7 @@ def make_sharded_decide(
 
         def step(state, rules, batches, now):
             def body(st, batch):
-                st, verdicts = _decide_core(
+                st, verdicts = core(
                     config, st, rules, batch, now, axis_name=axis,
                     grouped=grouped, uniform=uniform,
                 )

@@ -442,3 +442,112 @@ class TestNativeMixedSoak:
             f"{n_lanes} intake lanes (built={pool.built}, "
             f"reused={pool.reused})"
         )
+
+
+class TestDeviceLanePipelining:
+    """Double-buffered device lane: the ``max_device_inflight`` permit
+    bound, permit release discipline on every exit path, and the
+    overlap/inflight observability surface."""
+
+    def _server(self, **kw):
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=1e9, mode=G)])
+        return NativeTokenServer(svc, port=0, idle_ttl_s=None, **kw), svc
+
+    def test_tracked_dispatch_permit_lifecycle(self):
+        server, _ = self._server(max_device_inflight=2)
+        calls = []
+
+        def fake_dispatch(ids, counts, prios):
+            calls.append(len(ids))
+            return lambda: ("status", "remaining", "wait")
+
+        ids = np.array([1], np.int64)
+        cnt = np.array([1], np.int32)
+        pri = np.array([False], bool)
+        mat1, rel1, ov1 = server._tracked_dispatch(
+            fake_dispatch, ids, cnt, pri
+        )
+        assert server._device_inflight == 1 and ov1 is False
+        mat2, rel2, ov2 = server._tracked_dispatch(
+            fake_dispatch, ids, cnt, pri
+        )
+        # second group dispatched while the first is in flight
+        assert server._device_inflight == 2 and ov2 is True
+        assert mat1() == ("status", "remaining", "wait")
+        assert server._device_inflight == 1
+        rel1()  # idempotent with mat1's own release
+        assert server._device_inflight == 1
+        rel2()  # abandon-path escape hatch, mat2 never materialized
+        assert server._device_inflight == 0
+        mat2()
+        assert server._device_inflight == 0
+        assert calls == [1, 1]
+
+    def test_tracked_dispatch_releases_on_dispatch_error(self):
+        server, _ = self._server(max_device_inflight=2)
+
+        def boom(ids, counts, prios):
+            raise RuntimeError("device fell over")
+
+        with pytest.raises(RuntimeError):
+            server._tracked_dispatch(
+                boom, np.array([1], np.int64),
+                np.array([1], np.int32), np.array([False], bool),
+            )
+        assert server._device_inflight == 0
+
+    def test_inflight_bound_blocks_third_dispatch(self):
+        server, _ = self._server(max_device_inflight=1)
+        mat1, rel1, _ = server._tracked_dispatch(
+            lambda *a: (lambda: None),
+            np.array([1], np.int64), np.array([1], np.int32),
+            np.array([False], bool),
+        )
+        entered = threading.Event()
+        done = threading.Event()
+
+        def second():
+            entered.set()
+            server._tracked_dispatch(
+                lambda *a: (lambda: None),
+                np.array([1], np.int64), np.array([1], np.int32),
+                np.array([False], bool),
+            )[1]()  # release immediately once admitted
+            done.set()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        assert entered.wait(2.0)
+        # permit wait holds the second dispatch while the first is live
+        assert not done.wait(0.4)
+        rel1()
+        assert done.wait(2.0), "release must unblock the waiting dispatch"
+        t.join(timeout=2.0)
+        assert server._device_inflight == 0
+
+    def test_overlap_surface_and_gauge_drain(self):
+        from sentinel_tpu.metrics.server import server_metrics
+
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([ClusterFlowRule(flow_id=1, count=1e9, mode=G)])
+        server = NativeTokenServer(svc, port=0, idle_ttl_s=None)
+        server.start()
+        try:
+            client = TokenClient("127.0.0.1", server.port)
+            try:
+                for _ in range(30):
+                    client.request_batch([(1, 1, False)] * 32)
+            finally:
+                client.close()
+            snap = server_metrics().snapshot()
+            assert "overlapSavedMsTotal" in snap
+            assert snap["overlapSavedMsTotal"] >= 0.0
+            assert "device_inflight" in snap["gauges"]
+            text = server_metrics().render()
+            assert "sentinel_server_overlap_saved_ms_total" in text
+            assert "sentinel_server_device_inflight" in text
+        finally:
+            server.stop()
+        # every permit taken on the traffic above was released
+        assert server._device_inflight == 0

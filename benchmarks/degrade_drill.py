@@ -1,0 +1,345 @@
+"""Seeded circuit-breaker drill (the CI ``degrade-smoke`` gate).
+
+Service-level and fully deterministic: a ``ManualClock`` drives the token
+service (no sleeps, no wall time), completions come from the shared seeded
+``OutcomeProfile`` generators, so the gates are exact claims about the
+breaker columns, not timing-tolerant approximations. Four gates:
+
+1. **OPEN within one stat interval.** An error storm (``error_storm_profile``
+   at 40% failures) must trip the ERROR_RATIO breaker to OPEN — first
+   DEGRADED verdict — within ``stat_interval_ms`` + one drive tick of the
+   storm's onset; a slow-dependency ramp (``slow_dependency_profile``) must
+   trip the SLOW_REQUEST_RATIO breaker once the host-side trailing-window
+   slow ratio actually crosses the threshold (the trip may never precede
+   the evidence).
+2. **Exactly one HALF_OPEN probe under a fused 3-deep burst.** After the
+   recovery timeout, a single 3×batch burst — dispatched as ONE fused
+   ``lax.scan`` device step (``fuse_depths=(3,)``) — gets exactly one OK
+   row (the elected probe) and DEGRADED for every other row, across all
+   three chained frames. The same-flow prefix election must stay exact
+   under fusion, not just per-dispatch.
+3. **Recovery after a healthy probe.** Reporting one fast, non-exception
+   completion for the probe closes the breaker; the next batch serves OK.
+   (A failing probe is also drilled: it must snap straight back to OPEN.)
+4. **Bit-equal breaker state across snapshot/restore and MOVE.** A
+   snapshot restored into a fresh service reproduces the breaker columns
+   bit-for-bit; a namespace MOVE blob re-anchors the relative clocks such
+   that the destination's DEGRADED retry-after equals the source's.
+
+Exit code is nonzero on any violated gate::
+
+    JAX_PLATFORMS=cpu python benchmarks/degrade_drill.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SCHEMA = "sentinel-degrade-drill/1"
+RESULTS_DIR = os.path.join(REPO, "benchmarks", "results")
+
+
+def run_drill(seed: int = 20260807, verbose: bool = True) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from benchmarks.workload import (
+        error_storm_profile,
+        slow_dependency_profile,
+    )
+    from sentinel_tpu.core import clock as _clock
+    from sentinel_tpu.engine import (
+        ClusterFlowRule,
+        DegradeRule,
+        DegradeStrategy,
+        EngineConfig,
+        TokenStatus,
+    )
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+    mc = _clock.ManualClock(1_000_000)
+    old_clock = _clock.set_clock(mc)
+    violations = []
+    try:
+        cfg = EngineConfig(max_flows=16, max_namespaces=4, batch_size=32)
+        cap = cfg.batch_size
+        stat_ms = 1000
+        recovery_ms = 2000
+        tick_ms = 100
+        err_fid, slow_fid = 1, 2
+        svc = DefaultTokenService(cfg, fuse_depths=(3,))
+        svc.load_rules([
+            ClusterFlowRule(err_fid, 1e9, namespace="errns"),
+            ClusterFlowRule(slow_fid, 1e9, namespace="slowns"),
+        ])
+        svc.load_degrade_rules([
+            DegradeRule(err_fid, DegradeStrategy.ERROR_RATIO,
+                        threshold=0.2, min_request_amount=20,
+                        stat_interval_ms=stat_ms,
+                        recovery_timeout_ms=recovery_ms,
+                        namespace="errns"),
+            DegradeRule(slow_fid, DegradeStrategy.SLOW_REQUEST_RATIO,
+                        threshold=0.5, slow_rt_ms=12,
+                        min_request_amount=20,
+                        stat_interval_ms=stat_ms,
+                        recovery_timeout_ms=recovery_ms,
+                        namespace="slowns"),
+        ])
+        storm = error_storm_profile()
+        slow = slow_dependency_profile()
+
+        def drive(fid, profile, frac):
+            """One tick: offer ``cap`` rows, report outcomes for admitted
+            rows from the seeded profile at run fraction ``frac``. Returns
+            the verdict array."""
+            fids = np.full(cap, fid, np.int64)
+            st, _, _ = svc.request_batch_arrays(fids)
+            st = np.asarray(st)
+            n_ok = int((st == int(TokenStatus.OK)).sum())
+            if n_ok:
+                rt, exc, _ = profile.sample(n_ok, seed, frac)
+                svc.report_outcomes(
+                    np.full(n_ok, fid, np.int64),
+                    np.clip(rt, 0, 59_000).astype(np.int64),
+                    exc.astype(np.int64),
+                )
+            return st
+
+        # -- gate 1a: error storm trips within one stat interval -------------
+        n_ticks = 36  # 3.6s drive; storm holds [1/3, 2/3) of the run
+        storm_onset_ms = None
+        err_first_degraded_ms = None
+        for i in range(n_ticks):
+            frac = i / n_ticks
+            if storm_onset_ms is None and frac >= storm.storm_window[0]:
+                storm_onset_ms = mc.now_ms()
+            st = drive(err_fid, storm, frac)
+            if (
+                err_first_degraded_ms is None
+                and (st == int(TokenStatus.DEGRADED)).any()
+            ):
+                err_first_degraded_ms = mc.now_ms()
+            mc.advance(tick_ms)
+        if err_first_degraded_ms is None:
+            violations.append("error storm never tripped the breaker OPEN")
+            trip_lag_ms = None
+        else:
+            trip_lag_ms = err_first_degraded_ms - storm_onset_ms
+            if trip_lag_ms > stat_ms + tick_ms:
+                violations.append(
+                    f"error-ratio breaker opened {trip_lag_ms}ms after "
+                    f"storm onset (budget {stat_ms + tick_ms}ms)"
+                )
+
+        # -- gate 1b: slow-dependency ramp trips, never before the evidence --
+        reported = []  # (engine_ms, rt_ms) of every reported slow-flow row
+        slow_trip_ms = None
+        for i in range(n_ticks):
+            frac = i / n_ticks
+            fids = np.full(cap, slow_fid, np.int64)
+            st = np.asarray(svc.request_batch_arrays(fids)[0])
+            if (
+                slow_trip_ms is None
+                and (st == int(TokenStatus.DEGRADED)).any()
+            ):
+                slow_trip_ms = mc.now_ms()
+            n_ok = int((st == int(TokenStatus.OK)).sum())
+            if n_ok:
+                rt, exc, _ = slow.sample(n_ok, seed, frac)
+                rt = np.clip(rt, 0, 59_000).astype(np.int64)
+                now = mc.now_ms()
+                reported.extend((now, int(r)) for r in rt)
+                svc.report_outcomes(
+                    np.full(n_ok, slow_fid, np.int64), rt,
+                    exc.astype(np.int64),
+                )
+            mc.advance(tick_ms)
+        if slow_trip_ms is None:
+            violations.append(
+                "slow-dependency ramp never tripped the breaker OPEN"
+            )
+        else:
+            # the device fences stats at BUCKET granularity (whole buckets
+            # with starts >= now - stat_ms), so the host mirror widens its
+            # trailing window by one bucket: the trip must be justified by
+            # the evidence some device-visible alignment saw
+            bucket_ms = svc.config.bucket_ms
+            win = [(t, r) for t, r in reported
+                   if slow_trip_ms - stat_ms - bucket_ms <= t < slow_trip_ms]
+            n_slow = sum(1 for _, r in win if r > 12)
+            ratio = n_slow / max(1, len(win))
+            if len(win) >= 20 and ratio <= 0.4:
+                violations.append(
+                    f"slow-ratio breaker tripped at trailing-window ratio "
+                    f"{ratio:.2f} far below threshold 0.5 "
+                    f"({n_slow}/{len(win)})"
+                )
+
+        # -- gate 2: exactly one probe under a fused 3-deep burst -------------
+        mc.advance(recovery_ms + tick_ms)
+        burst = np.full(3 * cap, err_fid, np.int64)
+        st = np.asarray(svc.request_batch_arrays(burst)[0])
+        n_ok = int((st == int(TokenStatus.OK)).sum())
+        n_deg = int((st == int(TokenStatus.DEGRADED)).sum())
+        if n_ok != 1 or n_deg != 3 * cap - 1:
+            violations.append(
+                f"fused 3-deep HALF_OPEN burst admitted {n_ok} probes "
+                f"({n_deg} degraded) — want exactly 1 ({3 * cap - 1})"
+            )
+        bstats = svc.breaker_stats()["flows"].get(err_fid, {})
+        if bstats.get("state") != "half_open":
+            violations.append(
+                f"breaker not HALF_OPEN after probe election: {bstats}"
+            )
+
+        # -- gate 3a: failing probe snaps back OPEN ---------------------------
+        svc.report_outcomes(np.array([err_fid], np.int64),
+                            np.array([5], np.int64),
+                            np.array([1], np.int64))  # probe threw
+        if svc.breaker_stats()["flows"][err_fid]["state"] != "open":
+            violations.append("failed probe did not reopen the breaker")
+
+        # -- gate 3b: healthy probe closes ------------------------------------
+        mc.advance(recovery_ms + tick_ms)
+        st = np.asarray(
+            svc.request_batch_arrays(np.array([err_fid], np.int64))[0]
+        )
+        if int(st[0]) != int(TokenStatus.OK):
+            violations.append(
+                f"post-recovery probe refused (status {int(st[0])})"
+            )
+        svc.report_outcomes(np.array([err_fid], np.int64),
+                            np.array([5], np.int64),
+                            np.array([0], np.int64))  # probe healthy
+        if svc.breaker_stats()["flows"][err_fid]["state"] != "closed":
+            violations.append("healthy probe did not close the breaker")
+        st = np.asarray(
+            svc.request_batch_arrays(np.full(cap, err_fid, np.int64))[0]
+        )
+        if not (st == int(TokenStatus.OK)).all():
+            violations.append("recovered flow still refusing after close")
+
+        # -- gate 4: HA bit-equality ------------------------------------------
+        # slow flow is still OPEN; snapshot → fresh service → bit-equal
+        src = svc.export_state()
+        twin = DefaultTokenService(cfg, fuse_depths=(3,))
+        twin.import_state(src)
+        dst = twin.export_state()
+        for key in ("state", "opened_ms", "probe_ms"):
+            if not np.array_equal(
+                np.asarray(src["breaker"][key]),
+                np.asarray(dst["breaker"][key]),
+            ):
+                violations.append(
+                    f"snapshot restore not bit-equal on breaker.{key}"
+                )
+        # MOVE: pin the slow breaker in a deterministic OPEN state first —
+        # the recovery timeout elapsed during the drive, so the next
+        # request elects a HALF_OPEN probe; fail it (slow) to reopen
+        svc.request_batch_arrays(np.array([slow_fid], np.int64))
+        svc.report_outcomes(np.array([slow_fid], np.int64),
+                            np.array([100], np.int64),
+                            np.array([0], np.int64))  # rt 100 > 12 → reopen
+        st_s, rem_s, _ = svc.request_batch_arrays(
+            np.array([slow_fid], np.int64)
+        )
+        rem_src = int(np.asarray(rem_s)[0])
+        if int(np.asarray(st_s)[0]) != int(TokenStatus.DEGRADED):
+            violations.append("slow breaker not OPEN before the MOVE gate")
+        # the re-anchored clocks must yield the same retry-after at the
+        # destination (imported at the same manual-clock instant)
+        blob = svc.export_namespace_state("slowns")
+        dest = DefaultTokenService(cfg, fuse_depths=(3,))
+        dest.import_namespace_state(blob)
+        st_d, rem_d, _ = dest.request_batch_arrays(
+            np.array([slow_fid], np.int64)
+        )
+        if int(np.asarray(st_d)[0]) != int(TokenStatus.DEGRADED):
+            violations.append("MOVE destination lost the OPEN breaker")
+        elif int(np.asarray(rem_d)[0]) != rem_src:
+            violations.append(
+                f"MOVE retry-after drifted: src {rem_src}ms vs dst "
+                f"{int(np.asarray(rem_d)[0])}ms"
+            )
+        src_code = svc.breaker_stats()["flows"][slow_fid]["state_code"]
+        dst_code = dest.breaker_stats()["flows"][slow_fid]["state_code"]
+        if src_code != dst_code:
+            violations.append(
+                f"MOVE breaker state byte differs: {src_code} vs {dst_code}"
+            )
+
+        doc = {
+            "schema": SCHEMA,
+            "seed": seed,
+            "error_storm": {
+                "stat_interval_ms": stat_ms,
+                "trip_lag_ms": trip_lag_ms,
+                "budget_ms": stat_ms + tick_ms,
+            },
+            "probe": {
+                "fused_depth": 3,
+                "burst_rows": 3 * cap,
+                "probes_admitted": n_ok,
+                "degraded": n_deg,
+            },
+            "transitions": [
+                {"from": f, "to": t, "count": c}
+                for (f, t), c in sorted(
+                    __import__("sentinel_tpu.metrics.server",
+                               fromlist=["server_metrics"])
+                    .server_metrics().breaker_transition_totals().items()
+                )
+            ],
+            "violations": violations,
+        }
+        if verbose:
+            print(json.dumps(doc, indent=2))
+        return doc
+    finally:
+        _clock.set_clock(old_clock)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=20260807)
+    ap.add_argument("--out-dir", default=RESULTS_DIR)
+    ap.add_argument("--no-artifact", action="store_true",
+                    help="skip writing the results JSON")
+    args = ap.parse_args()
+
+    doc = run_drill(seed=args.seed)
+    if not args.no_artifact:
+        os.makedirs(args.out_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        path = os.path.join(args.out_dir, f"degrade-{stamp}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"wrote {path}")
+    if doc["violations"]:
+        for vi in doc["violations"]:
+            print(f"GATE VIOLATED: {vi}", file=sys.stderr)
+        return 1
+    print(
+        "degrade drill ok: "
+        f"error-ratio trip lag {doc['error_storm']['trip_lag_ms']}ms "
+        f"(budget {doc['error_storm']['budget_ms']}ms); "
+        f"{doc['probe']['probes_admitted']} probe / "
+        f"{doc['probe']['degraded']} degraded in the fused "
+        f"{doc['probe']['burst_rows']}-row burst; "
+        "snapshot + MOVE breaker state bit-equal"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,13 +68,24 @@ from benchmarks.workload import (  # noqa: E402
     Phase,
     TenantSpec,
     WorkloadModel,
+    degraded_dependency_tenant,
+    error_storm_profile,
+    slow_dependency_profile,
 )
+
+# OutcomeProfile factories the degraded-tenant drivers can name in
+# TenantSpec.outcome_profile (the driver reports its admitted rows'
+# completions back over the wire, sampled from this profile)
+_OUTCOME_PROFILES = {
+    "error-storm": error_storm_profile,
+    "slow-dependency": slow_dependency_profile,
+}
 
 SCHEMA = "sentinel-scenario/1"
 RESULTS_DIR = os.path.join(_REPO, "benchmarks", "results")
 
 # TokenStatus codes the drivers tally (mirrors metrics/server.VERDICT_NAMES)
-_OK, _BLOCKED, _TOO_MANY, _OVERLOAD = 0, 1, 4, 8
+_OK, _BLOCKED, _TOO_MANY, _OVERLOAD, _DEGRADED = 0, 1, 4, 8, 12
 
 
 # -- configuration ------------------------------------------------------------
@@ -97,6 +108,10 @@ class ScenarioConfig:
     fairness_tolerance: float = 0.25
     lease_tenant: Optional[str] = None
     lease_want: int = 256
+    # the tenant whose metered flow sits behind a circuit breaker (see
+    # degraded_config): the degrade-attribution gate must name it from
+    # the verdict stream, and the breaker must trip AND recover in-run
+    degraded_tenant: Optional[str] = None
     replica: bool = False
     # overload ladder knobs for the run (aggressive vs the conservative
     # production defaults, so a CPU-scale flood actually engages SHED_LOW)
@@ -176,6 +191,48 @@ def full_config(seed: int = 20260805) -> ScenarioConfig:
     )
 
 
+def degraded_config(seed: int = 20260807) -> ScenarioConfig:
+    """The circuit-breaker profile: one healthy tenant plus one tenant
+    whose metered flow guards a flaky dependency (error-storm outcome
+    profile). Phase timing is matched to the profile's storm window
+    (the middle third of the 12 s run = the ``storm`` phase exactly), so
+    the breaker trips OPEN mid-run, and the ``recovery-probe`` phase —
+    deliberately laced with ``conn_reset`` + ``device_stall`` chaos —
+    must still elect HALF_OPEN probes and re-close the breaker. Gates:
+    the degrade-attribution gate names the degraded tenant from the
+    verdict stream alone, and the transition counters must show the
+    full trip AND the in-chaos recovery."""
+    tenants = [
+        TenantSpec("tenant-0", 0, 64, share=0.35, base_rate=2000.0,
+                   zipf_alpha=1.1, batch=24),
+        degraded_dependency_tenant(
+            "tenant-dep", 64, 64, share=0.35, base_rate=2000.0,
+            strategy=1, threshold=0.25, min_requests=20,
+            stat_ms=1000, recovery_ms=1500,
+            outcome_profile="error-storm",
+            zipf_alpha=1.1, batch=24,
+        ),
+    ]
+    phases = [
+        Phase("warmup", 2.0, "steady", measured=False),
+        Phase("steady", 2.0, "steady"),
+        # 4 s..8 s of the 12 s run = frac [1/3, 2/3): exactly the
+        # error-storm profile's 40%-failure window
+        Phase("storm", 4.0, "steady"),
+        Phase("recovery-probe", 4.0, "steady",
+              chaos="conn_reset:p=0.01;device_stall:p=0.1,ms=2"),
+    ]
+    model = WorkloadModel(tenants=tenants, phases=phases, seed=seed)
+    return ScenarioConfig(
+        name="degraded", model=model, flood_tenant=None,
+        degraded_tenant="tenant-dep",
+        # the degraded tenant's DEGRADED refusals are its own
+        # dependency's burn — its gate is the scale's maximum
+        burn_gates={"tenant-0": 60.0, "tenant-dep": 100.0},
+        lease_tenant=None, replica=False,
+    )
+
+
 # -- tenant drivers -----------------------------------------------------------
 class TenantDriver(threading.Thread):
     """Open-loop raw-wire driver for one tenant: frames on an ABSOLUTE
@@ -199,18 +256,31 @@ class TenantDriver(threading.Thread):
         self.metered_flow = metered_flow
         self.stats = [self._zero_stats() for _ in model.phases]
         self._lock = threading.Lock()
+        # sender and reader both write the socket (requests vs piggy-backed
+        # OUTCOME_REPORT frames) — the write lock keeps frames whole
+        self._wlock = threading.Lock()
         self._inflight: Dict[int, tuple] = {}  # xid → (phase_idx, flow_ids)
         self._halt = threading.Event()
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
+        # degraded tenants close the outcome loop: every answered OK row's
+        # completion is reported back over the wire (rev 6), sampled from
+        # the tenant's OutcomeProfile at the run's normalized time — the
+        # error storm these reports carry is what trips the breaker
+        self._profile = (
+            _OUTCOME_PROFILES[tenant.outcome_profile]()
+            if getattr(tenant, "outcome_profile", None) else None
+        )
+        self._total_s = max(sum(ph.seconds for ph in model.phases), 1e-9)
 
     @staticmethod
     def _zero_stats() -> dict:
         return {
             "demand_rows": 0, "sent_rows": 0, "answered_rows": 0,
             "pass": 0, "block": 0, "overload": 0, "too_many": 0,
-            "other": 0, "metered_pass": 0, "skipped_frames": 0,
-            "lost_inflight": 0, "reconnects": 0, "errors": 0,
+            "degraded": 0, "other": 0, "metered_pass": 0,
+            "skipped_frames": 0, "lost_inflight": 0, "reconnects": 0,
+            "reported_rows": 0, "errors": 0,
         }
 
     # -- socket lifecycle --------------------------------------------------
@@ -280,13 +350,40 @@ class TenantDriver(threading.Thread):
                 st["block"] += int((status == _BLOCKED).sum())
                 st["overload"] += int((status == _OVERLOAD).sum())
                 st["too_many"] += int((status == _TOO_MANY).sum())
+                st["degraded"] += int((status == _DEGRADED).sum())
                 st["other"] += n - int(
                     np.isin(status,
-                            (_OK, _BLOCKED, _OVERLOAD, _TOO_MANY)).sum()
+                            (_OK, _BLOCKED, _OVERLOAD, _TOO_MANY,
+                             _DEGRADED)).sum()
                 )
                 st["metered_pass"] += int(
                     ((status == _OK) & (ids == self.metered_flow)).sum()
                 )
+                if self._profile is not None:
+                    ok_ids = ids[status == _OK]
+                    if ok_ids.size:
+                        # only admitted rows reach the dependency, so only
+                        # they produce completions — a breaker that is OPEN
+                        # starves its own stat window, exactly the real
+                        # semantics
+                        frac = (
+                            (time.perf_counter() - self.t0) / self._total_s
+                        )
+                        rt, exc, _inv = self._profile.sample(
+                            ok_ids.size,
+                            self.model.seed ^ (xid & 0xFFFF), frac,
+                        )
+                        out = P.encode_outcome_report(
+                            xid, ok_ids,
+                            np.maximum(rt, 1.0).astype(np.int32),
+                            exc.astype(np.uint8),
+                        )
+                        try:
+                            with self._wlock:
+                                sock.sendall(out)
+                            st["reported_rows"] += int(ok_ids.size)
+                        except OSError:
+                            pass  # sender owns the reconnect
 
     # -- sender ------------------------------------------------------------
     def run(self) -> None:
@@ -331,7 +428,8 @@ class TenantDriver(threading.Thread):
                 with self._lock:
                     self._inflight[xid] = (pi, ids)
                 try:
-                    self._sock.sendall(frame)
+                    with self._wlock:
+                        self._sock.sendall(frame)
                     st["sent_rows"] += batch
                 except OSError:
                     with self._lock:
@@ -466,6 +564,25 @@ def _build_stack(cfg: ScenarioConfig):
     )
     svc.load_rules(rules, ns_max_qps=1e12)
 
+    # degraded tenants: the metered flow guards the flaky dependency —
+    # a DegradeRule with the tenant's knobs turns its br_* rule columns on
+    degrade_rules = []
+    for t in model.tenants:
+        if getattr(t, "degraded", False):
+            from sentinel_tpu.engine import DegradeRule, DegradeStrategy
+
+            degrade_rules.append(DegradeRule(
+                t.first_flow, DegradeStrategy(t.degrade_strategy),
+                threshold=t.degrade_threshold,
+                slow_rt_ms=t.degrade_slow_rt_ms,
+                min_request_amount=t.degrade_min_requests,
+                stat_interval_ms=t.degrade_stat_ms,
+                recovery_timeout_ms=t.degrade_recovery_ms,
+                namespace=t.name,
+            ))
+    if degrade_rules:
+        svc.load_degrade_rules(degrade_rules)
+
     overload = AdmissionController(OverloadConfig(
         min_bdp=cfg.min_bdp,
         headroom_shed=cfg.headroom_shed,
@@ -483,6 +600,10 @@ def _build_stack(cfg: ScenarioConfig):
                 model.tenants) + 2, batch_size=256),
         )
         standby_svc.load_rules(list(rules), ns_max_qps=1e12)
+        if degrade_rules:
+            # the standby needs the same br_* rule columns so replicated
+            # breaker rows mean the same thing after a promotion
+            standby_svc.load_degrade_rules(list(degrade_rules))
         standby = TokenServer(standby_svc, port=0, standby_of="primary")
         standby.start()
         replicate_to = [f"127.0.0.1:{standby.port}"]
@@ -581,6 +702,30 @@ def flood_attribution(base_sums: Dict[str, Dict[str, int]],
         delta = arr_flood - arr_base
         if delta > best_delta:
             best, best_delta = ns, delta
+    return best
+
+
+def degrade_attribution(base_counts: Dict[str, int],
+                        storm_counts: Dict[str, int],
+                        base_s: float, storm_s: float,
+                        exclude=()) -> Optional[str]:
+    """Name the degraded RESOURCE from the verdict stream alone — the
+    flood-attribution mirror for breakers: the tenant with the largest
+    DEGRADED-verdict rate increase between a baseline phase and the storm
+    phase. A breaker refusal is attributed to the dependency that tripped
+    it, not to whichever tenant happened to be loudest; requiring a
+    strictly positive delta means a run where no breaker tripped names
+    nobody."""
+    best, best_delta = None, 0.0
+    for name, c in storm_counts.items():
+        if name in exclude:
+            continue
+        delta = (
+            c / max(storm_s, 1e-9)
+            - base_counts.get(name, 0) / max(base_s, 1e-9)
+        )
+        if delta > best_delta:
+            best, best_delta = name, delta
     return best
 
 
@@ -874,6 +1019,85 @@ def run_scenario(cfg: ScenarioConfig) -> dict:
                     f"{cfg.flood_tenant!r}"
                 )
 
+    # -- gate: the verdict stream names the degraded resource, and the
+    # breaker both trips AND recovers (the recovery landing inside the
+    # chaos-laced recovery-probe phase is the point of the profile) ------
+    degrade_doc = None
+    breaker_doc = None
+    if cfg.degraded_tenant is not None:
+        deg_counts = {
+            name: [st["degraded"] for st in stats]
+            for name, stats in driver_stats.items()
+        }
+        measured_pis = [
+            i for i, ph in enumerate(model.phases) if ph.measured
+        ]
+        storm_pi = max(
+            measured_pis,
+            key=lambda i: sum(c[i] for c in deg_counts.values()),
+        )
+        base_pi = next(i for i in measured_pis if i != storm_pi)
+
+        def _dur(pi: int) -> float:
+            b, e = phase_bounds[pi]
+            return (e - b) / 1000.0
+
+        suspect = degrade_attribution(
+            {n: c[base_pi] for n, c in deg_counts.items()},
+            {n: c[storm_pi] for n, c in deg_counts.items()},
+            _dur(base_pi), _dur(storm_pi),
+        )
+        # breaker_stats forces a final transition scan, so the totals
+        # below include everything up to the last answered frame
+        br = svc.breaker_stats() if hasattr(svc, "breaker_stats") else {}
+        transitions = {
+            f"{frm}->{to}": c
+            for (frm, to), c in sm.breaker_transition_totals().items()
+        }
+        dep_flow = metered[cfg.degraded_tenant]
+        final_state = (
+            (br.get("flows") or {}).get(dep_flow, {}).get("state")
+        )
+        tripped = transitions.get("closed->open", 0) >= 1
+        # the host scan sees NET edges between its ~1/s ticks, so a fast
+        # HALF_OPEN→CLOSED probe cycle may fold into open->closed — either
+        # edge back to CLOSED is the recovery proof
+        recovered = (
+            transitions.get("open->closed", 0)
+            + transitions.get("half_open->closed", 0) >= 1
+            and final_state == "closed"
+        )
+        degraded_rows = sum(c[storm_pi] for c in deg_counts.values())
+        degrade_doc = {
+            "expected": cfg.degraded_tenant, "named": suspect,
+            "stormPhase": model.phases[storm_pi].name,
+            "basePhase": model.phases[base_pi].name,
+            "degradedRowsInStorm": degraded_rows,
+            "tripped": tripped, "recovered": recovered,
+            "finalState": final_state,
+            "ok": bool(
+                suspect == cfg.degraded_tenant and tripped and recovered
+            ),
+        }
+        breaker_doc = {"transitions": transitions, "flows": {
+            str(fid): snap for fid, snap in (br.get("flows") or {}).items()
+        }}
+        if suspect != cfg.degraded_tenant:
+            failures.append(
+                f"verdict stream named {suspect!r} as the degraded "
+                f"resource, expected {cfg.degraded_tenant!r}"
+            )
+        if not tripped:
+            failures.append(
+                "breaker never tripped: no closed->open transition "
+                f"observed (transitions={transitions})"
+            )
+        if not recovered:
+            failures.append(
+                f"breaker did not recover under chaos: final state "
+                f"{final_state!r}, transitions={transitions}"
+            )
+
     overload_snap = server.overload.snapshot() if hasattr(
         server, "overload") else {}
     shed_by_reason = sm.shed_totals()
@@ -896,11 +1120,14 @@ def run_scenario(cfg: ScenarioConfig) -> dict:
         "shares": model.shares(),
         "burnGates": cfg.burn_gates,
         "floodTenant": cfg.flood_tenant,
+        "degradedTenant": cfg.degraded_tenant,
         "tenants": [
             {"name": t.name, "flows": t.n_flows, "share": t.share,
              "baseRate": t.base_rate, "zipfAlpha": t.zipf_alpha,
              "batch": t.batch, "prioritized": t.prioritized,
              "lease": t.name == cfg.lease_tenant,
+             "degraded": bool(getattr(t, "degraded", False)),
+             "outcomeProfile": getattr(t, "outcome_profile", None),
              "meteredFlow": metered[t.name]}
             for t in model.tenants
         ],
@@ -913,6 +1140,7 @@ def run_scenario(cfg: ScenarioConfig) -> dict:
             "clientErrors": {"ok": client_errors == 0,
                              "count": client_errors},
             "floodAttribution": flood_doc,
+            "degradeAttribution": degrade_doc,
             "timelineReconciles": {"ok": recon_ok, "diffs": recon_diffs},
         },
         "slo": fleet,
@@ -922,6 +1150,7 @@ def run_scenario(cfg: ScenarioConfig) -> dict:
             "lease": svc.lease_stats() if hasattr(
                 svc, "lease_stats") else {},
             "maxLeaseTokens": max_lease_tokens,
+            "breaker": breaker_doc,
         },
         "leaseDriver": (
             lease_driver.stats if lease_driver is not None else None
@@ -978,6 +1207,9 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="CI profile: 2 tenants, ramp+spike+chaos, ~15s")
+    ap.add_argument("--degraded", action="store_true",
+                    help="circuit-breaker profile: error-storm tenant, "
+                         "trip + chaos-laced recovery-probe phase, ~12s")
     ap.add_argument("--seed", type=int, default=20260805)
     ap.add_argument("--door", choices=("tcp", "native"), default="tcp")
     ap.add_argument("--objective-ms", type=float, default=None,
@@ -989,7 +1221,12 @@ def main() -> None:
     ap.add_argument("--out-dir", default=RESULTS_DIR)
     args = ap.parse_args()
 
-    cfg = smoke_config(args.seed) if args.smoke else full_config(args.seed)
+    if args.smoke:
+        cfg = smoke_config(args.seed)
+    elif args.degraded:
+        cfg = degraded_config(args.seed)
+    else:
+        cfg = full_config(args.seed)
     cfg.door = args.door
     cfg.out_dir = args.out_dir
     if args.objective_ms is not None:

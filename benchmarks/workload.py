@@ -69,6 +69,18 @@ class TenantSpec:
     warm_up_period_sec: int = 10
     cold_factor: int = 3
     max_queueing_time_ms: int = 500
+    # circuit breaking on this tenant's metered flow: when ``degraded`` is
+    # set the stack builder attaches a DegradeRule with these knobs (field
+    # names mirror DegradeRule; strategy is the DegradeStrategy int) and
+    # the tenant's completions should be driven by ``outcome_profile``
+    degraded: bool = False
+    degrade_strategy: int = 1  # ERROR_RATIO
+    degrade_threshold: float = 0.5
+    degrade_slow_rt_ms: int = 50
+    degrade_min_requests: int = 20
+    degrade_stat_ms: int = 1000
+    degrade_recovery_ms: int = 2000
+    outcome_profile: Optional[str] = None  # OutcomeProfile name to drive
 
     def flow_stream(self, size: int, seed: int) -> np.ndarray:
         """Tenant-local Zipf stream mapped into this tenant's flow range
@@ -107,6 +119,30 @@ def paced_tenant(name: str, first_flow: int, n_flows: int,
     return TenantSpec(
         name, first_flow, n_flows, share, base_rate,
         control_behavior=2, max_queueing_time_ms=max_queueing_time_ms, **kw,
+    )
+
+
+def degraded_dependency_tenant(name: str, first_flow: int, n_flows: int,
+                               share: float, base_rate: float,
+                               strategy: int = 1, threshold: float = 0.5,
+                               slow_rt_ms: int = 50, min_requests: int = 20,
+                               stat_ms: int = 1000, recovery_ms: int = 2000,
+                               outcome_profile: str = "error-storm",
+                               **kw) -> TenantSpec:
+    """A tenant whose metered flow sits behind a CIRCUIT BREAKER guarding a
+    flaky dependency: pair it with an error-storm or slow-dependency
+    ``OutcomeProfile`` and the breaker trips OPEN during the storm (the
+    tenant's verdicts flip to DEGRADED with retry-after hints), elects one
+    HALF_OPEN probe per recovery window, and re-closes when the dependency
+    heals — the scenario harness reads the trip/recovery timeline off this
+    tenant's verdicts and the probe count off its breaker stats."""
+    return TenantSpec(
+        name, first_flow, n_flows, share, base_rate,
+        degraded=True, degrade_strategy=strategy,
+        degrade_threshold=threshold, degrade_slow_rt_ms=slow_rt_ms,
+        degrade_min_requests=min_requests, degrade_stat_ms=stat_ms,
+        degrade_recovery_ms=recovery_ms, outcome_profile=outcome_profile,
+        **kw,
     )
 
 

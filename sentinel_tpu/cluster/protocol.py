@@ -262,6 +262,9 @@ MOVED_STATUS = 10
 # TokenStatus.NOT_LEASABLE, mirrored for the same reason: the rev-5 lease
 # refusal (flow not leasable / no headroom / lease revoked)
 NOT_LEASABLE_STATUS = 11
+# TokenStatus.DEGRADED, mirrored for the same reason: the circuit-breaker
+# refusal (resource breaker OPEN; ``remaining`` carries retry-after ms)
+DEGRADED_STATUS = 12
 
 
 class ReplAck(enum.IntEnum):

@@ -181,6 +181,7 @@ def encode_move_state_blob(doc: Dict[str, object]) -> bytes:
     """``export_namespace_state()`` document → compressed wire blob (rules
     serialize with the ha.snapshot idiom, arrays with its base64+zlib
     codec)."""
+    from sentinel_tpu.engine.rules import encode_degrade_rule as _enc_degrade
     from sentinel_tpu.engine.rules import encode_rule as _encode_rule
 
     out: Dict[str, object] = {
@@ -213,6 +214,17 @@ def encode_move_state_blob(doc: Dict[str, object]) -> bytes:
     ):
         if k in doc:
             out[k] = _enc_array(doc[k])
+    # the breaker plane: its rules, the moved flows' completion windows,
+    # and the state columns with relative clocks (absent in pre-breaker
+    # exports — the destination then starts those flows CLOSED/cold)
+    if doc.get("degrade_rules"):
+        out["degrade_rules"] = [_enc_degrade(d) for d in doc["degrade_rules"]]
+    for k in (
+        "outcome_sums", "breaker_state",
+        "breaker_opened_rel", "breaker_probe_rel",
+    ):
+        if k in doc:
+            out[k] = _enc_array(doc[k])
     return zlib.compress(json.dumps(out, separators=(",", ":")).encode())
 
 
@@ -221,6 +233,7 @@ def decode_move_state_blob(blob: bytes) -> Dict[str, object]:
     ``ValueError`` on any malformed input (fuzz-safe — corrupt bytes must
     never kill the destination door)."""
     from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
+    from sentinel_tpu.engine.rules import decode_degrade_rule as _dec_degrade
     from sentinel_tpu.engine.rules import decode_rule as _decode_rule
 
     try:
@@ -254,9 +267,21 @@ def decode_move_state_blob(blob: bytes) -> Dict[str, object]:
                     "shaping_lpt_rel",
                     "shaping_warm_tokens",
                     "shaping_warm_filled_rel",
+                    "outcome_sums",
+                    "breaker_state",
+                    "breaker_opened_rel",
+                    "breaker_probe_rel",
                 )
                 if k in out
             },
+            **(
+                {
+                    "degrade_rules": [
+                        _dec_degrade(d) for d in out["degrade_rules"]
+                    ]
+                }
+                if "degrade_rules" in out else {}
+            ),
         }
     except ValueError:
         raise

@@ -28,6 +28,7 @@ from sentinel_tpu import chaos as _chaos
 from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.engine import (
     ClusterFlowRule,
+    DegradeRule,
     EngineConfig,
     EngineState,
     TokenStatus,
@@ -141,6 +142,16 @@ class TokenResult:
         # RELEASE_OK is the success status of a concurrent release — the one
         # natural success predicate must cover both acquire and release paths
         return self.status in (TokenStatus.OK, TokenStatus.RELEASE_OK)
+
+    @property
+    def retry_after_ms(self) -> int:
+        """DEGRADED only: how long until the flow's breaker admits a
+        recovery probe (``remaining`` carries it on the wire, like the
+        MOVED epoch). 0 for every other status."""
+        return (
+            int(self.remaining)
+            if self.status == TokenStatus.DEGRADED else 0
+        )
 
 
 class TokenService:
@@ -338,6 +349,20 @@ class DefaultTokenService(TokenService):
         # without walking namespaces
         self._rule_of: Dict[int, ClusterFlowRule] = {}
         self._param_rules_src: Dict[int, "ClusterParamFlowRule"] = {}
+        # device-resident circuit breakers (engine/degrade.py): the source
+        # DegradeRule objects keyed by flow_id (compiled into the br_*
+        # rule-table columns on every load_rules), the slots that carry a
+        # breaker (dirty-set and lease-refusal gating), and the host-side
+        # state mirror the transition scanner diffs against (int8[F] copy
+        # of the last breaker.state this host observed — the device is the
+        # authority; the mirror only exists to emit
+        # sentinel_breaker_transitions_total edges and the CLOSED→OPEN
+        # blackbox dump without a device round-trip per transition).
+        self._degrade_rules_src: Dict[int, "DegradeRule"] = {}
+        self._has_breakers = False
+        self._breaker_slots: set = set()
+        self._breaker_prev: Optional[np.ndarray] = None
+        self._breaker_scan_ts = 0.0
         # namespaces this server explicitly serves (modifyNamespaceSet);
         # unioned with namespaces of loaded rules for info/fetchConfig
         self.namespace_set: set = set()
@@ -441,6 +466,11 @@ class DefaultTokenService(TokenService):
         }
         _SM.register_outcome_provider(
             lambda: (lambda s: s.outcome_stats() if s is not None else {})(
+                _self()
+            )
+        )
+        _SM.register_breaker_provider(
+            lambda: (lambda s: s.breaker_stats() if s is not None else {})(
                 _self()
             )
         )
@@ -589,11 +619,22 @@ class DefaultTokenService(TokenService):
                 by_ns.setdefault(r.namespace, {})[r.flow_id] = r
             self._rules_by_ns = by_ns
             self._rule_of = {r.flow_id: r for r in rules}
+            degrade = list(self._degrade_rules_src.values())
             table, self._index = build_rule_table(
                 self.config, rules, index=self._index,
                 ns_max_qps=self._ns_max_qps, connected=self._connected,
+                degrade_rules=degrade,
             )
             self._table = self._place_rules(table)
+            # breaker bookkeeping: which slots carry a breaker (dirty-set
+            # and lease gating) and a fresh transition-scan mirror — slot
+            # assignments may have moved, so the old mirror is meaningless
+            self._has_breakers = bool(degrade)
+            self._breaker_slots = {
+                self._index.slot_of[d.flow_id] for d in degrade
+                if d.flow_id in self._index.slot_of
+            }
+            self._breaker_prev = None
             # re-place after the drain scatter: eager sharding propagation
             # through .at[].set isn't guaranteed to keep the flow layout
             self._state = self._place_state(
@@ -626,7 +667,10 @@ class DefaultTokenService(TokenService):
             # replication sender into a full-snapshot resync
             self._state_gen += 1
             if self._dirty is not None:
-                self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
+                self._dirty = {
+                    "flow": set(), "param": set(), "param_fat": set(),
+                    "outcome": set(), "breaker": set(),
+                }
             # leases pin flow_id → slot; a reload may have reassigned the
             # slot or dropped the rule, so re-resolve every outstanding
             # lease and revoke those whose rule vanished (their LEASED
@@ -679,6 +723,51 @@ class DefaultTokenService(TokenService):
             return [
                 r for m in self._rules_by_ns.values() for r in m.values()
             ]
+
+    # -- degrade (circuit-breaker) rules (DegradeRuleManager analog) --------
+    def load_degrade_rules(self, rules: List[DegradeRule]) -> None:
+        """Replace the full degrade-rule set. Rules compile into the
+        ``br_*`` rule-table columns next to the flow rules (one table, one
+        gather on the hot path); a flow may carry a breaker with or without
+        a flow rule — breaker-only flows get an effectively-unlimited slot
+        so the gate still sees them. Breaker STATE survives the reload for
+        flows whose rule persists (the state columns are keyed by slot and
+        slots are sticky across reloads); a removed rule's slot resets to
+        CLOSED via ``drain_pending_clear``."""
+        with self._rules_mutex:
+            with self._lock:
+                self._degrade_rules_src = {r.flow_id: r for r in rules}
+            self.load_rules(self.current_rules())
+
+    def load_namespace_degrade_rules(
+        self, namespace: str, rules: List[DegradeRule]
+    ) -> None:
+        """Replace ONE namespace's degrade rules, keeping the others (the
+        same shape as :meth:`load_namespace_rules`; the MOVE import path
+        uses this to land a namespace's breakers on the destination)."""
+        import dataclasses as _dc
+
+        fixed = [
+            r if r.namespace == namespace
+            else _dc.replace(r, namespace=namespace)
+            for r in rules
+        ]
+        with self._rules_mutex:
+            with self._lock:
+                keep = [
+                    r for r in self._degrade_rules_src.values()
+                    if r.namespace != namespace
+                ]
+            self.load_degrade_rules(keep + fixed)
+
+    def current_degrade_rules(
+        self, namespace: Optional[str] = None
+    ) -> List[DegradeRule]:
+        with self._lock:
+            rules = list(self._degrade_rules_src.values())
+        if namespace is not None:
+            rules = [r for r in rules if r.namespace == namespace]
+        return rules
 
     def served_namespaces(self) -> List[str]:
         """Explicit namespace set ∪ namespaces with loaded rules."""
@@ -743,6 +832,7 @@ class DefaultTokenService(TokenService):
 
             delta = now - 60_000  # keep the last minute of history addressable
             shp = self._state.shaping
+            brk = self._state.breaker
             d32 = jnp.int32(delta)
             self._state = EngineState(
                 flow=rebase(self._state.flow, delta),
@@ -758,6 +848,20 @@ class DefaultTokenService(TokenService):
                     ),
                 ),
                 outcome=rebase(self._state.outcome, delta),
+                # breaker fence/ticket clocks share the engine epoch; the
+                # state column is epoch-free and passes through untouched
+                breaker=brk._replace(
+                    opened_ms=jnp.where(
+                        brk.opened_ms == _WNEVER,
+                        brk.opened_ms,
+                        brk.opened_ms - d32,
+                    ),
+                    probe_ms=jnp.where(
+                        brk.probe_ms == _WNEVER,
+                        brk.probe_ms,
+                        brk.probe_ms - d32,
+                    ),
+                ),
             )
             # the param sketch's starts are engine-ms too
             pstarts = self._param_state.starts
@@ -968,9 +1072,14 @@ class DefaultTokenService(TokenService):
                 self._state, self._table, batch, np.int32(now)
             )
             if self._dirty is not None:
-                self._dirty["flow"].update(
-                    np.unique(slots[slots >= 0]).tolist()
-                )
+                touched = np.unique(slots[slots >= 0]).tolist()
+                self._dirty["flow"].update(touched)
+                if self._has_breakers:
+                    # breaker transitions only happen for batched rows, so
+                    # touched ∩ breaker-slots is exactly the dirty set
+                    self._dirty.setdefault("breaker", set()).update(
+                        s for s in touched if s in self._breaker_slots
+                    )
         if _TR.ARMED:  # flight recorder: device step submitted
             _TR.record(_TR.DEVICE_IN, aux=n)
 
@@ -1020,15 +1129,23 @@ class DefaultTokenService(TokenService):
                 _TR.record(_TR.DEVICE_OUT, aux=n)
             # cluster server stat log (ClusterServerStatLogUtil analog): one
             # aggregated counter per verdict class per window
+            n_degraded = 0
             for event, code in (
                 ("pass", int(TokenStatus.OK)),
                 ("block", int(TokenStatus.BLOCKED)),
                 ("occupied", int(TokenStatus.SHOULD_WAIT)),
                 ("tooManyRequest", int(TokenStatus.TOO_MANY_REQUEST)),
+                ("degraded", int(TokenStatus.DEGRADED)),
             ):
                 hits = int((status == code).sum())
                 if hits:
                     log_cluster(event, count=hits)
+                    if event == "degraded":
+                        n_degraded = hits
+            if n_degraded:
+                # breaker activity observed: fold the device transitions
+                # into the host transition counters / blackbox plane
+                self._breaker_scan()
             return status, remaining, wait
 
         return _materialize
@@ -1187,9 +1304,12 @@ class DefaultTokenService(TokenService):
             )
             if self._dirty is not None:
                 span = np.concatenate([p[0] for p in preps])
-                self._dirty["flow"].update(
-                    np.unique(span[span >= 0]).tolist()
-                )
+                touched = np.unique(span[span >= 0]).tolist()
+                self._dirty["flow"].update(touched)
+                if self._has_breakers:
+                    self._dirty.setdefault("breaker", set()).update(
+                        s for s in touched if s in self._breaker_slots
+                    )
         _SM.record_fused(depth)
         if _TR.ARMED:  # flight recorder: fused group submitted
             _TR.record(_TR.FUSE, aux=depth)
@@ -1247,15 +1367,21 @@ class DefaultTokenService(TokenService):
             )
             if _TR.ARMED:  # flight recorder: fused group materialized
                 _TR.record(_TR.DEVICE_OUT, aux=depth * cap)
+            n_degraded = 0
             for event, code in (
                 ("pass", int(TokenStatus.OK)),
                 ("block", int(TokenStatus.BLOCKED)),
                 ("occupied", int(TokenStatus.SHOULD_WAIT)),
                 ("tooManyRequest", int(TokenStatus.TOO_MANY_REQUEST)),
+                ("degraded", int(TokenStatus.DEGRADED)),
             ):
                 hits = int((status == code).sum())
                 if hits:
                     log_cluster(event, count=hits)
+                    if event == "degraded":
+                        n_degraded = hits
+            if n_degraded:
+                self._breaker_scan()
             return status, remaining, wait
 
         return _materialize
@@ -1330,7 +1456,10 @@ class DefaultTokenService(TokenService):
             # invalidate any delta collected against the old generation
             self._state_gen += 1
             if self._dirty is not None:
-                self._dirty = {"flow": set(), "param": set(), "param_fat": set()}
+                self._dirty = {
+                    "flow": set(), "param": set(), "param_fat": set(),
+                    "outcome": set(), "breaker": set(),
+                }
 
     def load_namespace_param_rules(
         self, namespace: str, rules: List[ClusterParamFlowRule]
@@ -1545,6 +1674,13 @@ class DefaultTokenService(TokenService):
         with self._lock:
             self._moving.pop(namespace, None)
             self._rebuild_moving_snap()
+        # degrade rules leave with the namespace too (the MOVE blob carried
+        # them; keeping them here would pin dead breaker slots)
+        if any(
+            d.namespace == namespace
+            for d in self._degrade_rules_src.values()
+        ):
+            self.load_namespace_degrade_rules(namespace, [])
         self.load_namespace_rules(namespace, [])
 
     def moving_namespaces(self) -> Dict[str, Tuple[str, int]]:
@@ -1664,6 +1800,13 @@ class DefaultTokenService(TokenService):
             # a shaped rule's admission curve lives in the device shaper
             # state — client-local lease admission would bypass warmup and
             # pacing entirely, so shaped flows are simply not leasable
+            return LeaseResult(int(TokenStatus.NOT_LEASABLE))
+        if self._has_breakers and flow_id in self._degrade_rules_src:
+            # a breaker-guarded flow must answer per-request: a client-local
+            # slice would keep admitting for a full TTL after the breaker
+            # OPENs, and its traffic would never produce the DEGRADED
+            # verdicts that tell the client to back off. Refusing the lease
+            # bounds breaker over-admission to in-flight requests only.
             return LeaseResult(int(TokenStatus.NOT_LEASABLE))
         slot = self._index.slot_of.get(flow_id)
         if slot is None:
@@ -2040,6 +2183,16 @@ class DefaultTokenService(TokenService):
             lpt_h = np.asarray(self._state.shaping.lpt)
             wtok_h = np.asarray(self._state.shaping.warm_tokens)
             wfill_h = np.asarray(self._state.shaping.warm_filled)
+            # breaker columns move with the flow like the shaper clocks: an
+            # OPEN breaker must stay OPEN at the destination, its recovery
+            # clock re-anchored to the destination's epoch
+            br_st_h = np.asarray(self._state.breaker.state)
+            br_op_h = np.asarray(self._state.breaker.opened_ms)
+            br_pr_h = np.asarray(self._state.breaker.probe_ms)
+            degrade_rules = [
+                d for d in self._degrade_rules_src.values()
+                if d.namespace == namespace
+            ]
             flow_ids: List[int] = []
             frows: List[np.ndarray] = []
             orows: List[np.ndarray] = []
@@ -2047,7 +2200,20 @@ class DefaultTokenService(TokenService):
             lpt_rel: List[int] = []
             wtok_rows: List[float] = []
             wfill_rel: List[int] = []
-            for r in rules:
+            br_state_rows: List[int] = []
+            br_opened_rel: List[int] = []
+            br_probe_rel: List[int] = []
+
+            def _rel(v: int) -> int:
+                return int(_WNEVER) if v == int(_WNEVER) else int(v) - now
+
+            # breaker-only flows (a DegradeRule with no flow rule) still own
+            # a slot and breaker state; walk the union so they move too
+            exported = {r.flow_id for r in rules}
+            movers = list(rules) + [
+                d for d in degrade_rules if d.flow_id not in exported
+            ]
+            for r in movers:
                 slot = self._index.slot_of.get(r.flow_id)
                 if slot is None:
                     continue
@@ -2057,15 +2223,12 @@ class DefaultTokenService(TokenService):
                 outrows.append(outsum[slot])
                 # shaper clocks ship RELATIVE to now — the destination's
                 # engine epoch is its own; NEVER stays NEVER
-                lpt_rel.append(
-                    int(_WNEVER) if lpt_h[slot] == int(_WNEVER)
-                    else int(lpt_h[slot]) - now
-                )
+                lpt_rel.append(_rel(int(lpt_h[slot])))
                 wtok_rows.append(float(wtok_h[slot]))
-                wfill_rel.append(
-                    int(_WNEVER) if wfill_h[slot] == int(_WNEVER)
-                    else int(wfill_h[slot]) - now
-                )
+                wfill_rel.append(_rel(int(wfill_h[slot])))
+                br_state_rows.append(int(br_st_h[slot]))
+                br_opened_rel.append(_rel(int(br_op_h[slot])))
+                br_probe_rel.append(_rel(int(br_pr_h[slot])))
             row = self._index.ns_of.get(namespace)
             doc: Dict[str, object] = {
                 "namespace": namespace,
@@ -2093,6 +2256,10 @@ class DefaultTokenService(TokenService):
                 "shaping_lpt_rel": np.asarray(lpt_rel, np.int64),
                 "shaping_warm_tokens": np.asarray(wtok_rows, np.float32),
                 "shaping_warm_filled_rel": np.asarray(wfill_rel, np.int64),
+                "degrade_rules": degrade_rules,
+                "breaker_state": np.asarray(br_state_rows, np.int8),
+                "breaker_opened_rel": np.asarray(br_opened_rel, np.int64),
+                "breaker_probe_rel": np.asarray(br_probe_rel, np.int64),
             }
             # param sketch: per-slot live-window cell sums [depth, cells] —
             # summed over DECODED cells (sketch.decoded_counts_np), so the
@@ -2144,8 +2311,13 @@ class DefaultTokenService(TokenService):
         namespace = str(doc["namespace"])
         rules = list(doc["rules"])
         param_rules = list(doc["param_rules"])
+        degrade_rules = list(doc.get("degrade_rules", ()))
         with self._rules_mutex:
             self.load_namespace_rules(namespace, rules)
+            if degrade_rules:
+                # the namespace's breakers move with it: rules first (slots
+                # + br_* columns), then the state columns re-anchor below
+                self.load_namespace_degrade_rules(namespace, degrade_rules)
             if param_rules:
                 self.load_namespace_param_rules(namespace, param_rules)
             with self._lock:
@@ -2214,9 +2386,47 @@ class DefaultTokenService(TokenService):
                         warm_tokens=jnp.asarray(wtok_h),
                         warm_filled=jnp.asarray(wfill_h),
                     )
+                # re-anchor the moved breaker columns the same way: state
+                # verbatim, clocks shipped relative to the source's export
+                # now (pre-breaker blobs carry no key — breakers start
+                # CLOSED, which only under-protects until the stat window
+                # refills, never over-admits the destination's own flows)
+                breaker = self._state.breaker
+                br_state_in = doc.get("breaker_state")
+                if br_state_in is not None and flow_ids:
+                    from sentinel_tpu.stats.window import NEVER as _WNEVER
+
+                    bst_h = np.asarray(breaker.state).copy()
+                    bop_h = np.asarray(breaker.opened_ms).copy()
+                    bpr_h = np.asarray(breaker.probe_ms).copy()
+                    bst_in = np.asarray(br_state_in)
+                    bop_in = np.asarray(doc["breaker_opened_rel"])
+                    bpr_in = np.asarray(doc["breaker_probe_rel"])
+
+                    def _anchor(rel: int) -> int:
+                        return (
+                            int(_WNEVER) if rel == int(_WNEVER)
+                            else int(np.clip(
+                                now + int(rel), int(_WNEVER), 2**30
+                            ))
+                        )
+
+                    for i, s in enumerate(np.asarray(slots)):
+                        bst_h[s] = bst_in[i]
+                        bop_h[s] = _anchor(int(bop_in[i]))
+                        bpr_h[s] = _anchor(int(bpr_in[i]))
+                    breaker = breaker._replace(
+                        state=jnp.asarray(bst_h),
+                        opened_ms=jnp.asarray(bop_h),
+                        probe_ms=jnp.asarray(bpr_h),
+                    )
+                    # drop the stale transition mirror: the next scan
+                    # re-baselines from CLOSED, so moved-in OPEN breakers
+                    # surface as closed→open edges on the destination
+                    self._breaker_prev = None
                 self._state = self._place_state(
                     _ES(flow=flow, occupy=occupy, ns=ns, shaping=shaping,
-                        outcome=outcome)
+                        outcome=outcome, breaker=breaker)
                 )
                 pfids = [int(f) for f in doc.get("param_fids", [])]
                 if pfids:
@@ -2267,6 +2477,7 @@ class DefaultTokenService(TokenService):
                     r for m in self._rules_by_ns.values() for r in m.values()
                 ],
                 "param_rules": list(self._param_rules_src.values()),
+                "degrade_rules": list(self._degrade_rules_src.values()),
                 "slot_of": dict(self._index.slot_of),
                 "ns_of": dict(self._index.ns_of),
                 "param_slot_of": {
@@ -2288,6 +2499,14 @@ class DefaultTokenService(TokenService):
                     "warm_filled": np.asarray(
                         self._state.shaping.warm_filled
                     ),
+                },
+                # per-flow circuit-breaker columns (state machine + engine-ms
+                # clocks; clocks share the exported epoch, so restore is
+                # bit-exact on the same service and remaps by flow_id)
+                "breaker": {
+                    "state": np.asarray(self._state.breaker.state),
+                    "opened_ms": np.asarray(self._state.breaker.opened_ms),
+                    "probe_ms": np.asarray(self._state.breaker.probe_ms),
                 },
                 "param": {
                     "starts": np.asarray(self._param_state.starts),
@@ -2369,6 +2588,10 @@ class DefaultTokenService(TokenService):
                 # pre-outcome snapshots carry no completion windows —
                 # restore them empty (cold), same tolerant-absent discipline
                 outcome_doc = state.get("outcome")
+                # pre-breaker snapshots carry no breaker columns — restore
+                # CLOSED everywhere (under-protects until the stat window
+                # refills; never wrongly rejects)
+                breaker_doc = state.get("breaker")
                 if outcome_doc is not None:
                     out_c = _check("outcome.counts", outcome_doc["counts"],
                                    cur.outcome.counts)
@@ -2380,6 +2603,13 @@ class DefaultTokenService(TokenService):
                         np.asarray(cur.outcome.counts[:0]).dtype,
                     )
                     out_s = np.asarray(cur.outcome.starts)
+            with self._lock:
+                # degrade rules must be in place BEFORE load_rules so the
+                # rebuilt RuleTable carries the br_* columns the restored
+                # breaker state refers to
+                self._degrade_rules_src = {
+                    d.flow_id: d for d in state.get("degrade_rules", ())
+                }
             self.load_rules(
                 rules,
                 ns_max_qps=float(state["ns_max_qps"]),
@@ -2399,6 +2629,9 @@ class DefaultTokenService(TokenService):
                 new_lpt = np.full(n_flows, int(_WNEVER), np.int32)
                 new_wtok = np.zeros(n_flows, np.float32)
                 new_wfill = np.full(n_flows, int(_WNEVER), np.int32)
+                new_br_st = np.zeros(n_flows, np.int8)
+                new_br_op = np.full(n_flows, int(_WNEVER), np.int32)
+                new_br_pr = np.full(n_flows, int(_WNEVER), np.int32)
                 for fid, new in self._index.slot_of.items():
                     old = old_slot.get(fid)
                     if old is None:
@@ -2413,6 +2646,16 @@ class DefaultTokenService(TokenService):
                         )[old]
                         new_wfill[new] = np.asarray(
                             shaping_doc["warm_filled"]
+                        )[old]
+                    if breaker_doc is not None:
+                        new_br_st[new] = np.asarray(
+                            breaker_doc["state"]
+                        )[old]
+                        new_br_op[new] = np.asarray(
+                            breaker_doc["opened_ms"]
+                        )[old]
+                        new_br_pr[new] = np.asarray(
+                            breaker_doc["probe_ms"]
                         )[old]
                 # namespace guard rows remap by name
                 old_ns = state["ns_of"]
@@ -2442,6 +2685,7 @@ class DefaultTokenService(TokenService):
                         if p_merges is not None:
                             new_p_merges[new] = np.asarray(p_merges)[old]
                 from sentinel_tpu.engine.state import (
+                    BreakerState as _BRS,
                     ShapingState as _SHS,
                 )
 
@@ -2455,7 +2699,15 @@ class DefaultTokenService(TokenService):
                         warm_filled=jnp.asarray(new_wfill),
                     ),
                     outcome=_WS(jnp.asarray(out_s), jnp.asarray(new_out_c)),
+                    breaker=_BRS(
+                        state=jnp.asarray(new_br_st),
+                        opened_ms=jnp.asarray(new_br_op),
+                        probe_ms=jnp.asarray(new_br_pr),
+                    ),
                 ))
+                # re-baseline the transition mirror from CLOSED so the
+                # restore surfaces still-open breakers as closed→open edges
+                self._breaker_prev = None
                 self._param_state = self._param_state._replace(
                     starts=jnp.asarray(p_s),
                     counts=jnp.asarray(new_p_c),
@@ -2486,7 +2738,7 @@ class DefaultTokenService(TokenService):
             if self._dirty is None:
                 self._dirty = {
                     "flow": set(), "param": set(), "param_fat": set(),
-                    "outcome": set(),
+                    "outcome": set(), "breaker": set(),
                 }
 
     def replication_disable(self) -> None:
@@ -2520,9 +2772,10 @@ class DefaultTokenService(TokenService):
             param_slots = sorted(self._dirty["param"])
             param_fat_slots = sorted(self._dirty.get("param_fat", ()))
             outcome_slots = sorted(self._dirty.get("outcome", ()))
+            breaker_slots = sorted(self._dirty.get("breaker", ()))
             self._dirty = {
                 "flow": set(), "param": set(), "param_fat": set(),
-                "outcome": set(),
+                "outcome": set(), "breaker": set(),
             }
             now = self._engine_now()  # pins the epoch, runs a due rebase
             delta: Dict[str, object] = {
@@ -2584,6 +2837,23 @@ class DefaultTokenService(TokenService):
                 delta["outcome_fids"] = [int(orev[s]) for s in outcome_slots]
                 delta["outcome_counts"] = host_rows(
                     self._state.outcome.counts, osl
+                )
+            if breaker_slots:
+                # breaker columns ship raw engine-ms clocks — the standby
+                # shares the epoch (checked on apply), so no re-anchoring.
+                # Only touched∩breaker slots land here: transitions can only
+                # occur for rows that were batched or reported this tick.
+                bsl = np.asarray(breaker_slots, np.int32)
+                brev = {v: k for k, v in self._index.slot_of.items()}
+                delta["breaker_fids"] = [int(brev[s]) for s in breaker_slots]
+                delta["breaker_state"] = host_rows(
+                    self._state.breaker.state, bsl
+                )
+                delta["breaker_opened"] = host_rows(
+                    self._state.breaker.opened_ms, bsl
+                )
+                delta["breaker_probe"] = host_rows(
+                    self._state.breaker.probe_ms, bsl
                 )
             if param_slots:
                 pr = np.asarray(param_slots, np.int32)
@@ -2717,6 +2987,29 @@ class DefaultTokenService(TokenService):
                         jnp.asarray(delta["outcome_counts"])
                     )
                 )
+            breaker = self._state.breaker
+            breaker_fids = delta.get("breaker_fids")
+            if breaker_fids:
+                bslots = []
+                for fid in breaker_fids:
+                    s = self._index.slot_of.get(int(fid))
+                    if s is None:
+                        raise ValueError(f"delta names unknown flow {fid}")
+                    bslots.append(s)
+                bsl = jnp.asarray(np.asarray(bslots, np.int32))
+                # clocks are raw engine-ms; the epoch check above already
+                # guarantees both sides share the timeline
+                breaker = breaker._replace(
+                    state=breaker.state.at[bsl].set(
+                        jnp.asarray(delta["breaker_state"])
+                    ),
+                    opened_ms=breaker.opened_ms.at[bsl].set(
+                        jnp.asarray(delta["breaker_opened"])
+                    ),
+                    probe_ms=breaker.probe_ms.at[bsl].set(
+                        jnp.asarray(delta["breaker_probe"])
+                    ),
+                )
             ns_names = delta.get("ns_names")
             if ns_names:
                 rows = []
@@ -2744,6 +3037,7 @@ class DefaultTokenService(TokenService):
                     _WS(jnp.asarray(out_starts), outcome.counts)
                     if out_starts is not None else outcome
                 ),
+                breaker=breaker,
             ))
             pstate = _rotate(self._param_state, delta["param_starts"])
             pcounts = pstate.counts
@@ -2953,22 +3247,45 @@ class DefaultTokenService(TokenService):
 
                     self._outcome_step = outcome_step_donating(self.config)
                 now = self._engine_now()
-                self._state = self._outcome_step(
-                    self._state,
-                    jnp.asarray(slots_p),
-                    jnp.asarray(rt_p),
-                    jnp.asarray(exc_p),
-                    jnp.asarray(valid_p),
-                    jnp.int32(now),
-                )
+                if self._has_breakers:
+                    # breakers loaded: the step additionally counts the
+                    # SLOW channel against each flow's DegradeRule cutoff
+                    # and resolves HALF_OPEN probes (a separate jit trace;
+                    # the 6-arg form below stays bit-identical to the
+                    # pre-breaker step)
+                    self._state = self._outcome_step(
+                        self._state,
+                        jnp.asarray(slots_p),
+                        jnp.asarray(rt_p),
+                        jnp.asarray(exc_p),
+                        jnp.asarray(valid_p),
+                        jnp.int32(now),
+                        self._table.br_strategy,
+                        self._table.br_slow_rt_ms,
+                    )
+                else:
+                    self._state = self._outcome_step(
+                        self._state,
+                        jnp.asarray(slots_p),
+                        jnp.asarray(rt_p),
+                        jnp.asarray(exc_p),
+                        jnp.asarray(valid_p),
+                        jnp.int32(now),
+                    )
                 self._outcome_counts["reported"] += n_ok
                 n_exc = int((exc_in & valid).sum())
                 self._outcome_counts["exceptions"] += n_exc
                 self._outcome_counts["rt_sum_ms"] += int(rt[valid].sum())
                 if self._dirty is not None:
-                    self._dirty.setdefault("outcome", set()).update(
-                        int(s) for s in np.unique(slots[valid])
-                    )
+                    touched = {int(s) for s in np.unique(slots[valid])}
+                    self._dirty.setdefault("outcome", set()).update(touched)
+                    if self._has_breakers:
+                        # a report can resolve a probe (HALF_OPEN →
+                        # CLOSED/OPEN), so reported breaker slots are
+                        # breaker-dirty too
+                        self._dirty.setdefault("breaker", set()).update(
+                            touched & self._breaker_slots
+                        )
             ns_names, slot_ns = self._ns_snapshot
         if _TR.ARMED:
             _TR.record(_TR.OUTCOME, xid=xid, aux=n_ok)
@@ -3061,3 +3378,97 @@ class DefaultTokenService(TokenService):
                 }
             out["flows"] = flows
             return out
+
+    # -- circuit-breaker observability (engine/degrade.py host plane) --------
+    _BR_STATE_NAMES = ("closed", "open", "half_open")
+
+    def _breaker_scan(self, force: bool = False) -> None:
+        """Diff the device breaker state column against the host mirror and
+        fold observed transitions into ``ServerMetrics`` (the
+        ``sentinel_breaker_transitions_total{from,to}`` edges) plus a
+        rate-limited blackbox dump on a trip to OPEN. The device is the
+        authority — transitions happen inside the decide/outcome steps with
+        no host round-trip — so this scan sees edges at its own cadence: a
+        breaker that OPENs and recovers between two scans reports the net
+        edge, not the intermediate states. ``force`` skips the ~1/s rate
+        limit (scrape and drill paths; the serving materializer only scans
+        when a batch actually produced DEGRADED verdicts)."""
+        if not self._has_breakers:
+            return
+        edges: Dict[Tuple[int, int], int] = {}
+        tripped: List[object] = []
+        with self._lock:
+            now_s = time.monotonic()
+            if not force and now_s - self._breaker_scan_ts < 1.0:
+                return
+            self._breaker_scan_ts = now_s
+            st = np.array(np.asarray(self._state.breaker.state))
+            prev = self._breaker_prev
+            self._breaker_prev = st
+            if prev is None:
+                # first observation since the (re)load: surface non-CLOSED
+                # states (a snapshot restore's open breakers) as edges
+                # from CLOSED rather than losing them
+                prev = np.zeros_like(st)
+            changed = np.nonzero(st != prev)[0]
+            if changed.size == 0:
+                return
+            rev = {v: k for k, v in self._index.slot_of.items()}
+            for s in changed.tolist():
+                if s not in self._breaker_slots:
+                    continue  # stale mirror rows of dropped rules
+                frm, to = int(prev[s]), int(st[s])
+                edges[(frm, to)] = edges.get((frm, to), 0) + 1
+                if to == 1:  # BR_OPEN
+                    tripped.append(rev.get(s, s))
+        names = self._BR_STATE_NAMES
+        for (frm, to), count in edges.items():
+            _SM.count_breaker_transition(
+                names[frm] if frm < 3 else str(frm),
+                names[to] if to < 3 else str(to),
+                count,
+            )
+        if tripped:
+            from sentinel_tpu.trace import blackbox as _blackbox
+
+            _blackbox.maybe_dump(
+                "breaker_open:" + ",".join(str(f) for f in tripped)
+            )
+
+    def breaker_stats(self) -> Dict[str, object]:
+        """Host snapshot of the breaker plane: per-flow state (read from
+        the device ``BreakerState`` columns) plus clock ages, for the
+        ``sentinel_breaker_state`` gauge and the ``breaker`` block of
+        ``clusterServerStats``. Scans for transitions first, so a scrape
+        is also the liveness floor of the transition counters."""
+        if not self._has_breakers:
+            return {}
+        self._breaker_scan(force=True)
+        from sentinel_tpu.stats.window import NEVER as _WNEVER
+
+        names = self._BR_STATE_NAMES
+        with self._lock:
+            br = self._state.breaker
+            st = np.asarray(br.state)
+            opened = np.asarray(br.opened_ms)
+            probe = np.asarray(br.probe_ms)
+            now = self._engine_now()
+            flows: Dict[int, Dict[str, object]] = {}
+            for fid, rule in self._degrade_rules_src.items():
+                slot = self._index.slot_of.get(fid)
+                if slot is None:
+                    continue
+                code = int(st[slot])
+                entry: Dict[str, object] = {
+                    "state": names[code] if code < 3 else str(code),
+                    "state_code": code,
+                    "strategy": int(rule.strategy),
+                }
+                if int(opened[slot]) != int(_WNEVER):
+                    entry["since_transition_ms"] = (
+                        int(now) - int(opened[slot])
+                    )
+                if int(probe[slot]) != int(_WNEVER):
+                    entry["probe_age_ms"] = int(now) - int(probe[slot])
+                flows[int(fid)] = entry
+            return {"rules": len(self._degrade_rules_src), "flows": flows}

@@ -17,6 +17,8 @@ from sentinel_tpu.engine.config import EngineConfig
 from sentinel_tpu.engine.rules import (
     RuleTable,
     ClusterFlowRule,
+    DegradeRule,
+    DegradeStrategy,
     build_rule_table,
     drain_pending_clear,
 )
@@ -37,6 +39,8 @@ __all__ = [
     "EngineConfig",
     "RuleTable",
     "ClusterFlowRule",
+    "DegradeRule",
+    "DegradeStrategy",
     "build_rule_table",
     "drain_pending_clear",
     "EngineState",

@@ -85,6 +85,14 @@ class TokenStatus(enum.IntEnum):
     # path; the failover client treats it as proof of life. Never produced
     # by the device kernels.
     NOT_LEASABLE = 11
+    # circuit-breaker refusal (DegradeSlot / DegradeException): the flow's
+    # breaker is OPEN (or HALF_OPEN with its single probe already in
+    # flight), so the request is shed without touching the flow window.
+    # ``remaining`` carries retry-after-ms — the time until the breaker
+    # will admit a recovery probe. Unlike OVERLOAD..NOT_LEASABLE this IS
+    # produced by the device kernels: the breaker state machine runs
+    # batch-vectorized inside the decide step (engine/degrade.py).
+    DEGRADED = 12
 
 
 class RequestBatch(NamedTuple):
@@ -173,6 +181,7 @@ def make_batch_into(
     valid[n:] = False
 
 
+from sentinel_tpu.engine.degrade import breaker_gate as _breaker_gate
 from sentinel_tpu.engine.prefix import segment_prefix_builder as _segment_prefix_builder
 from sentinel_tpu.ops.scan_mm import blocked_cumsum as _blocked_cumsum
 
@@ -389,6 +398,35 @@ def _decide_core(
     too_many = live & ~ns_ok
     ns_admitted = live & ns_ok  # global mask — identical on every device
     active = ns_admitted & owned  # flow evaluation happens on the owner
+
+    if config.prefix_impl == "grouped":
+        # "grouped" is only sound when the host batcher sorted the batch —
+        # that guarantee arrives via decide()'s grouped flag, never via
+        # config (on an interleaved batch it would silently drop earlier
+        # same-flow contributions and break the no-overshoot guarantee)
+        raise ValueError(
+            "prefix_impl='grouped' is not a config value; pass grouped=True "
+            "to decide() from a batcher that groups same-flow requests"
+        )
+    flow_prefix = _segment_prefix_builder(
+        safe_slot, "grouped" if grouped else config.prefix_impl
+    )
+
+    # ------------------------------------------------------------------
+    # 1b. circuit breakers (DegradeSlot): OPEN/HALF_OPEN rows shed here —
+    #     they write NO flow-window events (like the namespace-guard
+    #     refusals above) and answer DEGRADED with retry-after-ms. The
+    #     HALF_OPEN probe winner stays in `active` and runs the normal
+    #     admission below. Skipped at trace time when the table carries
+    #     no degrade rules (None br_* columns); otherwise cond-gated
+    #     inside breaker_gate on a mesh-uniform "any breaker row"
+    #     predicate.
+    # ------------------------------------------------------------------
+    degraded, br_retry, breaker_ws = _breaker_gate(
+        config, spec, state, rules, now, safe_slot, active, flow_prefix, psum
+    )
+    active = active & ~degraded
+
     # ------------------------------------------------------------------
     # 2. per-request threshold (ClusterFlowChecker.java:38-48)
     # ------------------------------------------------------------------
@@ -459,19 +497,6 @@ def _decide_core(
     # ------------------------------------------------------------------
     # 3. prefix-sum admission (odd refinement count ⇒ ⊆ sequential-exact)
     # ------------------------------------------------------------------
-    if config.prefix_impl == "grouped":
-        # "grouped" is only sound when the host batcher sorted the batch —
-        # that guarantee arrives via decide()'s grouped flag, never via
-        # config (on an interleaved batch it would silently drop earlier
-        # same-flow contributions and break the no-overshoot guarantee)
-        raise ValueError(
-            "prefix_impl='grouped' is not a config value; pass grouped=True "
-            "to decide() from a batcher that groups same-flow requests"
-        )
-    flow_prefix = _segment_prefix_builder(
-        safe_slot, "grouped" if grouped else config.prefix_impl
-    )
-
     if uniform:
         # closed-form greedy admission: with one acquire size `a` per batch,
         # the admitted set of each flow is exactly its first
@@ -684,13 +709,17 @@ def _decide_core(
     # 6. verdicts — owner emits status+1, psum stitches shards together
     # ------------------------------------------------------------------
     local_status = jnp.where(
-        admit | pace_now,
-        int(TokenStatus.OK) + 1,
+        degraded,
+        int(TokenStatus.DEGRADED) + 1,
         jnp.where(
-            can_occupy | pace_later,
-            int(TokenStatus.SHOULD_WAIT) + 1,
+            admit | pace_now,
+            int(TokenStatus.OK) + 1,
             jnp.where(
-                hard_block | pace_reject, int(TokenStatus.BLOCKED) + 1, 0
+                can_occupy | pace_later,
+                int(TokenStatus.SHOULD_WAIT) + 1,
+                jnp.where(
+                    hard_block | pace_reject, int(TokenStatus.BLOCKED) + 1, 0
+                ),
             ),
         ),
     ).astype(jnp.int32)
@@ -720,8 +749,11 @@ def _decide_core(
         2**30,
     ).astype(jnp.int32)
     # blockedResult() in the reference always carries remaining=0 — and so
-    # do paced admissions (RateLimiterController has no token count to report)
-    remaining = psum(jnp.where(admit, remaining_local, 0))
+    # do paced admissions (RateLimiterController has no token count to
+    # report); DEGRADED rows carry retry-after-ms instead
+    remaining = psum(
+        jnp.where(admit, remaining_local, jnp.where(degraded, br_retry, 0))
+    )
 
     new_state = EngineState(
         flow=flow_ws, occupy=occupy_ws, ns=ns_ws,
@@ -732,6 +764,7 @@ def _decide_core(
         # (engine/outcome.py), never by the admission kernel — the serve
         # path's donated buffers just flow through
         outcome=state.outcome,
+        breaker=breaker_ws,
     )
     verdicts = VerdictBatch(status=status, wait_ms=wait_ms, remaining=remaining)
     return new_state, verdicts

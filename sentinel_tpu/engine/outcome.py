@@ -37,13 +37,20 @@ import jax
 import jax.numpy as jnp
 
 from sentinel_tpu.engine.config import EngineConfig
+from sentinel_tpu.engine.prefix import segment_prefix_builder
+from sentinel_tpu.engine.rules import DegradeStrategy
 from sentinel_tpu.engine.state import (
+    BR_CLOSED,
+    BR_HALF_OPEN,
+    BR_OPEN,
+    BreakerState,
     EngineState,
     N_RT_BUCKETS,
     OutcomeChannel,
     flow_spec,
 )
 from sentinel_tpu.stats import window as W
+from sentinel_tpu.stats.window import NEVER
 
 
 def rt_bucket(rt_ms: jax.Array) -> jax.Array:
@@ -58,6 +65,56 @@ def rt_bucket(rt_ms: jax.Array) -> jax.Array:
     return jnp.clip(blog, 0, N_RT_BUCKETS - 1)
 
 
+def _resolve_probes(
+    br: BreakerState,
+    br_strategy: jax.Array,  # int8 [F] rule columns
+    br_slow_rt_ms: jax.Array,  # int32 [F]
+    gslot: jax.Array,  # int32 [K] clamped in-range slots
+    in_rng: jax.Array,  # bool [K] valid & slot in range
+    rt_ms: jax.Array,
+    exc: jax.Array,
+    now: jax.Array,
+) -> BreakerState:
+    """HALF_OPEN probe resolution — ``fromHalfOpenToClose`` / the error
+    rollback: the FIRST report of each flow whose breaker sits HALF_OPEN
+    with a live probe ticket decides the flow's fate. Success (fast for
+    SLOW_REQUEST_RATIO, non-exception otherwise) → CLOSED with
+    ``opened_ms = now`` (the stats fence excludes pre-recovery buckets,
+    the device resetStat()); failure → straight back to OPEN with a fresh
+    recovery clock. Any in-flight completion for the flow can resolve the
+    probe, like the reference's ``onRequestComplete`` — the probe request
+    is merely the only one the breaker ADMITTED."""
+    f = br.state.shape[0]
+    st = br.state[gslot].astype(jnp.int32)
+    probe = br.probe_ms[gslot]
+    live = in_rng & (st == BR_HALF_OPEN) & (probe != NEVER)
+
+    def off(_):
+        return br
+
+    def on(_):
+        # first live report per flow in batch order wins the resolution
+        rank = segment_prefix_builder(gslot, "auto")(
+            live.astype(jnp.float32)
+        )
+        elected = live & (rank == 0.0)
+        strat = br_strategy[gslot].astype(jnp.int32)
+        fail = jnp.where(
+            strat == int(DegradeStrategy.SLOW_REQUEST_RATIO),
+            jnp.asarray(rt_ms, jnp.int32) > br_slow_rt_ms[gslot],
+            jnp.asarray(exc, jnp.int32) > 0,
+        )
+        new_st = jnp.where(fail, BR_OPEN, BR_CLOSED).astype(jnp.int8)
+        scat = jnp.where(elected, gslot, f)
+        return BreakerState(
+            state=br.state.at[scat].set(new_st, mode="drop"),
+            opened_ms=br.opened_ms.at[scat].set(now, mode="drop"),
+            probe_ms=br.probe_ms.at[scat].set(jnp.int32(NEVER), mode="drop"),
+        )
+
+    return jax.lax.cond(jnp.any(live), on, off, None)
+
+
 def _outcome_core(
     config: EngineConfig,
     state: EngineState,
@@ -66,27 +123,38 @@ def _outcome_core(
     exc: jax.Array,  # int32 [K] 1 = exception, 0 = success
     valid: jax.Array,  # bool [K]
     now: jax.Array,  # int32 engine ms
+    br_strategy=None,  # int8 [F] rule column, or None (no breakers loaded)
+    br_slow_rt_ms=None,  # int32 [F] rule column, or None
 ) -> EngineState:
     spec = flow_spec(config)
     k = slots.shape[0]
     # invalid rows scatter to row F, which mode="drop" discards entirely
     safe_slot = jnp.where(valid, slots, jnp.int32(config.max_flows))
     ones = jnp.ones((k,), jnp.int32)
-    rows = jnp.stack(
-        [
-            jnp.asarray(rt_ms, jnp.int32),
-            ones,
-            jnp.asarray(exc, jnp.int32),
-        ],
-        axis=1,
+    row_cols = [
+        jnp.asarray(rt_ms, jnp.int32),
+        ones,
+        jnp.asarray(exc, jnp.int32),
+    ]
+    channels = (
+        int(OutcomeChannel.RT_SUM),
+        int(OutcomeChannel.COMPLETE),
+        int(OutcomeChannel.EXCEPTION),
     )
+    if br_strategy is not None:
+        # SLOW channel: counted exactly at report time against the flow's
+        # DegradeRule cutoff (rules without a breaker carry NO_SLOW_RT_MS,
+        # so their rows never count) — the SLOW_REQUEST_RATIO numerator
+        gslot = jnp.where(valid, slots, 0).astype(jnp.int32)
+        in_rng = valid & (slots >= 0) & (slots < br_strategy.shape[0])
+        is_slow = (
+            jnp.asarray(rt_ms, jnp.int32) > br_slow_rt_ms[gslot]
+        ).astype(jnp.int32)
+        row_cols.append(is_slow)
+        channels = channels + (int(OutcomeChannel.SLOW),)
+    rows = jnp.stack(row_cols, axis=1)
     ws = W.add_event_rows(
-        spec, state.outcome, now, safe_slot, rows,
-        channels=(
-            int(OutcomeChannel.RT_SUM),
-            int(OutcomeChannel.COMPLETE),
-            int(OutcomeChannel.EXCEPTION),
-        ),
+        spec, state.outcome, now, safe_slot, rows, channels=channels
     )
     # histogram cell: one extra scatter with a traced channel id (the roll
     # inside add_events is a no-op — the slot was refreshed just above)
@@ -96,12 +164,23 @@ def _outcome_core(
         channel_ids=int(OutcomeChannel.RT_HIST0) + rt_bucket(rt_ms),
         values=ones,
     )
-    return state._replace(outcome=ws)
+    breaker = state.breaker
+    if br_strategy is not None:
+        breaker = _resolve_probes(
+            state.breaker, br_strategy, br_slow_rt_ms, gslot, in_rng,
+            rt_ms, exc, now,
+        )
+    return state._replace(outcome=ws, breaker=breaker)
 
 
 def outcome_step_donating(config: EngineConfig):
     """Build the jitted donated step ``(state, slots, rt, exc, valid, now)
     -> state'``. The full EngineState is donated (the admission windows
     alias through untouched), mirroring ``decide_donating``'s contract:
-    the caller's lock must make the passed state the only live reference."""
+    the caller's lock must make the passed state the only live reference.
+
+    When breakers are loaded the caller additionally passes the
+    ``br_strategy``/``br_slow_rt_ms`` rule columns, which turns on the
+    SLOW-channel scatter and HALF_OPEN probe resolution (a separate jit
+    trace; the 6-arg form stays bit-identical to the pre-breaker step)."""
     return jax.jit(partial(_outcome_core, config), donate_argnums=(0,))

@@ -30,6 +30,16 @@ class ThresholdMode(enum.IntEnum):
     GLOBAL = 1
 
 
+class DegradeStrategy(enum.IntEnum):
+    # RuleConstant.DEGRADE_GRADE_*: which metric trips the breaker.
+    # SLOW_REQUEST_RATIO compares (completions with RT > slow_rt_ms) / total
+    # against `threshold` in [0, 1]; ERROR_RATIO compares exceptions / total;
+    # ERROR_COUNT compares the raw exception count against `threshold`.
+    SLOW_REQUEST_RATIO = 0
+    ERROR_RATIO = 1
+    ERROR_COUNT = 2
+
+
 class ControlBehavior(enum.IntEnum):
     # RuleConstant.CONTROL_BEHAVIOR_*: which TrafficShapingController serves
     # the rule. DEFAULT rejects on threshold; WARM_UP admits along the
@@ -65,6 +75,38 @@ class ClusterFlowRule:
     max_queueing_time_ms: int = 500
 
 
+@dataclass(frozen=True)
+class DegradeRule:
+    """Host-side circuit-breaker rule (``DegradeRule.java`` subset).
+
+    ``threshold`` is a ratio in [0, 1] for the two ratio strategies and a
+    raw count for ERROR_COUNT (the reference overloads ``count`` the same
+    way). ``stat_interval_ms`` is clamped at build time to the engine's
+    outcome-window interval — the sliding window holds no older history.
+    A flow may carry a DegradeRule with or without a ClusterFlowRule; a
+    breaker-only flow gets a slot with an effectively-unlimited admission
+    threshold, so CLOSED answers OK and only the breaker gates it."""
+
+    flow_id: int
+    strategy: DegradeStrategy = DegradeStrategy.SLOW_REQUEST_RATIO
+    threshold: float = 1.0
+    slow_rt_ms: int = 1000
+    min_request_amount: int = 5
+    stat_interval_ms: int = 1000
+    recovery_timeout_ms: int = 5000
+    namespace: str = "default"
+
+
+# br_slow_rt_ms default for slots without a breaker rule: no real RT can
+# exceed it, so the SLOW outcome channel stays zero for those slots
+NO_SLOW_RT_MS = 2**30 - 1
+
+# admission threshold for breaker-only slots (no ClusterFlowRule): large
+# enough that the window can never fill, small enough that
+# threshold * exceed_count * interval stays well inside f32 exactness
+UNLIMITED_COUNT = 1e9
+
+
 class RuleTable(NamedTuple):
     """Device tensors, all shaped ``[max_flows]`` (+ ``[max_namespaces]``).
 
@@ -87,6 +129,17 @@ class RuleTable(NamedTuple):
     slope: jax.Array  # float32 — warmup admission slope above the line
     cold_count: jax.Array  # float32 — floor(count / cold_factor) refill gate
     max_queue_ms: jax.Array  # int32 — pacing queue bound (ring-clamped)
+    # circuit-breaker columns (DegradeRule); br_strategy == -1 marks a slot
+    # with no breaker rule, which the breaker gate skips entirely. All six
+    # are None when the table carries no degrade rules at all — None is
+    # part of the jit pytree structure, so breaker-free tables compile the
+    # decide step without tracing the breaker arm
+    br_strategy: Optional[jax.Array]  # int8 — DegradeStrategy, -1 = none
+    br_threshold: Optional[jax.Array]  # float32 — ratio (0/1) or count (2)
+    br_slow_rt_ms: Optional[jax.Array]  # int32 — slow-call RT cutoff
+    br_min_request: Optional[jax.Array]  # int32 — minRequestAmount gate
+    br_stat_ms: Optional[jax.Array]  # int32 — stat interval (ring-clamped)
+    br_recovery_ms: Optional[jax.Array]  # int32 — OPEN → HALF_OPEN timeout
 
 
 class RuleIndex:
@@ -147,18 +200,26 @@ def build_rule_table(
     index: Optional[RuleIndex] = None,
     ns_max_qps: float = 30_000.0,
     connected: Optional[Dict[str, int]] = None,
+    degrade_rules: Optional[List[DegradeRule]] = None,
 ) -> tuple:
     """Build/refresh the device rule table. Returns ``(table, index)``.
 
     ``ns_max_qps`` defaults to the reference's namespace self-protection cap
     (``ServerFlowConfig.java:31``).
 
+    ``degrade_rules`` attach circuit breakers to flows by flow_id; a flow
+    with only a DegradeRule still gets a live slot (admission effectively
+    unlimited) so the breaker alone gates it.
+
     After a rebuild, call ``drain_pending_clear(index, state)`` so slots freed
     by removed rules are zeroed before a new flow_id reuses them — otherwise
     the new flow inherits the removed flow's live window history.
     """
+    degrade_rules = degrade_rules or []
     index = index or RuleIndex(config)
-    index.release_missing(r.flow_id for r in rules)
+    index.release_missing(
+        {r.flow_id for r in rules} | {d.flow_id for d in degrade_rules}
+    )
 
     valid = np.zeros(config.max_flows, dtype=bool)
     count = np.zeros(config.max_flows, dtype=np.float32)
@@ -172,6 +233,12 @@ def build_rule_table(
     slope = np.zeros(config.max_flows, dtype=np.float32)
     cold_count = np.zeros(config.max_flows, dtype=np.float32)
     max_queue_ms = np.zeros(config.max_flows, dtype=np.int32)
+    br_strategy = np.full(config.max_flows, -1, dtype=np.int8)
+    br_threshold = np.zeros(config.max_flows, dtype=np.float32)
+    br_slow_rt = np.full(config.max_flows, NO_SLOW_RT_MS, dtype=np.int32)
+    br_min_request = np.zeros(config.max_flows, dtype=np.int32)
+    br_stat_ms = np.zeros(config.max_flows, dtype=np.int32)
+    br_recovery_ms = np.zeros(config.max_flows, dtype=np.int32)
     # add_future can park a borrow at most n_buckets-1 windows ahead, so a
     # pacing queue longer than that would assign waits the cross-batch
     # charge cannot cover — clamp at build time and let docs/SHAPING.md
@@ -202,6 +269,27 @@ def build_rule_table(
             max_queue_ms[slot] = min(
                 int(rule.max_queueing_time_ms), queue_cap_ms
             )
+    interval_ms = config.n_buckets * config.bucket_ms
+    for d in degrade_rules:
+        slot = index.assign(d.flow_id)
+        if not valid[slot]:
+            # breaker-only flow: a live slot whose admission threshold the
+            # window can never reach — only the breaker gates it
+            valid[slot] = True
+            count[slot] = UNLIMITED_COUNT
+            mode[slot] = int(ThresholdMode.GLOBAL)
+            namespace_id[slot] = index.namespace_slot(d.namespace)
+        br_strategy[slot] = int(d.strategy)
+        br_threshold[slot] = float(d.threshold)
+        if int(d.strategy) == int(DegradeStrategy.SLOW_REQUEST_RATIO):
+            br_slow_rt[slot] = max(0, int(d.slow_rt_ms))
+        br_min_request[slot] = max(1, int(d.min_request_amount))
+        # the outcome ring holds exactly one interval of history; a stat
+        # interval past that would silently read a shorter window anyway
+        br_stat_ms[slot] = int(
+            np.clip(int(d.stat_interval_ms), config.bucket_ms, interval_ms)
+        )
+        br_recovery_ms[slot] = max(1, int(d.recovery_timeout_ms))
     for ns_name, n in (connected or {}).items():
         ns_conn[index.namespace_slot(ns_name)] = max(1, int(n))
     table = RuleTable(
@@ -217,6 +305,14 @@ def build_rule_table(
         slope=jnp.asarray(slope),
         cold_count=jnp.asarray(cold_count),
         max_queue_ms=jnp.asarray(max_queue_ms),
+        # no degrade rules → None columns: a structurally different pytree,
+        # so jit specializes the decide step with NO breaker arm traced in
+        br_strategy=jnp.asarray(br_strategy) if degrade_rules else None,
+        br_threshold=jnp.asarray(br_threshold) if degrade_rules else None,
+        br_slow_rt_ms=jnp.asarray(br_slow_rt) if degrade_rules else None,
+        br_min_request=jnp.asarray(br_min_request) if degrade_rules else None,
+        br_stat_ms=jnp.asarray(br_stat_ms) if degrade_rules else None,
+        br_recovery_ms=jnp.asarray(br_recovery_ms) if degrade_rules else None,
     )
     return table, index
 
@@ -254,6 +350,36 @@ def decode_rule(d: dict) -> ClusterFlowRule:
     )
 
 
+def encode_degrade_rule(rule: DegradeRule) -> dict:
+    """Wire/blob dict for a DegradeRule — shared by snapshots and MOVE
+    blobs, same emit-only-non-default discipline as :func:`encode_rule`."""
+    d = {
+        "flow_id": int(rule.flow_id),
+        "strategy": int(rule.strategy),
+        "threshold": float(rule.threshold),
+        "minRequest": int(rule.min_request_amount),
+        "statMs": int(rule.stat_interval_ms),
+        "recoveryMs": int(rule.recovery_timeout_ms),
+        "namespace": rule.namespace,
+    }
+    if int(rule.strategy) == int(DegradeStrategy.SLOW_REQUEST_RATIO):
+        d["slowRtMs"] = int(rule.slow_rt_ms)
+    return d
+
+
+def decode_degrade_rule(d: dict) -> DegradeRule:
+    return DegradeRule(
+        flow_id=int(d["flow_id"]),
+        strategy=DegradeStrategy(int(d["strategy"])),
+        threshold=float(d["threshold"]),
+        slow_rt_ms=int(d.get("slowRtMs", 1000)),
+        min_request_amount=int(d.get("minRequest", 5)),
+        stat_interval_ms=int(d.get("statMs", 1000)),
+        recovery_timeout_ms=int(d.get("recoveryMs", 5000)),
+        namespace=str(d.get("namespace", "default")),
+    )
+
+
 def drain_pending_clear(index: RuleIndex, state) -> "object":
     """Zero the window history of slots freed by rule reloads; returns the
     updated EngineState. Idempotent; call after every ``build_rule_table``."""
@@ -281,10 +407,18 @@ def drain_pending_clear(index: RuleIndex, state) -> "object":
     )
     # a reused slot must not inherit the removed flow's completion history
     outcome_counts = state.outcome.counts.at[idx].set(0)
+    # nor the removed flow's breaker: a reused slot starts CLOSED/cold
+    breaker = state.breaker
+    breaker = breaker._replace(
+        state=breaker.state.at[idx].set(jnp.int8(0)),
+        opened_ms=breaker.opened_ms.at[idx].set(NEVER),
+        probe_ms=breaker.probe_ms.at[idx].set(NEVER),
+    )
     return EngineState(
         flow=WindowState(starts=state.flow.starts, counts=flow_counts),
         occupy=WindowState(starts=state.occupy.starts, counts=occupy_counts),
         ns=state.ns,
         shaping=shaping,
         outcome=WindowState(starts=state.outcome.starts, counts=outcome_counts),
+        breaker=breaker,
     )

@@ -66,7 +66,13 @@ class OutcomeChannel(enum.IntEnum):
     RT_SUM = 0
     COMPLETE = 1
     EXCEPTION = 2
-    RT_HIST0 = 3
+    # completions whose RT exceeded the flow's DegradeRule slow_rt_ms —
+    # the SLOW_REQUEST_RATIO breaker numerator. Counted exactly at report
+    # time (the per-flow cutoff is a rule column), not reconstructed from
+    # the coarse log2 histogram, so the breaker ratio matches the
+    # reference's per-request `rt > maxAllowedRt` test bit-for-bit.
+    SLOW = 3
+    RT_HIST0 = 4
 
 
 # log2 RT histogram cells; bucket 11 spans [2047, inf) ms. Upper edges are
@@ -94,12 +100,40 @@ class ShapingState(NamedTuple):
     warm_filled: jax.Array  # int32 [F] — last warmup sync second, engine ms
 
 
+# circuit-breaker states (AbstractCircuitBreaker.State); plain ints so the
+# kernel compares i8 columns without enum machinery
+BR_CLOSED = 0
+BR_OPEN = 1
+BR_HALF_OPEN = 2
+
+
+class BreakerState(NamedTuple):
+    """Per-flow circuit-breaker columns (``AbstractCircuitBreaker``'s
+    ``currentState`` + ``nextRetryTimestamp`` atomics, flattened to
+    ``[max_flows]`` device columns so transitions run batch-vectorized
+    inside the decide kernel).
+
+    ``opened_ms`` doubles as the stats fence: every transition stamps it
+    ``now``, and the breaker evaluation only reads outcome buckets whose
+    start is >= ``max(now - stat_interval, opened_ms)`` — the device analog
+    of the reference's ``resetStat()`` on close, without destroying the
+    shared telemetry window. ``probe_ms`` is the HALF_OPEN probe ticket:
+    the engine clock at which the current probe was elected (``NEVER``
+    when no probe is in flight); a probe whose completion report never
+    arrives re-arms after ``recovery_timeout_ms``."""
+
+    state: jax.Array  # int8 [F] — BR_CLOSED / BR_OPEN / BR_HALF_OPEN
+    opened_ms: jax.Array  # int32 [F] — last transition clock (stats fence)
+    probe_ms: jax.Array  # int32 [F] — HALF_OPEN probe election clock
+
+
 class EngineState(NamedTuple):
     flow: WindowState  # [F, B, E] current windows
     occupy: WindowState  # [F, B, 1] future (borrowed) windows
     ns: WindowState  # [NS, B, 1] namespace request qps guard
     shaping: ShapingState  # [F] per-flow shaper clocks
     outcome: WindowState  # [F, B, N_OUTCOME_CHANNELS] completion outcomes
+    breaker: BreakerState  # [F] per-flow circuit-breaker columns
 
 
 def flow_spec(config: EngineConfig) -> WindowSpec:
@@ -114,6 +148,14 @@ def make_shaping(n_flows: int) -> ShapingState:
     )
 
 
+def make_breaker(n_flows: int) -> BreakerState:
+    return BreakerState(
+        state=jnp.zeros((n_flows,), dtype=jnp.int8),  # BR_CLOSED
+        opened_ms=jnp.full((n_flows,), NEVER, dtype=jnp.int32),
+        probe_ms=jnp.full((n_flows,), NEVER, dtype=jnp.int32),
+    )
+
+
 def make_state(config: EngineConfig) -> EngineState:
     spec = flow_spec(config)
     return EngineState(
@@ -122,4 +164,5 @@ def make_state(config: EngineConfig) -> EngineState:
         ns=make_window(spec, config.max_namespaces, 1),
         shaping=make_shaping(config.max_flows),
         outcome=make_window(spec, config.max_flows, N_OUTCOME_CHANNELS),
+        breaker=make_breaker(config.max_flows),
     )

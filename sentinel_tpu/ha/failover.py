@@ -167,6 +167,20 @@ class FailoverTokenClient(TokenService):
         return False
 
     @staticmethod
+    def _degraded(result) -> bool:
+        """A server-side circuit-breaker refusal (DEGRADED): the resource's
+        breaker is OPEN and the server is answering honestly with a
+        retry-after hint. Same whole-batch rule as OVERLOAD."""
+        if isinstance(result, TokenResult):
+            return result.status == TokenStatus.DEGRADED
+        if isinstance(result, tuple) and len(result) == 3:
+            status = np.asarray(result[0])
+            return status.size > 0 and bool(
+                (status == int(TokenStatus.DEGRADED)).all()
+            )
+        return False
+
+    @staticmethod
     def _lease_refusal(result) -> bool:
         """A lease-protocol refusal (NOT_LEASABLE: flow not leasable, lease
         revoked, or no headroom to delegate). The wrapped per-endpoint
@@ -207,7 +221,16 @@ class FailoverTokenClient(TokenService):
         client has no shard map to follow the redirect with (that is
         RoutingTokenClient's job), so it records SUCCESS — evicting a
         healthy server for answering honestly would be wrong — and walks on
-        to the next endpoint, which may be the move's destination."""
+        to the next endpoint, which may be the move's destination.
+
+        DEGRADED replies (server-side circuit breaking) are proof of life
+        as well: the resource's breaker is OPEN, which says the PROTECTED
+        DEPENDENCY is unhealthy, not the token server. The breaker records
+        SUCCESS and the walk tries the next endpoint (a standby whose
+        replicated breaker lags may still admit); when nothing answers
+        better the first DEGRADED verdict — with its retry-after hint in
+        ``remaining`` — is returned rather than degrading to fallback,
+        which would defeat the breaker's whole purpose."""
         if failed is None:
             failed = lambda r: (
                 r is None
@@ -216,6 +239,7 @@ class FailoverTokenClient(TokenService):
             )
         deadline = _clock.now_ms() + self.deadline_ms
         overload_result = None
+        degraded_result = None
         saw_standby = False
         for i, member in enumerate(self._members):
             # health is consulted immediately before dispatch, never up
@@ -262,6 +286,13 @@ class FailoverTokenClient(TokenService):
                 if _clock.now_ms() >= deadline:
                     break
                 continue
+            if self._degraded(result):
+                ha_metrics().count_fallback("degraded")
+                if degraded_result is None:
+                    degraded_result = result
+                if _clock.now_ms() >= deadline:
+                    break
+                continue
             if self._overloaded(result):
                 ha_metrics().count_fallback("overload_backoff")
                 if overload_result is None:
@@ -271,6 +302,10 @@ class FailoverTokenClient(TokenService):
                 continue
             self._note_served(i)
             return result
+        if degraded_result is not None:
+            # the breaker verdict is authoritative cluster state (the same
+            # OPEN row replicates everywhere) — prefer it over OVERLOAD
+            return degraded_result
         if overload_result is not None:
             return overload_result
         if not saw_standby:

@@ -84,6 +84,9 @@ _ARRAY_KEYS = frozenset(
         # completion-outcome columns (own dirty set: reporting cadence is
         # decoupled from the admission windows')
         "outcome_starts", "outcome_counts",
+        # circuit-breaker columns (own dirty set: transitions happen only
+        # on batched/reported rows, so touched∩breaker is exact)
+        "breaker_state", "breaker_opened", "breaker_probe",
     }
 )
 

@@ -40,7 +40,12 @@ from sentinel_tpu.core import clock as _clock
 from sentinel_tpu.core.config import SentinelConfig
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.cluster.token_service import ClusterParamFlowRule
-from sentinel_tpu.engine.rules import decode_rule, encode_rule
+from sentinel_tpu.engine.rules import (
+    decode_degrade_rule,
+    decode_rule,
+    encode_degrade_rule,
+    encode_rule,
+)
 from sentinel_tpu.metrics.ha import ha_metrics
 
 SNAPSHOT_VERSION = 1
@@ -122,6 +127,20 @@ def encode_snapshot(state: Dict[str, object]) -> Dict[str, object]:
             {"outcome": _enc_win(state["outcome"])}
             if "outcome" in state else {}
         ),
+        # circuit-breaker rules + state columns (absent in pre-breaker
+        # snapshots; the importer then restores every breaker CLOSED)
+        **(
+            {
+                "degrade_rules": [
+                    encode_degrade_rule(d) for d in state["degrade_rules"]
+                ],
+            }
+            if "degrade_rules" in state else {}
+        ),
+        **(
+            {"breaker": _enc_win(state["breaker"])}
+            if "breaker" in state else {}
+        ),
         # hierarchy-coordinator ledger piggyback (already JSON-safe; absent
         # when no coordinator is co-located with this pod)
         **({"hier": state["hier"]} if "hier" in state else {}),
@@ -170,6 +189,18 @@ def decode_snapshot(doc: Dict[str, object]) -> Dict[str, object]:
         **(
             {"outcome": _dec_win(doc["outcome"])}
             if "outcome" in doc else {}
+        ),
+        **(
+            {
+                "degrade_rules": [
+                    decode_degrade_rule(d) for d in doc["degrade_rules"]
+                ],
+            }
+            if "degrade_rules" in doc else {}
+        ),
+        **(
+            {"breaker": _dec_win(doc["breaker"])}
+            if "breaker" in doc else {}
         ),
         **({"hier": doc["hier"]} if "hier" in doc else {}),
     }

@@ -37,6 +37,7 @@ VERDICT_NAMES: Dict[int, str] = {
     8: "overload",        # admission refused: queue full / deadline / brownout
     9: "standby",         # unpromoted warm standby refused to decide
     10: "moved",          # namespace rebalanced away: redirect to new owner
+    12: "degraded",       # circuit breaker OPEN/HALF_OPEN refused the row
 }
 
 # reasons on the sentinel_server_shed_total counter: every dropped or
@@ -172,6 +173,14 @@ class ServerMetrics:
         # outcome columns). Same most-recent-wins weakref model as the rest.
         self._outcome_provider: Optional[Callable[[], dict]] = None
         self._outcome_lock = threading.Lock()
+        # circuit-breaker observability: the live token service registers
+        # a zero-arg reader returning its breaker_stats() block (per-flow
+        # breaker state + clocks, read from the device state columns), and
+        # pushes CLOSED/OPEN/HALF_OPEN transition edges through
+        # count_breaker_transition as its host mirror observes them.
+        self._breaker_provider: Optional[Callable[[], dict]] = None
+        self._breaker_transitions: Dict[Tuple[str, str], int] = {}
+        self._breaker_lock = threading.Lock()
 
     # -- fused dispatch counters --------------------------------------------
     def record_fused(self, depth: int) -> None:
@@ -330,7 +339,8 @@ class ServerMetrics:
 
     # refusal verdict → the SLO-plane shed reason it is attributed under
     _SLO_SHED_REASONS = {"overload": "overload", "too_many_request":
-                         "namespace_guard", "moved": "moved"}
+                         "namespace_guard", "moved": "moved",
+                         "degraded": "degraded"}
 
     def _feed_slo(
         self,
@@ -515,6 +525,44 @@ class ServerMetrics:
         except Exception:
             return {}  # a torn-down service's reader must not 500 a scrape
 
+    # -- breaker provider ---------------------------------------------------
+    def register_breaker_provider(self, fn: Callable[[], dict]) -> None:
+        """Install the zero-arg reader for the token service's circuit
+        breaker stats (``DefaultTokenService.breaker_stats`` shape:
+        per-flow breaker state name + clocks read from the device
+        ``BreakerState`` columns; ``{}`` with no breakers loaded). Most
+        recent registration wins; providers return ``{}`` once their
+        service is gone."""
+        with self._breaker_lock:
+            self._breaker_provider = fn
+
+    def breaker_stats(self) -> dict:
+        with self._breaker_lock:
+            fn = self._breaker_provider
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:
+            return {}  # a torn-down service's reader must not 500 a scrape
+
+    def count_breaker_transition(
+        self, from_state: str, to_state: str, n: int = 1
+    ) -> None:
+        """``n`` breaker transitions ``from_state`` → ``to_state`` observed
+        by the host mirror (state names: closed / open / half_open)."""
+        if n <= 0:
+            return
+        key = (str(from_state), str(to_state))
+        with self._breaker_lock:
+            self._breaker_transitions[key] = (
+                self._breaker_transitions.get(key, 0) + int(n)
+            )
+
+    def breaker_transition_totals(self) -> Dict[Tuple[str, str], int]:
+        with self._breaker_lock:
+            return dict(self._breaker_transitions)
+
     # -- snapshots ----------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON shape served by the ``clusterServerStats`` command — the
@@ -540,6 +588,15 @@ class ServerMetrics:
             "lease": self.lease_stats(),
             "hier": self.hier_stats(),
             "outcome": self.outcome_stats(),
+            "breaker": {
+                **self.breaker_stats(),
+                "transitions": [
+                    {"from": f, "to": t, "count": c}
+                    for (f, t), c in sorted(
+                        self.breaker_transition_totals().items()
+                    )
+                ],
+            },
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
                 "decide_ms": self.decide_ms.snapshot(),
@@ -835,6 +892,40 @@ class ServerMetrics:
                         f'{mname}{{flow_id="{int(fid)}"}} '
                         f"{float(vals.get(fkey, 0.0) or 0.0):g}"
                     )
+        lines.append(
+            "# HELP sentinel_breaker_transitions_total Circuit-breaker "
+            "state transitions observed by the host mirror, by edge "
+            "(cumulative)."
+        )
+        lines.append("# TYPE sentinel_breaker_transitions_total counter")
+        transitions = self.breaker_transition_totals()
+        if transitions:
+            for (frm, to), count in sorted(transitions.items()):
+                lines.append(
+                    "sentinel_breaker_transitions_total"
+                    f'{{from="{_escape(frm)}",to="{_escape(to)}"}} {count}'
+                )
+        else:
+            # zero-sample so the series exists before the first trip
+            lines.append(
+                'sentinel_breaker_transitions_total'
+                '{from="closed",to="open"} 0'
+            )
+        breaker = self.breaker_stats()
+        br_flows = breaker.get("flows") or {}
+        if br_flows:
+            lines.append(
+                "# HELP sentinel_breaker_state Circuit-breaker state per "
+                "flow (0 = closed, 1 = open, 2 = half_open), read from the "
+                "device BreakerState columns."
+            )
+            lines.append("# TYPE sentinel_breaker_state gauge")
+            for fid in sorted(br_flows, key=int):
+                vals = br_flows[fid] or {}
+                lines.append(
+                    f'sentinel_breaker_state{{flow_id="{int(fid)}"}} '
+                    f"{int(vals.get('state_code', 0) or 0)}"
+                )
         gauges = self._gauge_values()
         for name, help_text in (
             ("queue_depth", "Requests queued awaiting a device step."),
@@ -925,6 +1016,9 @@ class ServerMetrics:
             self._hier_provider = None
         with self._outcome_lock:
             self._outcome_provider = None
+        with self._breaker_lock:
+            self._breaker_provider = None
+            self._breaker_transitions.clear()
         self._rate.reset()
 
 

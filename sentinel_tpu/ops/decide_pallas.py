@@ -578,6 +578,18 @@ def decide_core_pallas(
     ns_admitted = live & ns_ok
     active = ns_admitted & owned
 
+    # circuit breakers run in the prologue with the SAME shared gate (and
+    # the same grouped prefix builder) as the XLA path, and degraded rows
+    # are stripped from `active` BEFORE the kernel sees it — the megakernel
+    # then treats them exactly like inactive rows (zero event deltas, no
+    # admission), so kernel parity holds by construction with zero kernel
+    # changes
+    degraded, br_retry, breaker_ws = D._breaker_gate(
+        config, spec, state, rules, now, safe_slot, active,
+        _grouped_prefix(safe_slot), psum,
+    )
+    active = active & ~degraded
+
     conn = rules.ns_connected[ns_id].astype(jnp.float32)
     factor = jnp.where(
         rules.mode[safe_slot] == int(ThresholdMode.AVG_LOCAL), conn, 1.0
@@ -692,13 +704,18 @@ def decide_core_pallas(
     # ---- verdict stitching (identical to _decide_core §6) ---------------
     TokenStatus = D.TokenStatus
     local_status = jnp.where(
-        admit | pace_now,
-        int(TokenStatus.OK) + 1,
+        degraded,
+        int(TokenStatus.DEGRADED) + 1,
         jnp.where(
-            can_occupy | pace_later,
-            int(TokenStatus.SHOULD_WAIT) + 1,
+            admit | pace_now,
+            int(TokenStatus.OK) + 1,
             jnp.where(
-                hard_block | pace_reject, int(TokenStatus.BLOCKED) + 1, 0
+                can_occupy | pace_later,
+                int(TokenStatus.SHOULD_WAIT) + 1,
+                jnp.where(
+                    hard_block | pace_reject,
+                    int(TokenStatus.BLOCKED) + 1, 0
+                ),
             ),
         ),
     ).astype(jnp.int32)
@@ -729,7 +746,9 @@ def decide_core_pallas(
         0.0,
         2 ** 30,
     ).astype(jnp.int32)
-    remaining = psum(jnp.where(admit, remaining_local, 0))
+    remaining = psum(
+        jnp.where(admit, remaining_local, jnp.where(degraded, br_retry, 0))
+    )
 
     new_state = EngineState(
         flow=flow_ws, occupy=occupy_ws, ns=ns_ws,
@@ -738,6 +757,7 @@ def decide_core_pallas(
             warm_filled=warm_filled_ws,
         ),
         outcome=state.outcome,
+        breaker=breaker_ws,
     )
     verdicts = D.VerdictBatch(
         status=status, wait_ms=wait_ms, remaining=remaining

@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sentinel_tpu.engine.config import EngineConfig
 from sentinel_tpu.engine.decide import RequestBatch, VerdictBatch, _core_for
 from sentinel_tpu.engine.rules import RuleTable
-from sentinel_tpu.engine.state import EngineState, ShapingState
+from sentinel_tpu.engine.state import BreakerState, EngineState, ShapingState
 from sentinel_tpu.stats.window import WindowState
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
@@ -67,10 +67,16 @@ def _state_specs(axis: str) -> EngineState:
             lpt=P(axis), warm_tokens=P(axis), warm_filled=P(axis)
         ),
         outcome=WindowState(starts=P(), counts=P(axis)),
+        breaker=BreakerState(
+            state=P(axis), opened_ms=P(axis), probe_ms=P(axis)
+        ),
     )
 
 
-def _rules_specs(axis: str) -> RuleTable:
+def _rules_specs(axis: str, br: bool = True) -> RuleTable:
+    # ``br=False`` mirrors a table built with no degrade rules, whose six
+    # br_* columns are None (and so absent from the pytree structure)
+    brp = P(axis) if br else None
     return RuleTable(
         valid=P(axis),
         count=P(axis),
@@ -84,6 +90,12 @@ def _rules_specs(axis: str) -> RuleTable:
         slope=P(axis),
         cold_count=P(axis),
         max_queue_ms=P(axis),
+        br_strategy=brp,
+        br_threshold=brp,
+        br_slow_rt_ms=brp,
+        br_min_request=brp,
+        br_stat_ms=brp,
+        br_recovery_ms=brp,
     )
 
 
@@ -100,7 +112,7 @@ def shard_state(state: EngineState, mesh: Mesh, axis: str = "flows") -> EngineSt
 
 
 def shard_rules(rules: RuleTable, mesh: Mesh, axis: str = "flows") -> RuleTable:
-    specs = _rules_specs(axis)
+    specs = _rules_specs(axis, br=rules.br_strategy is not None)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), rules, specs
     )
@@ -205,13 +217,32 @@ def make_sharded_decide(
 
             return jax.lax.scan(body, state, batches, length=depth)
 
-    mapped = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(_state_specs(axis), _rules_specs(axis), _batch_specs(), P()),
-        out_specs=(
-            _state_specs(axis),
-            VerdictBatch(status=P(), wait_ms=P(), remaining=P()),
-        ),
-    )
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    # two spec shapes, matching the two RuleTable pytree structures: with
+    # br_* columns (degrade rules loaded) and without (None columns, so the
+    # compile skips the breaker arm). Built lazily on first use of each.
+    def _build(br: bool):
+        mapped = shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                _state_specs(axis),
+                _rules_specs(axis, br=br),
+                _batch_specs(),
+                P(),
+            ),
+            out_specs=(
+                _state_specs(axis),
+                VerdictBatch(status=P(), wait_ms=P(), remaining=P()),
+            ),
+        )
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+    impls = {}
+
+    def sharded_step(state, rules, batch, now):
+        br = rules.br_strategy is not None
+        if br not in impls:
+            impls[br] = _build(br)
+        return impls[br](state, rules, batch, now)
+
+    return sharded_step

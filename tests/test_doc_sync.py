@@ -269,6 +269,77 @@ class TestScenarioDocSync:
         assert any(p.chaos for p in cfg.model.phases)
 
 
+class TestDegradeDocSync:
+    """docs/DEGRADE.md ↔ breaker plane sync: the doc names the strategy
+    math, the tensor columns, the HA keys, and the verification surface —
+    each of which exists in code, and the derived numbers it quotes come
+    from the live dtypes/enums, not a stale copy."""
+
+    def _text(self):
+        with open(os.path.join(REPO, "docs", "DEGRADE.md")) as f:
+            return f.read()
+
+    def test_cross_links(self):
+        with open(os.path.join(REPO, "README.md")) as f:
+            readme = f.read()
+        assert "docs/DEGRADE.md" in readme
+        assert "degrade_drill.py" in readme
+        for doc in ("ROBUSTNESS.md", "CLUSTER_HA.md", "OBSERVABILITY.md"):
+            with open(os.path.join(REPO, "docs", doc)) as f:
+                assert "DEGRADE.md" in f.read(), f"{doc} lost the link"
+
+    @pytest.mark.parametrize("needle", [
+        # the three strategies and their knobs
+        "SLOW_REQUEST_RATIO",
+        "ERROR_RATIO",
+        "ERROR_COUNT",
+        "min_request_amount",
+        "stat_interval_ms",
+        "recovery_timeout_ms",
+        "slow_rt_ms",
+        # the state columns and the probe ticket
+        "`opened_ms`",
+        "`probe_ms`",
+        "HALF_OPEN",
+        # what feeds them and what they answer
+        "OUTCOME_REPORT",
+        "`DEGRADED`",
+        "NOT_LEASABLE",
+        # HA: replication delta keys and the relative MOVE keys
+        "breaker_fids",
+        "breaker_state",
+        "breaker_opened_rel",
+        # the metric surface and its host-scan caveat
+        "`sentinel_breaker_transitions_total`",
+        "`sentinel_breaker_state`",
+        "net edges",
+        # verification surface
+        "sentinel-degrade-drill/1",
+        "tests/test_degrade.py",
+        "benchmarks/degrade_drill.py",
+        "--degraded",
+        "`degrade-smoke`",
+    ])
+    def test_doc_names_the_surface(self, needle):
+        assert needle in self._text()
+
+    def test_doc_numbers_come_from_code(self):
+        """The per-flow byte cost and the DEGRADED wire code the doc quotes
+        are derived from the live columns, not hand-copied."""
+        import numpy as np
+
+        from sentinel_tpu.engine import EngineConfig, TokenStatus, make_state
+
+        state = make_state(EngineConfig(max_flows=8, max_namespaces=2,
+                                        batch_size=16))
+        per_flow = sum(
+            np.asarray(leaf).dtype.itemsize for leaf in state.breaker
+        )
+        text = self._text()
+        assert f"**{per_flow} bytes**" in text
+        assert f"status code **{int(TokenStatus.DEGRADED)}**" in text
+
+
 class TestMegakernelDocSync:
     """docs/PERF.md round 16 ↔ code sync: the doc names the megakernel's
     selection surface, the bytes ledger, the pipelined lane knob, and the

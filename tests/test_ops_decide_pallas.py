@@ -34,7 +34,10 @@ from sentinel_tpu.engine.decide import (
     decide_fused_donating,
     resolve_decide_impl,
 )
+from sentinel_tpu.engine import DegradeRule, DegradeStrategy, TokenStatus
+from sentinel_tpu.engine.outcome import outcome_step_donating
 from sentinel_tpu.engine.rules import ControlBehavior, ThresholdMode
+from sentinel_tpu.engine.state import BR_CLOSED
 from sentinel_tpu.ops.decide_pallas import MAX_BATCH, decide_core_pallas
 from sentinel_tpu.parallel import (
     make_flow_mesh,
@@ -250,6 +253,143 @@ class TestMegakernelParity:
         # and the deltas actually landed (3 + 2 PASS_REQUESTs per step)
         flow = jax.device_get(st_x.flow.counts)
         assert flow[8, :, 1].sum() == 6 and flow[16, :, 1].sum() == 4
+
+
+class TestBreakerParity:
+    """The breaker plane inside the megakernel: CLOSED→OPEN trips,
+    retry-after verdicts, the HALF_OPEN single-probe election, and the
+    transition scatters must come back bit-identical to the XLA core.
+    Outcome reports go through the (backend-independent) outcome step
+    applied to each backend's state copy, so any divergence is the decide
+    twin's fault alone."""
+
+    def _build_with_breakers(self, config):
+        table, index = build_rule_table(
+            config, _mixed_rules(), ns_max_qps=30_000.0,
+            connected={"default": 3, "tight": 2},
+            degrade_rules=[
+                DegradeRule(1, DegradeStrategy.ERROR_RATIO, threshold=0.2,
+                            min_request_amount=5, stat_interval_ms=1000,
+                            recovery_timeout_ms=300),
+                DegradeRule(4, DegradeStrategy.SLOW_REQUEST_RATIO,
+                            threshold=0.3, slow_rt_ms=40,
+                            min_request_amount=5, stat_interval_ms=1000,
+                            recovery_timeout_ms=400, namespace="default"),
+                DegradeRule(6, DegradeStrategy.ERROR_COUNT, threshold=3.0,
+                            min_request_amount=1, stat_interval_ms=800,
+                            recovery_timeout_ms=350, namespace="tight"),
+            ],
+        )
+        return table, index
+
+    def _report(self, ostep, table, state, slots, rts, excs, now):
+        k = len(slots)
+        return ostep(
+            state, jnp.asarray(slots, jnp.int32),
+            jnp.asarray(rts, jnp.int32), jnp.asarray(excs, jnp.int32),
+            jnp.ones((k,), bool), jnp.int32(now),
+            table.br_strategy, table.br_slow_rt_ms,
+        )
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_breaker_stream_parity(self, seed):
+        table, _ = self._build_with_breakers(CFG_X)
+        ostep = outcome_step_donating(CFG_X)
+        st_x, st_p = make_state(CFG_X), make_state(CFG_P)
+        rng = np.random.default_rng(0xBEA + seed)
+        now = 10_000
+        guarded = [1, 4, 6]
+        saw_open = False
+        for step_i in range(14):
+            now += int(rng.integers(40, 260))
+            if rng.random() < 0.5:
+                k = int(rng.integers(8, 24))
+                slots = rng.choice(guarded, size=k).astype(np.int32)
+                rts = rng.integers(1, 90, size=k).astype(np.int32)
+                excs = (rng.random(k) < 0.5).astype(np.int32)
+                st_x = self._report(ostep, table, st_x, slots, rts, excs, now)
+                st_p = self._report(ostep, table, st_p, slots, rts, excs, now)
+            else:
+                n = int(rng.integers(6, 20))
+                slots = rng.choice(guarded + [0, 29], size=n).astype(np.int32)
+                slots.sort()
+                batch = make_batch(CFG_X, slots)
+                st_x, v_x = decide(CFG_X, st_x, table, batch, now,
+                                   grouped=True)
+                st_p, v_p = decide(CFG_P, st_p, table, batch, now,
+                                   grouped=True)
+                _assert_trees_equal(
+                    v_x, v_p, f"breaker verdicts seed={seed} step={step_i}"
+                )
+                saw_open |= bool(
+                    (np.asarray(v_x.status)[:n]
+                     == int(TokenStatus.DEGRADED)).any()
+                )
+            _assert_trees_equal(
+                st_x, st_p, f"breaker state seed={seed} step={step_i}"
+            )
+        # the error-heavy stream must actually trip breakers — an
+        # all-CLOSED parity run would not cover the transition scatters
+        assert saw_open
+
+    def test_half_open_probe_parity(self):
+        """Trip flow 1, wait out recovery, then send a grouped batch of 8
+        same-flow rows: both backends must elect exactly the first row as
+        the probe and stamp identical probe tickets."""
+        table, _ = self._build_with_breakers(CFG_X)
+        ostep = outcome_step_donating(CFG_X)
+        st_x, st_p = make_state(CFG_X), make_state(CFG_P)
+        slots, rts, excs = [1] * 8, [5] * 8, [1] * 8
+        st_x = self._report(ostep, table, st_x, slots, rts, excs, 10_000)
+        st_p = self._report(ostep, table, st_p, slots, rts, excs, 10_000)
+
+        def both(now, rows):
+            nonlocal st_x, st_p
+            batch = make_batch(CFG_X, rows)
+            st_x, v_x = decide(CFG_X, st_x, table, batch, now, grouped=True)
+            st_p, v_p = decide(CFG_P, st_p, table, batch, now, grouped=True)
+            _assert_trees_equal(v_x, v_p, f"probe verdicts now={now}")
+            _assert_trees_equal(st_x, st_p, f"probe state now={now}")
+            return np.asarray(v_x.status)
+
+        status = both(10_050, np.asarray([1], np.int32))  # trips
+        assert status[0] == int(TokenStatus.DEGRADED)
+        status = both(10_400, np.ones(8, np.int32))  # past recovery: probe
+        assert int((status[:8] == int(TokenStatus.OK)).sum()) == 1
+        assert status[0] == int(TokenStatus.OK)
+        # probe succeeds → CLOSED again, bit-equal columns both sides
+        st_x = self._report(ostep, table, st_x, [1], [5], [0], 10_450)
+        st_p = self._report(ostep, table, st_p, [1], [5], [0], 10_450)
+        assert int(np.asarray(st_x.breaker.state)[1]) == BR_CLOSED
+        status = both(10_500, np.ones(4, np.int32))
+        assert (status[:4] == int(TokenStatus.OK)).all()
+
+    def test_fused_breaker_scan_parity(self):
+        """Breaker columns through the fused ``lax.scan``: an OPEN flow past
+        recovery inside a 2-deep stack — frame 0 elects, frame 1 sees the
+        live ticket, identically in both backends."""
+        depth = 2
+        table, _ = self._build_with_breakers(CFG_X)
+        ostep = outcome_step_donating(CFG_X)
+        st_x, st_p = make_state(CFG_X), make_state(CFG_P)
+        slots, rts, excs = [1] * 8, [5] * 8, [1] * 8
+        st_x = self._report(ostep, table, st_x, slots, rts, excs, 10_000)
+        st_p = self._report(ostep, table, st_p, slots, rts, excs, 10_000)
+        trip = make_batch(CFG_X, np.asarray([1], np.int32))
+        st_x, _ = decide(CFG_X, st_x, table, trip, 10_050, grouped=True)
+        st_p, _ = decide(CFG_P, st_p, table, trip, 10_050, grouped=True)
+
+        step_x = decide_fused_donating(CFG_X, depth, grouped=True)
+        step_p = decide_fused_donating(CFG_P, depth, grouped=True)
+        frames = [make_batch(CFG_X, np.ones(6, np.int32)) for _ in range(2)]
+        batches = jax.tree.map(lambda *xs: np.stack(xs), *frames)
+        st_x, v_x = step_x(st_x, table, batches, jnp.int32(10_400))
+        st_p, v_p = step_p(st_p, table, batches, jnp.int32(10_400))
+        _assert_trees_equal(v_x, v_p, "fused breaker verdicts")
+        _assert_trees_equal(st_x, st_p, "fused breaker state")
+        status = np.asarray(v_x.status)[:, :6]
+        assert int((status == int(TokenStatus.OK)).sum()) == 1
+        assert status[0, 0] == int(TokenStatus.OK)
 
 
 class TestBackendSelection:

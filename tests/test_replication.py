@@ -318,6 +318,135 @@ class TestDeltaApply:
             standby.apply_replication_delta(delta)
 
 
+# -- circuit-breaker columns across the HA planes ----------------------------
+class TestBreakerColumnsAcrossHA:
+    """The breaker state machine must survive every serialization plane: an
+    OPEN breaker that a standby or MOVE destination silently restores as
+    CLOSED would re-admit a failing dependency exactly when the primary had
+    fenced it off. Deltas ship the three columns under their own dirty set;
+    snapshots restore them bit-exact (and tolerate their absence in
+    pre-breaker artifacts); MOVE blobs carry RELATIVE clocks so the
+    retry-after countdown is frozen in transit and re-anchors on import."""
+
+    def _breaker_service(self, recovery_ms=2000):
+        from sentinel_tpu.engine import DegradeRule, DegradeStrategy
+
+        svc = DefaultTokenService(CFG)
+        svc.load_rules([
+            ClusterFlowRule(flow_id=1, count=1e9, mode=G, namespace="brns")
+        ])
+        svc.load_degrade_rules([
+            DegradeRule(1, DegradeStrategy.ERROR_RATIO, threshold=0.2,
+                        min_request_amount=5, stat_interval_ms=1000,
+                        recovery_timeout_ms=recovery_ms, namespace="brns"),
+        ])
+        return svc
+
+    def _trip(self, svc, mc):
+        """Report an error burst, then decide once: CLOSED→OPEN. Returns
+        the DEGRADED verdict's retry-after-ms."""
+        svc.report_outcomes(
+            np.full(8, 1, np.int64), np.full(8, 5, np.int64),
+            np.ones(8, np.int64),
+        )
+        mc.advance(50)
+        st, rem, _ = svc.request_batch_arrays(np.array([1], np.int64))
+        assert int(np.asarray(st)[0]) == int(TokenStatus.DEGRADED)
+        return int(np.asarray(rem)[0])
+
+    def _assert_breaker_equal(self, a, b):
+        for leaf_a, leaf_b in zip(a._state.breaker, b._state.breaker):
+            np.testing.assert_array_equal(
+                np.asarray(leaf_a), np.asarray(leaf_b)
+            )
+
+    def test_breaker_rows_ship_in_delta_and_dirty_set_drains(
+        self, manual_clock
+    ):
+        manual_clock.advance(1_000)
+        primary = self._breaker_service()
+        standby = self._breaker_service()
+        primary.replication_enable()
+        standby.import_state(
+            R.decode_snapshot_blob(
+                R.encode_snapshot_blob(primary.export_state())
+            )
+        )
+        self._trip(primary, manual_clock)
+        delta = R.decode_delta_blob(
+            R.encode_delta_blob(primary.export_delta())
+        )
+        assert delta.get("breaker_fids") == [1]
+        assert int(np.asarray(delta["breaker_state"])[0]) != 0  # OPEN ships
+        standby.apply_replication_delta(delta)
+        self._assert_breaker_equal(standby, primary)
+        # collect-and-clear: with no new breaker activity the next delta
+        # carries no breaker rows (heartbeat-sized, not O(breakers))
+        assert "breaker_fids" not in primary.export_delta()
+
+    def test_snapshot_roundtrip_bit_exact_and_tolerant_absent(
+        self, manual_clock
+    ):
+        manual_clock.advance(1_000)
+        donor = self._breaker_service()
+        self._trip(donor, manual_clock)
+        doc = R.decode_snapshot_blob(
+            R.encode_snapshot_blob(donor.export_state())
+        )
+        twin = DefaultTokenService(CFG)
+        twin.import_state(doc)
+        self._assert_breaker_equal(twin, donor)
+        assert int(np.asarray(twin._state.breaker.state)[
+            twin._index.slot_of[1]]) != 0
+        # pre-breaker artifact: no "breaker" key → restore CLOSED/cold,
+        # which under-protects briefly but never wrongly rejects
+        doc2 = R.decode_snapshot_blob(
+            R.encode_snapshot_blob(donor.export_state())
+        )
+        doc2.pop("breaker")
+        cold = DefaultTokenService(CFG)
+        cold.import_state(doc2)
+        assert (np.asarray(cold._state.breaker.state) == 0).all()
+        # the restored outcome telemetry still shows the error burst, so
+        # the cold breaker legitimately RE-trips on its first decide …
+        st, _, _ = cold.request_batch_arrays(np.array([1], np.int64))
+        assert int(np.asarray(st)[0]) == int(TokenStatus.DEGRADED)
+        # … but the donor's OPEN countdown was forgotten: once the stat
+        # window drains past the re-trip fence, the flow serves again
+        manual_clock.advance(2_100)
+        st, _, _ = cold.request_batch_arrays(np.array([1], np.int64))
+        assert int(np.asarray(st)[0]) == int(TokenStatus.OK)
+
+    def test_move_blob_freezes_retry_countdown_in_transit(self, manual_clock):
+        from sentinel_tpu.cluster.rebalance import (
+            decode_move_state_blob,
+            encode_move_state_blob,
+        )
+
+        manual_clock.advance(1_000)
+        src = self._breaker_service(recovery_ms=2000)
+        self._trip(src, manual_clock)
+        manual_clock.advance(300)  # burn 300ms of the 2000ms recovery
+        st, rem, _ = src.request_batch_arrays(np.array([1], np.int64))
+        assert int(np.asarray(st)[0]) == int(TokenStatus.DEGRADED)
+        rem_at_export = int(np.asarray(rem)[0])
+        blob = encode_move_state_blob(src.export_namespace_state("brns"))
+        # 450ms of transit: the blob carries clocks RELATIVE to export
+        # time, so the countdown must NOT tick while the bytes are in
+        # flight — the destination owes the dependency the full remaining
+        # quiet period, however long the MOVE took
+        manual_clock.advance(450)
+        dest = DefaultTokenService(CFG)
+        dest.import_namespace_state(decode_move_state_blob(blob))
+        st_d, rem_d, _ = dest.request_batch_arrays(np.array([1], np.int64))
+        assert int(np.asarray(st_d)[0]) == int(TokenStatus.DEGRADED)
+        assert int(np.asarray(rem_d)[0]) == rem_at_export
+        assert (
+            dest.breaker_stats()["flows"][1]["state_code"]
+            == src.breaker_stats()["flows"][1]["state_code"]
+        )
+
+
 # -- sender → applier over real servers, chaos on the channel ----------------
 class TestPromotionUnderChaos:
     def test_standby_promotion_with_chaotic_repl_channel(self):

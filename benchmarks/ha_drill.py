@@ -1520,6 +1520,655 @@ def run_hier_drill(
     }
 
 
+def run_push_drill(
+    budget_qps: float = 200.0,
+    bucket_ms: int = 200,
+    lease_ttl_ms: float = 4000.0,
+    lease_want: int = 40,
+    flip_retry_ms: int = 1500,
+    dark_ttl_ms: float = 1500.0,
+    dark_want: int = 20,
+):
+    """Rev-7 push-plane drill: unsolicited server→client frames must cut
+    client-local admission over in RTTs, not lease TTLs — and with the
+    push plane dark, every TTL-era bound must still hold.
+
+    In-process (one pod, real TCP front door) so emit→apply latency is
+    measured exactly. An RTT baseline is taken first, then:
+
+    - **breaker flip**: a pushed OPEN stops a leased client's local
+      admits within ``max(10×RTT, 25ms)`` (the floor absorbs co-located
+      scheduler jitter) and far inside ``0.5× lease TTL``; the local
+      answers are DEGRADED with a live retry clock; a pushed CLOSED
+      lifts the clock so traffic reaches the server again.
+    - **lease revoke**: a pushed revoke drops the cached lease inside
+      the same bound; the local admits that land between emit and apply
+      stay below the TTL-era Σ-outstanding bound (the remaining slice),
+      and the client degrades to wire verdicts without raising.
+    - **rule epoch**: a live ``load_rules`` reaches connected clients as
+      RULE_EPOCH_INVALIDATE.
+    - **observability**: push frame totals, the revocation counter, and
+      the emit→apply staleness histogram are populated on both the stats
+      snapshot and the Prometheus scrape surface.
+    - **push dark**: the same server-side events under ``push=False``
+      send nothing, and the client behaves exactly as the TTL era
+      promised — no pushed DEGRADED answers, local admits bounded by the
+      outstanding slice, resync within the lease TTL.
+    """
+    from sentinel_tpu.cluster.client import TokenClient
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig, TokenStatus
+    from sentinel_tpu.engine.rules import ThresholdMode
+    from sentinel_tpu.metrics.server import server_metrics
+
+    failures = []
+    cfg = EngineConfig(
+        max_flows=64, max_namespaces=4, batch_size=64, bucket_ms=bucket_ms
+    )
+    rules = [
+        ClusterFlowRule(DRILL_FLOW, budget_qps, ThresholdMode.GLOBAL),
+        ClusterFlowRule(WARM_FLOW, 1e9, ThresholdMode.GLOBAL),
+    ]
+    svc = DefaultTokenService(cfg, lease_ttl_ms=int(lease_ttl_ms))
+    svc.load_rules(rules)
+    server = TokenServer(svc, port=0, metrics_port=0)
+    server.start()
+    wire = TokenClient("127.0.0.1", server.port, timeout_ms=500)
+    leaser = TokenClient("127.0.0.1", server.port, timeout_ms=500,
+                         lease=True, lease_want=lease_want)
+    leaser2 = srv2 = wire2 = darkc = None
+    rtt_ms = None
+    flip = revoke = dark = {}
+    rule_epoch_applied = False
+    staleness = {}
+    scrape_ok = None
+    try:
+        # warm the jit paths (decide + lease grant) on the unbounded flow
+        warm_deadline = time.monotonic() + 60.0
+        while time.monotonic() < warm_deadline:
+            r = wire.request_token(WARM_FLOW)
+            if r is not None and r.ok:
+                break
+        else:
+            failures.append("server never served the warm flow")
+        warm_lease = TokenClient("127.0.0.1", server.port, timeout_ms=500,
+                                 lease=True, lease_want=8)
+        try:
+            warm_lease.request_token(WARM_FLOW)
+        finally:
+            warm_lease.close()
+
+        # RTT baseline: the unit every push-cutover gate is denominated in
+        samples = []
+        for _ in range(50):
+            t = time.monotonic()
+            wire.request_token(WARM_FLOW)
+            samples.append((time.monotonic() - t) * 1000.0)
+        samples.sort()
+        rtt_ms = round(samples[len(samples) // 2], 3)
+        cut_bound_ms = max(10.0 * rtt_ms, 25.0)
+
+        # phase 1 — breaker flip: lease first, then flip OPEN by push
+        grant_deadline = time.monotonic() + 5.0
+        while time.monotonic() < grant_deadline:
+            leaser.request_token(DRILL_FLOW)
+            if leaser.lease_stats().get("granted", 0) >= 1:
+                break
+            time.sleep(0.01)
+        if leaser.lease_stats().get("granted", 0) < 1:
+            failures.append("leased client never got a lease to flip")
+        conn_deadline = time.monotonic() + 3.0
+        while (server.push_hub.connections() < 1
+               and time.monotonic() < conn_deadline):
+            time.sleep(0.01)
+        t_flip = time.monotonic()
+        server.push_hub.push_breaker_flip(DRILL_FLOW, 1, flip_retry_ms)
+        stop_ms = None
+        degraded_wait_ms = 0
+        while time.monotonic() < t_flip + 2.0:
+            r = leaser.request_token(DRILL_FLOW)
+            if r is not None and r.status == TokenStatus.DEGRADED:
+                stop_ms = round((time.monotonic() - t_flip) * 1000.0, 3)
+                degraded_wait_ms = r.wait_ms
+                break
+        flip = {"stop_ms": stop_ms, "bound_ms": round(cut_bound_ms, 3),
+                "retry_left_ms": degraded_wait_ms}
+        if stop_ms is None:
+            failures.append(
+                "pushed breaker OPEN never degraded the leased client"
+            )
+        else:
+            if stop_ms > cut_bound_ms:
+                failures.append(
+                    f"breaker cutover took {stop_ms}ms, above the "
+                    f"10xRTT bound of {cut_bound_ms:.1f}ms"
+                )
+            if stop_ms >= 0.5 * lease_ttl_ms:
+                failures.append(
+                    f"breaker cutover {stop_ms}ms is not well inside "
+                    f"half the {lease_ttl_ms:.0f}ms lease TTL"
+                )
+            if degraded_wait_ms <= 0:
+                failures.append(
+                    "pushed-OPEN DEGRADED answer carried no retry clock"
+                )
+        if leaser.push_stats().get("breaker_flip", 0) < 1:
+            failures.append("client never counted the breaker-flip push")
+
+        # a pushed CLOSED must lift the local clock again
+        server.push_hub.push_breaker_flip(DRILL_FLOW, 0, 0)
+        lifted = False
+        lift_deadline = time.monotonic() + 2.0
+        while time.monotonic() < lift_deadline:
+            r = leaser.request_token(DRILL_FLOW)
+            if r is not None and r.status != TokenStatus.DEGRADED:
+                lifted = True
+                break
+            time.sleep(0.005)
+        flip["lifted"] = lifted
+        if not lifted:
+            failures.append("pushed CLOSED never lifted the breaker clock")
+
+        # phase 2 — lease revoke: a fresh leased client (no flip backoff),
+        # slice partially spent, then revoked by push. The drive is paced
+        # at ~1ms (a realistic per-request cadence) so the admits that
+        # land before the apply measure the cutover, not loop speed.
+        leaser2 = TokenClient("127.0.0.1", server.port, timeout_ms=500,
+                              lease=True, lease_want=lease_want)
+        spent = 0
+        for _ in range(5):
+            r = leaser2.request_token(DRILL_FLOW)
+            if r is not None and r.ok:
+                spent += 1
+        if leaser2.lease_stats().get("granted", 0) < 1:
+            failures.append("revoke-phase client never got a lease")
+        remaining_slice = lease_want - spent
+        la0 = leaser2.lease_stats().get("local_admits", 0)
+        t_rev = time.monotonic()
+        server.push_hub.push_lease_revoke(0, DRILL_FLOW)  # 0 = any lease
+        revoke_ms = None
+        while time.monotonic() < t_rev + 2.0:
+            if leaser2.lease_stats().get("revoked", 0) >= 1:
+                revoke_ms = round((time.monotonic() - t_rev) * 1000.0, 3)
+                break
+            leaser2.request_token(DRILL_FLOW)
+            time.sleep(0.001)
+        local_after = leaser2.lease_stats().get("local_admits", 0) - la0
+        revoke = {"stop_ms": revoke_ms, "local_admits_after": local_after,
+                  "ttl_era_bound": remaining_slice}
+        if revoke_ms is None:
+            failures.append("pushed revoke never dropped the cached lease")
+        elif revoke_ms > cut_bound_ms:
+            failures.append(
+                f"revoke cutover took {revoke_ms}ms, above the 10xRTT "
+                f"bound of {cut_bound_ms:.1f}ms"
+            )
+        if local_after >= remaining_slice:
+            failures.append(
+                f"{local_after} local admits landed after the revoke "
+                f"push — not below the TTL-era slice bound of "
+                f"{remaining_slice}"
+            )
+        r = leaser2.request_token(DRILL_FLOW)
+        if r is None or r.status == TokenStatus.FAIL:
+            failures.append(
+                "revoked client did not degrade to wire verdicts"
+            )
+
+        # phase 3 — rule epoch: a live reload reaches connected clients
+        re0 = wire.push_stats().get("rule_epoch_invalidate", 0)
+        svc.load_rules(rules)
+        epoch_deadline = time.monotonic() + 2.0
+        while time.monotonic() < epoch_deadline:
+            if wire.push_stats().get("rule_epoch_invalidate", 0) > re0:
+                rule_epoch_applied = True
+                break
+            time.sleep(0.01)
+        if not rule_epoch_applied:
+            failures.append(
+                "rule reload never reached the client as an epoch push"
+            )
+
+        # phase 4 — observability: the emit→apply staleness histogram and
+        # the frame/revocation counters must be populated
+        snap = server_metrics().snapshot().get("push") or {}
+        staleness = dict(snap.get("stalenessMs") or {})
+        if not staleness.get("count"):
+            failures.append("push staleness histogram is empty")
+        if not snap.get("frames"):
+            failures.append("push frame totals are empty")
+        if snap.get("revocations", 0) < 1:
+            failures.append("push revocation counter never moved")
+        if server.metrics_port:
+            try:
+                body = _scrape(server.metrics_port)
+                scrape_ok = all(
+                    needle in body
+                    for needle in ("sentinel_push_frames_total",
+                                   "sentinel_push_staleness_ms")
+                )
+            except Exception as e:
+                failures.append(f"push metrics scrape failed: {e!r}")
+            if scrape_ok is False:
+                failures.append("push series missing from /metrics")
+
+        # phase 5 — push dark: same events, push=False server. Nothing is
+        # sent, nothing is locally DEGRADED, and the client resyncs on
+        # the TTL-era machinery (renew-ahead / expiry) with local admits
+        # bounded by the outstanding slice.
+        svc2 = DefaultTokenService(cfg, lease_ttl_ms=int(dark_ttl_ms))
+        svc2.load_rules(rules)
+        srv2 = TokenServer(svc2, port=0, metrics_port=0, push=False)
+        srv2.start()
+        wire2 = TokenClient("127.0.0.1", srv2.port, timeout_ms=500)
+        warm_deadline = time.monotonic() + 60.0
+        while time.monotonic() < warm_deadline:
+            r = wire2.request_token(WARM_FLOW)
+            if r is not None and r.ok:
+                break
+        else:
+            failures.append("dark server never served the warm flow")
+        darkc = TokenClient("127.0.0.1", srv2.port, timeout_ms=500,
+                            lease=True, lease_want=dark_want)
+        dark_spent = 0
+        for _ in range(3):
+            r = darkc.request_token(DRILL_FLOW)
+            if r is not None and r.ok:
+                dark_spent += 1
+        if darkc.lease_stats().get("granted", 0) < 1:
+            failures.append("dark-phase client never got a lease")
+        # server-side breaker flip AND lease revoke, both with the push
+        # plane disarmed: the flip emit is a no-op, the sweep reclaims
+        # the charge server-side but nothing tells the client
+        srv2.push_hub.push_breaker_flip(DRILL_FLOW, 1, 60_000)
+        with svc2._lock:
+            for lease in svc2._leases.values():
+                lease.expiry_ms = 0
+            svc2._sweep_leases_locked(now=1)
+        st0 = darkc.lease_stats()
+        base = {k: st0.get(k, 0) for k in ("granted", "renewed", "expired")}
+        la0 = st0.get("local_admits", 0)
+        t_dark = time.monotonic()
+        resync_ms = None
+        degraded_seen = False
+        dark_deadline = t_dark + dark_ttl_ms / 1000.0 + 2.5
+        while time.monotonic() < dark_deadline:
+            st = darkc.lease_stats()
+            # TTL-era resync machinery, whichever fires first: the
+            # renew-ahead (carries the dead lease id, degrades to a fresh
+            # server-accounted grant) or client-side expiry
+            if any(st.get(k, 0) > base[k]
+                   for k in ("granted", "renewed", "expired")):
+                resync_ms = round((time.monotonic() - t_dark) * 1000.0, 1)
+                break
+            r = darkc.request_token(DRILL_FLOW)
+            if r is not None and r.status == TokenStatus.DEGRADED:
+                degraded_seen = True
+            time.sleep(0.002)
+        dark_local = darkc.lease_stats().get("local_admits", 0) - la0
+        hub2 = srv2.push_hub.stats()
+        dark = {
+            "resync_ms": resync_ms,
+            "local_admits_after_revoke": dark_local,
+            "slice_bound": dark_want - dark_spent,
+            "hub_sent": hub2.get("sent"),
+        }
+        if degraded_seen:
+            failures.append(
+                "push-dark client answered DEGRADED with no push applied"
+            )
+        if resync_ms is None:
+            failures.append(
+                "push-dark client never resynced inside the lease TTL"
+            )
+        if dark_local > (dark_want - dark_spent) + 2:
+            failures.append(
+                f"push-dark local admits {dark_local} exceed the "
+                f"outstanding-slice bound {dark_want - dark_spent}"
+            )
+        if hub2.get("enabled") or hub2.get("sent"):
+            failures.append("push=False server still sent push frames")
+        dc = darkc.push_stats()
+        if any(dc.get(k, 0) for k in ("lease_revoke", "breaker_flip",
+                                      "rule_epoch_invalidate")):
+            failures.append("push-dark client counted applied pushes")
+    finally:
+        for c in (wire, leaser, leaser2, wire2, darkc):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        server.stop()
+        if srv2 is not None:
+            srv2.stop()
+    return {
+        "rtt_ms": rtt_ms,
+        "lease_ttl_ms": lease_ttl_ms,
+        "flip": flip,
+        "revoke": revoke,
+        "rule_epoch_applied": rule_epoch_applied,
+        "stalenessMs": staleness,
+        "scrape_ok": scrape_ok,
+        "dark": dark,
+        "failures": failures,
+    }
+
+
+def run_election_drill(
+    budget_qps: float = 200.0,
+    bucket_ms: int = 100,
+    lock_ttl_ms: int = 1200,
+    reconcile_ms: float = 100.0,
+):
+    """Coordinator auto-election drill: the global tier has NO configured
+    single point — no pod is told who hosts the coordinator.
+
+    Two live pods each run a :class:`CoordinatorElection` against a
+    shared shard-map publisher. Agents learn the coordinator endpoint
+    from the map's ``global_flows`` section (never from config), a
+    connected client witnesses the SHARD_MAP_PUSH that broadcasts each
+    election outcome, and the drill then crashes the leader
+    (``hard_stop`` — lock NOT released, the SIGKILL shape) and gates:
+
+    - exactly one winner per election round, arbitrated by the epoch
+      fence alone;
+    - admissions during the leaderless window stay within Σ outstanding
+      shares + one reconcile interval's slack (≤ the global budget);
+    - the survivor claims after the lock TTL lapses and the new ledger
+      re-covers both pods within ≤ 3 reconcile ticks of the win;
+    - the new leader's map and push name its endpoint, and the agents'
+      renews (unknown share ids to the empty ledger) degrade to grants
+      with no handshake.
+    """
+    from sentinel_tpu.cluster.client import TokenClient
+    from sentinel_tpu.cluster.hierarchy import (
+        COORD_LOCK_KEY,
+        CoordinatorElection,
+        GlobalFlowBudget,
+        PodShareAgent,
+        decode_coord_lock,
+    )
+    from sentinel_tpu.cluster.rebalance import (
+        ShardMapPublisher,
+        decode_shard_map_doc,
+    )
+    from sentinel_tpu.cluster.server import TokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.engine import ClusterFlowRule, EngineConfig
+    from sentinel_tpu.engine.rules import ThresholdMode
+
+    failures = []
+    window_s = bucket_ms * 10 / 1000.0
+    budget_tokens = int(budget_qps * window_s)
+    slack_tokens = max(2, int(budget_tokens * reconcile_ms / (window_s * 1e3)))
+    cfg = EngineConfig(
+        max_flows=64, max_namespaces=4, batch_size=64, bucket_ms=bucket_ms
+    )
+    svcA = DefaultTokenService(cfg)
+    svcB = DefaultTokenService(cfg)
+    for svc in (svcA, svcB):
+        svc.load_rules(
+            [ClusterFlowRule(DRILL_FLOW, budget_qps, ThresholdMode.GLOBAL),
+             ClusterFlowRule(WARM_FLOW, 1e9, ThresholdMode.GLOBAL)]
+        )
+    srvA = TokenServer(svcA, port=0, metrics_port=0)
+    srvB = TokenServer(svcB, port=0, metrics_port=0)
+    srvA.start()
+    srvB.start()
+    epA = f"127.0.0.1:{srvA.port}"
+    epB = f"127.0.0.1:{srvB.port}"
+    pub = ShardMapPublisher()
+    budgets = [GlobalFlowBudget(DRILL_FLOW, budget_qps, window_s)]
+    hubs = (srvA.push_hub, srvB.push_hub)
+    eA = CoordinatorElection(
+        svcA, pub, "pod-a", epA, budgets, lock_ttl_ms=lock_ttl_ms,
+        share_ttl_ms=30_000, reconcile_ms=reconcile_ms, push_hubs=hubs,
+    )
+    eB = CoordinatorElection(
+        svcB, pub, "pod-b", epB, budgets, lock_ttl_ms=lock_ttl_ms,
+        share_ttl_ms=30_000, reconcile_ms=reconcile_ms, push_hubs=hubs,
+    )
+    clA = TokenClient("127.0.0.1", srvA.port, timeout_ms=500)
+    clB = TokenClient("127.0.0.1", srvB.port, timeout_ms=500)
+    witness = TokenClient("127.0.0.1", srvB.port, timeout_ms=500)
+    seen_maps = []
+
+    def _witness_learn(blob):
+        try:
+            m = decode_shard_map_doc(blob)
+        except ValueError:
+            return
+        seen_maps.append((int(m.epoch), dict(m.global_flows)))
+
+    witness.on_shard_map = _witness_learn
+    agA = agB = None
+    subs = []
+    election = {}
+    dark = {}
+    failover = {}
+    push_named_leader = push_named_survivor = False
+
+    def _burst(cl, n, fid=DRILL_FLOW):
+        ok = 0
+        for _ in range(n):
+            r = cl.request_token(fid)
+            if r is not None and r.ok:
+                ok += 1
+        return ok
+
+    try:
+        # warm every decide kernel BEFORE any hold is pinned: the first
+        # decide per service pays its jit trace, which would otherwise
+        # age a fresh hold out of the window mid-measurement
+        warm_deadline = time.monotonic() + 60.0
+        while time.monotonic() < warm_deadline:
+            if (_burst(clA, 1, WARM_FLOW) and _burst(clB, 1, WARM_FLOW)
+                    and _burst(witness, 1, WARM_FLOW)):
+                break
+        else:
+            failures.append("pods never served the warm flow")
+
+        # phase 1 — first election: exactly one winner, map names it
+        ledA = eA.tick()
+        ledB = eB.tick()
+        if int(ledA) + int(ledB) != 1:
+            failures.append(
+                f"expected exactly one election winner, got "
+                f"{int(ledA) + int(ledB)}"
+            )
+        leader, standby = (eA, eB) if ledA else (eB, eA)
+        m = pub.current()
+        learned_ep = (m.global_flows or {}).get(str(DRILL_FLOW))
+        election = {"winner": leader.pod_id, "epoch": int(m.epoch),
+                    "learned_endpoint": learned_ep}
+        if learned_ep != leader.endpoint:
+            failures.append(
+                f"map points {learned_ep!r} at the flow, leader is "
+                f"{leader.endpoint!r}"
+            )
+        if decode_coord_lock(
+            (m.global_flows or {}).get(COORD_LOCK_KEY)
+        ) is None:
+            failures.append("no live coordinator lock in the map")
+        push_deadline = time.monotonic() + 2.0
+        while time.monotonic() < push_deadline:
+            if any(gf.get(str(DRILL_FLOW)) == leader.endpoint
+                   for _, gf in seen_maps):
+                push_named_leader = True
+                break
+            time.sleep(0.01)
+        if not push_named_leader:
+            failures.append(
+                "election outcome never reached the witness by push"
+            )
+
+        # phase 2 — agents bootstrap from the LEARNED endpoint (nothing
+        # is configured) and follow future maps through the publisher
+        agA = PodShareAgent(svcA, [learned_ep], "pod-a", [DRILL_FLOW],
+                            tick_ms=100)
+        agB = PodShareAgent(svcB, [learned_ep], "pod-b", [DRILL_FLOW],
+                            tick_ms=100)
+        for ag in (agA, agB):
+            subs.append(pub.listen(
+                lambda mp, ag=ag: (
+                    ag.apply_shard_map(mp) if mp is not None else None
+                )
+            ))
+        for _ in range(2):
+            agA.tick()
+            agB.tick()
+            if leader.coordinator is not None:
+                leader.coordinator.reconcile_once()
+            eA.tick()
+            eB.tick()
+        sA0 = agA.shares().get(DRILL_FLOW, 0)
+        sB0 = agB.shares().get(DRILL_FLOW, 0)
+        election["share_a"] = sA0
+        election["share_b"] = sB0
+        if sA0 + sB0 > budget_tokens:
+            failures.append(
+                f"bootstrap shares {sA0}+{sB0} exceed the budget "
+                f"{budget_tokens}"
+            )
+        if not (sA0 and sB0):
+            failures.append(f"bootstrap split {sA0}/{sB0} left a pod dry")
+
+        # phase 3 — SIGKILL shape: the leader vanishes without releasing
+        # the lock; its pod stops hosting the coordinator function
+        t_kill = time.monotonic()
+        leader.hard_stop()
+        leader.service.hierarchy = None
+
+        # leaderless drive, strictly inside one window: admissions stay
+        # within Σ outstanding shares + one reconcile interval's slack
+        drive_s = window_s - 2.5 * bucket_ms / 1e3
+        admits_dark = 0
+        t0 = time.monotonic()
+        last = t0
+        while time.monotonic() - t0 < drive_s:
+            admits_dark += _burst(clA, 20) + _burst(clB, 20)
+            if time.monotonic() - last >= reconcile_ms / 1e3:
+                agA.tick()
+                agB.tick()
+                last = time.monotonic()
+        over_dark = max(0, admits_dark - (sA0 + sB0))
+        dark = {"admits": admits_dark, "share_sum": sA0 + sB0,
+                "over_admission": over_dark, "slack_tokens": slack_tokens}
+        if over_dark > slack_tokens:
+            failures.append(
+                f"leaderless over-admission {over_dark} exceeds the "
+                f"outstanding-share bound {sA0 + sB0} + {slack_tokens}"
+            )
+
+        # phase 4 — the survivor waits out the lock TTL and claims
+        won_ms = None
+        wait_deadline = t_kill + lock_ttl_ms / 1e3 + 3.0
+        while time.monotonic() < wait_deadline:
+            if standby.tick():
+                won_ms = round((time.monotonic() - t_kill) * 1000.0, 1)
+                break
+            agA.tick()
+            agB.tick()
+            time.sleep(0.05)
+        if won_ms is None:
+            failures.append(
+                "survivor never won the election after the crash"
+            )
+
+        # convergence: ≤ 3 reconcile ticks from the win to a ledger that
+        # re-covers both pods (renews with unknown share ids degrade to
+        # plain grants — no handshake)
+        conv_rounds = 0
+        converged = False
+        newc = standby.coordinator
+        while newc is not None and conv_rounds < 6:
+            agA.tick()
+            agB.tick()
+            newc.reconcile_once()
+            standby.tick()
+            conv_rounds += 1
+            if newc.stats().get("outstanding_shares", 0) >= 2:
+                converged = True
+                break
+        sA1 = agA.shares().get(DRILL_FLOW, 0)
+        sB1 = agB.shares().get(DRILL_FLOW, 0)
+        m2 = pub.current()
+        failover = {
+            "won_ms": won_ms, "rounds_to_converge": conv_rounds,
+            "share_a": sA1, "share_b": sB1,
+            "learned_endpoint": (m2.global_flows or {}).get(
+                str(DRILL_FLOW)
+            ),
+            "survivor": standby.pod_id,
+        }
+        if not converged:
+            failures.append(
+                "new coordinator never re-covered both pods "
+                f"({conv_rounds} rounds)"
+            )
+        elif conv_rounds > 3:
+            failures.append(
+                f"auto-election convergence took {conv_rounds} reconcile "
+                "ticks (contract: <= 3)"
+            )
+        if sA1 + sB1 > budget_tokens:
+            failures.append(
+                f"post-failover shares {sA1}+{sB1} exceed the budget"
+            )
+        if not (sA1 and sB1):
+            failures.append("a pod holds no share after the failover")
+        if failover["learned_endpoint"] != standby.endpoint:
+            failures.append(
+                "the map does not name the survivor as coordinator"
+            )
+        push_deadline = time.monotonic() + 2.0
+        while time.monotonic() < push_deadline:
+            if any(gf.get(str(DRILL_FLOW)) == standby.endpoint
+                   for _, gf in seen_maps):
+                push_named_survivor = True
+                break
+            time.sleep(0.01)
+        if not push_named_survivor:
+            failures.append(
+                "failover outcome never reached the witness by push"
+            )
+        if standby.stats().get("elections_won", 0) != 1:
+            failures.append("survivor won more than one election")
+    finally:
+        for c in (clA, clB, witness):
+            try:
+                c.close()
+            except Exception:
+                pass
+        for ag in (agA, agB):
+            if ag is not None:
+                try:
+                    ag.close()
+                except Exception:
+                    pass
+        for e in (eA, eB):
+            try:
+                e.stop(release=False)
+            except Exception:
+                pass
+        srvA.stop()
+        srvB.stop()
+    return {
+        "budget_tokens": budget_tokens,
+        "lock_ttl_ms": lock_ttl_ms,
+        "configured_coordinator_endpoints": [],
+        "election": election,
+        "dark": dark,
+        "failover": failover,
+        "push_named_leader": push_named_leader,
+        "push_named_survivor": push_named_survivor,
+        "maps_witnessed": len(seen_maps),
+        "failures": failures,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true",
@@ -1543,6 +2192,13 @@ def main() -> None:
                          "hier-smoke job's fast path)")
     ap.add_argument("--hier-seed", type=int, default=7,
                     help="chaos seed for the hier drill's conn_reset cut")
+    ap.add_argument("--skip-push", action="store_true",
+                    help="skip the rev-7 push-plane drill")
+    ap.add_argument("--only-push", action="store_true",
+                    help="run ONLY the push-plane + auto-election drills "
+                         "(the CI push-smoke job's fast path)")
+    ap.add_argument("--skip-election", action="store_true",
+                    help="skip the coordinator auto-election drill")
     # child-role flags (used with --serve)
     ap.add_argument("--standby-of", default=None)
     ap.add_argument("--promote-after-ms", type=float, default=None)
@@ -1575,6 +2231,31 @@ def main() -> None:
             f"{lease['outstanding_tokens_at_kill']} "
             f"({lease['local_admits']} client-local admits survived the "
             f"kill, standby blocked {lease['standby_blocks']}x)"
+        )
+        return
+    if args.only_push:
+        doc = {"push": run_push_drill(),
+               "election": run_election_drill()}
+        doc["failures"] = (
+            doc["push"]["failures"] + doc["election"]["failures"]
+        )
+        doc["wall_s"] = round(time.time() - t0, 1)
+        print(json.dumps(doc, indent=2))
+        if doc["failures"]:
+            print(f"PUSH DRILL FAILED: {doc['failures']}", file=sys.stderr)
+            sys.exit(1)
+        push = doc["push"]
+        elec = doc["election"]
+        print(
+            f"push drill ok: breaker cutover {push['flip']['stop_ms']}ms "
+            f"and revoke cutover {push['revoke']['stop_ms']}ms against a "
+            f"{push['flip']['bound_ms']}ms 10xRTT bound "
+            f"(RTT {push['rtt_ms']}ms, lease TTL "
+            f"{push['lease_ttl_ms']:.0f}ms); dark resync "
+            f"{push['dark']['resync_ms']}ms; election failover converged "
+            f"in {elec['failover']['rounds_to_converge']} tick(s) "
+            f"({elec['failover']['won_ms']}ms past the kill), leaderless "
+            f"over-admission {elec['dark']['over_admission']}"
         )
         return
     if args.only_hier:
@@ -1612,6 +2293,12 @@ def main() -> None:
     if not args.skip_hier:
         doc["hier"] = run_hier_drill(chaos_seed=args.hier_seed)
         doc["failures"] = doc["failures"] + doc["hier"]["failures"]
+    if not args.skip_push:
+        doc["push"] = run_push_drill()
+        doc["failures"] = doc["failures"] + doc["push"]["failures"]
+    if not args.skip_election:
+        doc["election"] = run_election_drill()
+        doc["failures"] = doc["failures"] + doc["election"]["failures"]
     if not args.skip_overload:
         doc["overload"] = run_overload_drill()
         doc["failures"] = doc["failures"] + doc["overload"]["failures"]
@@ -1669,6 +2356,24 @@ def main() -> None:
             f"{hier['live']['over_admission']} of "
             f"{hier['budget_tokens']} (slack {hier['slack_tokens']}), "
             f"dark over-admission {hier['dark']['over_admission']}"
+        )
+    if "push" in doc:
+        push = doc["push"]
+        print(
+            f"push drill ok: breaker cutover {push['flip']['stop_ms']}ms "
+            f"and revoke cutover {push['revoke']['stop_ms']}ms against a "
+            f"{push['flip']['bound_ms']}ms 10xRTT bound "
+            f"(RTT {push['rtt_ms']}ms), dark resync "
+            f"{push['dark']['resync_ms']}ms"
+        )
+    if "election" in doc:
+        elec = doc["election"]
+        print(
+            f"election drill ok: {elec['failover']['survivor']} converged "
+            f"in {elec['failover']['rounds_to_converge']} tick(s) "
+            f"({elec['failover']['won_ms']}ms past the kill), leaderless "
+            f"over-admission {elec['dark']['over_admission']} of "
+            f"{elec['dark']['share_sum']} outstanding"
         )
     if "overload" in doc:
         ovl = doc["overload"]

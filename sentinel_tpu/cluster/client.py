@@ -45,10 +45,14 @@ RECONNECT_JITTER = 0.2
 # process-wide client receive accounting (all TokenClient readers): bytes
 # received off token-server sockets and growable-buffer expansions — the
 # exporter renders these as sentinel_client_recv_bytes_total /
-# sentinel_client_recv_buf_grows_total
+# sentinel_client_recv_buf_grows_total. unknown_frames counts frames whose
+# type byte this build doesn't speak (a newer server's rev): rev-7 readers
+# SKIP those instead of dropping the connection, and the count is the
+# rollout canary (sentinel_client_unknown_frames_total).
 _recv_lock = threading.Lock()
 _recv_bytes = 0
 _recv_buf_grows = 0
+_unknown_frames = 0
 
 
 def _count_recv(n: int, grows: int = 0) -> None:
@@ -56,6 +60,12 @@ def _count_recv(n: int, grows: int = 0) -> None:
     with _recv_lock:
         _recv_bytes += n
         _recv_buf_grows += grows
+
+
+def _count_unknown_frame(n: int = 1) -> None:
+    global _unknown_frames
+    with _recv_lock:
+        _unknown_frames += n
 
 
 def client_recv_bytes_total() -> int:
@@ -68,11 +78,17 @@ def client_recv_buf_grows_total() -> int:
         return _recv_buf_grows
 
 
+def client_unknown_frames_total() -> int:
+    with _recv_lock:
+        return _unknown_frames
+
+
 def reset_client_metrics_for_tests() -> None:
-    global _recv_bytes, _recv_buf_grows
+    global _recv_bytes, _recv_buf_grows, _unknown_frames
     with _recv_lock:
         _recv_bytes = 0
         _recv_buf_grows = 0
+        _unknown_frames = 0
 
 
 class _Pending:
@@ -185,6 +201,23 @@ class TokenClient(TokenService):
         # OK needs no second RPC. Off by default: most callers want the
         # hint, not the blocking.
         self.wait_and_admit = bool(wait_and_admit)
+        # wire rev 7 push state (all under _lease_lock): a pushed breaker
+        # OPEN parks the flow behind a local DEGRADED clock — admits answer
+        # DEGRADED with the pushed retry-after until it expires, so a
+        # leased fast path stops within one RTT of the server-side flip
+        # instead of at lease TTL. _push_counts tracks applies by kind;
+        # _rule_epoch fences RULE_EPOCH_INVALIDATE replays.
+        self._breaker_until: Dict[int, float] = {}  # flow → mono deadline
+        self._rule_epoch = 0
+        self._push_counts = {
+            "lease_revoke": 0, "breaker_flip": 0, "rule_epoch_invalidate": 0,
+            "shard_map_push": 0, "brownout_advisory": 0, "malformed": 0,
+        }
+        # out-of-band push listeners: routing subscribes shard-map docs,
+        # failover subscribes brownout advisories. Callbacks run on the
+        # reader thread — keep them cheap and never let them raise.
+        self.on_shard_map: Optional[callable] = None
+        self.on_brownout: Optional[callable] = None
 
     @property
     def consecutive_failures(self) -> int:
@@ -326,6 +359,18 @@ class TokenClient(TokenService):
                     payload = view[r + 2 : r + 2 + ln]
                     r += 2 + ln
                     mtype = P.peek_type(payload)
+                    if mtype in P.PUSH_TYPES:
+                        # rev-7 push: dispatched out-of-band, never resolves
+                        # a pending xid. A malformed push is skipped and
+                        # counted — it can't strand a waiter, so it never
+                        # justifies killing the connection.
+                        self._handle_push(bytes(payload))
+                        continue
+                    if mtype not in P.KNOWN_TYPES:
+                        # a newer server's frame type: skip + count instead
+                        # of dropping the connection (mixed-rev fleets)
+                        _count_unknown_frame()
+                        continue
                     if mtype in P.LEASE_TYPES or mtype in P.HIER_TYPES:
                         rsp = P.decode_lease_response(bytes(payload))
                         pending = self._pending.get(rsp.xid)
@@ -364,8 +409,126 @@ class TokenClient(TokenService):
         finally:
             self._drop_connection(sock)
 
+    # -- wire rev 7: push dispatch ------------------------------------------
+    def _handle_push(self, payload: bytes) -> None:
+        """Apply one server push out-of-band (reader thread). Malformed
+        pushes are counted and skipped — a push gates no pending request,
+        so it never justifies dropping the connection."""
+        try:
+            push = P.decode_push(payload)
+        except (ValueError, struct.error):
+            with self._lease_lock:
+                self._push_counts["malformed"] += 1
+            return
+        now = time.monotonic()
+        if push.msg_type == P.MsgType.LEASE_REVOKE:
+            with self._lease_lock:
+                self._push_counts["lease_revoke"] += 1
+                lease = self._leases.get(push.flow_id)
+                if lease is not None and (
+                    push.lease_id == 0 or lease.lease_id == push.lease_id
+                ):
+                    # stop local admits NOW (the server already reclaimed
+                    # the unused slice — charge-at-grant) and hold off the
+                    # regrant one backoff so a reload settles first
+                    del self._leases[push.flow_id]
+                    self._lease_counts["revoked"] = (
+                        self._lease_counts.get("revoked", 0) + 1
+                    )
+                    self._lease_backoff[push.flow_id] = (
+                        now + self._lease_backoff_s
+                    )
+        elif push.msg_type == P.MsgType.BREAKER_FLIP:
+            with self._lease_lock:
+                self._push_counts["breaker_flip"] += 1
+                if push.state == 1:  # OPEN (DEGRADE.md state code)
+                    # an OPEN without a pushed clock still parks the flow a
+                    # bounded moment; the server's wire-path DEGRADED
+                    # answers carry the authoritative retry-after
+                    retry_ms = push.retry_after_ms if push.retry_after_ms > 0 else 1000
+                    self._breaker_until[push.flow_id] = now + retry_ms / 1000.0
+                    lease = self._leases.pop(push.flow_id, None)
+                    if lease is not None:
+                        self._lease_counts["revoked"] = (
+                            self._lease_counts.get("revoked", 0) + 1
+                        )
+                    backoff = now + retry_ms / 1000.0
+                    if backoff > self._lease_backoff.get(push.flow_id, 0.0):
+                        self._lease_backoff[push.flow_id] = backoff
+                else:
+                    # CLOSED or HALF_OPEN: lift the local clock so requests
+                    # reach the server again (HALF_OPEN needs wire traffic
+                    # for its probe election)
+                    self._breaker_until.pop(push.flow_id, None)
+        elif push.msg_type == P.MsgType.RULE_EPOCH_INVALIDATE:
+            with self._lease_lock:
+                self._push_counts["rule_epoch_invalidate"] += 1
+                if push.epoch > self._rule_epoch:
+                    # every cached lease predates the new rule state:
+                    # drop them (and stale backoffs) and re-fetch fresh
+                    self._rule_epoch = push.epoch
+                    self._leases.clear()
+                    self._lease_backoff.clear()
+        elif push.msg_type == P.MsgType.SHARD_MAP_PUSH:
+            with self._lease_lock:
+                self._push_counts["shard_map_push"] += 1
+            cb = self.on_shard_map
+            if cb is not None:
+                try:
+                    cb(push.doc)
+                except Exception:
+                    pass  # a listener bug must not kill the reader
+        elif push.msg_type == P.MsgType.BROWNOUT_ADVISORY:
+            with self._lease_lock:
+                self._push_counts["brownout_advisory"] += 1
+            cb = self.on_brownout
+            if cb is not None:
+                try:
+                    cb(push.level, push.retry_after_ms)
+                except Exception:
+                    pass
+        if push.stamp_ms > 0:
+            # server-emit → client-apply staleness, off the frame's wall
+            # stamp (clock skew makes cross-host samples advisory; the
+            # drill's gates run co-located where the stamp is exact)
+            try:
+                from sentinel_tpu.metrics.server import server_metrics
+
+                server_metrics().record_push_staleness(
+                    time.time() * 1000.0 - push.stamp_ms
+                )
+            except Exception:
+                pass
+
+    def _breaker_refusal(self, flow_id: int) -> Optional[TokenResult]:
+        """A pushed breaker-OPEN clock still running answers DEGRADED
+        locally (remaining carries the retry-after left, the wire
+        convention) — the leased fast path stops admitting within one RTT
+        of the server-side flip instead of at lease TTL."""
+        with self._lease_lock:
+            deadline = self._breaker_until.get(flow_id)
+            if deadline is None:
+                return None
+            left_ms = int((deadline - time.monotonic()) * 1000.0)
+            if left_ms <= 0:
+                del self._breaker_until[flow_id]
+                return None
+        return TokenResult(TokenStatus.DEGRADED, left_ms, left_ms)
+
+    def push_stats(self) -> Dict[str, int]:
+        """Client-side push-apply counters (drill + test surface)."""
+        with self._lease_lock:
+            out = dict(self._push_counts)
+            out["breaker_clocks"] = len(self._breaker_until)
+            out["rule_epoch"] = self._rule_epoch
+            return out
+
     # -- TokenService -------------------------------------------------------
     def request_token(self, flow_id, acquire=1, prioritized=False) -> TokenResult:
+        if self._breaker_until:
+            refusal = self._breaker_refusal(int(flow_id))
+            if refusal is not None:
+                return refusal
         if self.lease_enabled:
             local = self._lease_admit(int(flow_id), int(acquire))
             if local is not None:

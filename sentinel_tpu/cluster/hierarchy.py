@@ -681,3 +681,243 @@ class PodShareAgent:
         out: Dict[str, object] = dict(self._stats)
         out["share_tokens"] = self.shares()
         return out
+
+
+# -- coordinator auto-election (rev-7: no configured single point) -----------
+# The leader lock lives in the shard map's ``global_flows`` section under a
+# key no flow id can collide with (flow keys are ``str(int(...))``;
+# ``coordinator_of`` lookups therefore never read it). The lock value names
+# the holder, its endpoint, and a wall-clock deadline — a lease, renewed by
+# the leader and claimable by anyone after expiry. Claims are arbitrated by
+# the SAME epoch fence MOVE uses: every claim is a next-epoch map through
+# ``ShardMapPublisher.publish``, which admits exactly one map per epoch, so
+# two pods racing for an expired lock can't both win — the loser's publish
+# returns False and it stays a follower.
+COORD_LOCK_KEY = "coordinator_lock"
+
+
+def encode_coord_lock(pod_id: str, endpoint: str, deadline_ms: int) -> str:
+    return f"{pod_id}|{endpoint}|{int(deadline_ms)}"
+
+
+def decode_coord_lock(text) -> Optional[Tuple[str, str, int]]:
+    """``(pod_id, endpoint, deadline_ms)`` or None for absent/malformed."""
+    try:
+        pod_id, endpoint, deadline = str(text).split("|")
+        return pod_id, endpoint, int(deadline)
+    except (ValueError, AttributeError):
+        return None
+
+
+class CoordinatorElection:
+    """Auto-elects which pod hosts the :class:`GlobalBudgetCoordinator`.
+
+    One instance per pod, ticking against a shared
+    :class:`~sentinel_tpu.cluster.rebalance.ShardMapPublisher`. The winner
+    constructs and attaches a coordinator (``service.attach_hierarchy``),
+    publishes a map whose ``global_flows`` points every budgeted flow at
+    its own endpoint, and broadcasts that map as a ``SHARD_MAP_PUSH`` on
+    every attached hub so agents and routing clients cut over within one
+    RTT instead of a poll interval. A deposed or expired leader detaches.
+
+    Failover needs no handshake: a freshly-elected coordinator starts with
+    an empty ledger, agents' renews carry unknown share ids and degrade to
+    plain grants (:meth:`GlobalBudgetCoordinator.share_renew`), and until
+    then each pod admits at its last-granted share — the same
+    Σ-outstanding-shares bound that holds while a coordinator is dark.
+    """
+
+    def __init__(
+        self,
+        service,
+        publisher,
+        pod_id: str,
+        endpoint: str,
+        budgets,
+        lock_ttl_ms: int = 3000,
+        tick_ms: int = 500,
+        share_ttl_ms: int = 5000,
+        reconcile_ms: int = 100,
+        coordinator_factory=None,
+        push_hubs=(),
+    ):
+        self.service = service
+        self.publisher = publisher
+        self.pod_id = str(pod_id)
+        self.endpoint = str(endpoint)
+        self.budgets = list(budgets)
+        self.lock_ttl_ms = max(1, int(lock_ttl_ms))
+        self.tick_ms = max(1, int(tick_ms))
+        self._factory = coordinator_factory or (
+            lambda: GlobalBudgetCoordinator(
+                self.budgets, share_ttl_ms=share_ttl_ms,
+                reconcile_ms=reconcile_ms,
+            )
+        )
+        self.push_hubs = list(push_hubs)
+        self.coordinator: Optional[GlobalBudgetCoordinator] = None
+        self.is_leader = False
+        self._lock = threading.Lock()
+        self._stats = {
+            "elections_won": 0, "lock_renewals": 0, "depositions": 0,
+            "claim_lost": 0,
+        }
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lock plumbing -------------------------------------------------------
+    def _current_lock(self, shard_map, now: int):
+        """The LIVE lock holder tuple, or None (absent/expired/torn)."""
+        lock = decode_coord_lock(
+            (shard_map.global_flows or {}).get(COORD_LOCK_KEY)
+        )
+        if lock is None or now >= lock[2]:
+            return None
+        return lock
+
+    def _publish_claim(self, shard_map, now: int) -> bool:
+        """Next-epoch map: our lock + every budgeted flow pointed at our
+        endpoint. The publisher's epoch fence arbitrates racing claims."""
+        g = dict(shard_map.global_flows or {})
+        g[COORD_LOCK_KEY] = encode_coord_lock(
+            self.pod_id, self.endpoint, now + self.lock_ttl_ms
+        )
+        for b in self.budgets:
+            g[str(int(b.flow_id))] = self.endpoint
+        nxt = type(shard_map)(
+            int(shard_map.epoch) + 1, dict(shard_map.endpoint_of), g
+        )
+        return bool(self.publisher.publish(nxt))
+
+    def _push_map(self) -> None:
+        """Broadcast the published map on every hub (SHARD_MAP_PUSH) so
+        live clients learn the election outcome within one RTT. Best
+        effort — the publisher's listener plane is the polling fallback."""
+        if not self.push_hubs:
+            return
+        from sentinel_tpu.cluster.rebalance import encode_shard_map_doc
+
+        try:
+            doc = encode_shard_map_doc(self.publisher.current())
+        except Exception:  # pragma: no cover - doc encode must not kill tick
+            return
+        for hub in self.push_hubs:
+            try:
+                hub.push_shard_map(doc)
+            except Exception:
+                pass
+
+    # -- leadership transitions ---------------------------------------------
+    def _ensure_leader(self) -> None:
+        with self._lock:
+            if self.is_leader:
+                return
+            self.coordinator = self._factory()
+            self.is_leader = True
+            self._stats["elections_won"] += 1
+        attach = getattr(self.service, "attach_hierarchy", None)
+        if attach is not None:
+            attach(self.coordinator)
+        log.info("pod %s won coordinator election (%s)",
+                 self.pod_id, self.endpoint)
+        self._push_map()
+
+    def _ensure_follower(self) -> None:
+        with self._lock:
+            if not self.is_leader:
+                return
+            coord, self.coordinator = self.coordinator, None
+            self.is_leader = False
+            self._stats["depositions"] += 1
+        if getattr(self.service, "hierarchy", None) is coord:
+            self.service.hierarchy = None
+        if coord is not None:
+            coord.stop()
+        log.info("pod %s deposed as coordinator", self.pod_id)
+
+    # -- the tick ------------------------------------------------------------
+    def tick(self) -> bool:
+        """One election pass; returns True while this pod leads. A live
+        foreign lock → follow. Our lock → renew when less than half the
+        TTL remains (each renewal is a next-epoch publish). Absent or
+        expired lock → claim; the epoch fence picks exactly one winner."""
+        now = _clock.now_ms()
+        shard_map = self.publisher.current()
+        lock = self._current_lock(shard_map, now)
+        if lock is not None and lock[0] != self.pod_id:
+            self._ensure_follower()
+            return False
+        if lock is not None:
+            # ours and live: renew before it can lapse mid-tick-period
+            if lock[2] - now < self.lock_ttl_ms / 2:
+                if self._publish_claim(shard_map, now):
+                    self._stats["lock_renewals"] += 1
+            self._ensure_leader()
+            return True
+        if self._publish_claim(shard_map, now):
+            self._ensure_leader()
+            return True
+        # lost the race to a concurrent claimant; learn the winner next tick
+        self._stats["claim_lost"] += 1
+        self._ensure_follower()
+        return False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "CoordinatorElection":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.tick_ms / 1000.0):
+                try:
+                    self.tick()
+                except Exception:  # pragma: no cover - loop must survive
+                    log.exception("coordinator election tick failed")
+
+        self._thread = threading.Thread(
+            target=_run, name="hier-election", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True) -> None:
+        """Graceful exit: stop ticking, step down, and (by default) publish
+        a lock release so the next claimant needn't wait out the TTL."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+        was_leader = self.is_leader
+        self._ensure_follower()
+        if release and was_leader:
+            shard_map = self.publisher.current()
+            lock = decode_coord_lock(
+                (shard_map.global_flows or {}).get(COORD_LOCK_KEY)
+            )
+            if lock is not None and lock[0] == self.pod_id:
+                g = dict(shard_map.global_flows)
+                g.pop(COORD_LOCK_KEY, None)
+                self.publisher.publish(type(shard_map)(
+                    int(shard_map.epoch) + 1,
+                    dict(shard_map.endpoint_of), g,
+                ))
+                self._push_map()
+
+    def hard_stop(self) -> None:
+        """Drill stand-in for SIGKILL: the pod vanishes WITHOUT releasing
+        the lock or detaching anything cleanly — survivors must wait out
+        the lock TTL, exactly like a real crash."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+        coord = self.coordinator
+        if coord is not None:
+            coord.stop()
+
+    def stats(self) -> Dict[str, object]:
+        out: Dict[str, object] = dict(self._stats)
+        out["is_leader"] = self.is_leader
+        out["pod_id"] = self.pod_id
+        return out

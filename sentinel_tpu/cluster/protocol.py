@@ -131,6 +131,51 @@ predates rev 6 simply drops the unknown type byte. RT values are validated
 server-side at this wire boundary (negative / oversized values are counted
 into ``sentinel_outcome_dropped_total`` rather than scattered) — see
 ``OUTCOME_MAX_RT_MS`` below.
+
+Codec rev 7 — PUSH frames (the server→client push control plane):
+unsolicited server→client frames carried on the SAME connections the data
+plane already holds (TCP streams and the shm ring's response lane). They
+are the inverse of every frame above — the server originates them, the
+client never answers — and they cut worst-case control staleness from
+TTL/tick scale to one RTT. All five share one envelope::
+
+    | xid: int32 | type: uint8 | stamp_ms: int64 | data... |
+
+``xid`` is a server-assigned push sequence (clients treat it as opaque;
+the staleness probe stamps known xids), ``stamp_ms`` is the server's wall
+clock at emit time — the client-side apply records
+``now_ms - stamp_ms`` into the ``sentinel_push_staleness_ms`` histogram.
+
+- ``LEASE_REVOKE``: ``lease_id:int64, flow_id:int64, tokens:int32`` —
+  the server recalled this lease (rule reload, MOVE drain, breaker flip
+  on the leased flow). The client credits nothing back to the server
+  (charge-at-grant means the server already reclaimed the unused slice);
+  it drops the ``_FlowLease`` immediately so local admits stop now
+  instead of at TTL expiry.
+- ``BREAKER_FLIP``: ``flow_id:int64, state:int8, retry_after_ms:int32``
+  — a device-resident breaker transition (CLOSED/OPEN/HALF_OPEN, the
+  DEGRADE.md state codes). OPEN makes the client answer DEGRADED locally
+  (with the pushed retry-after) until the clock expires; CLOSED clears
+  the local clock.
+- ``RULE_EPOCH_INVALIDATE``: ``epoch:int64`` — the server's rule state
+  generation bumped (``load_rules``); every cached lease and lease
+  backoff for that server is stale. Clients drop them and re-fetch.
+- ``SHARD_MAP_PUSH``: a zlib-compressed ShardMap JSON doc (``to_doc``);
+  the doc carries its own epoch and feeds the client's epoch-fenced
+  ``apply_shard_map`` learn path — a stale push is a no-op by the same
+  fence that already guards the polling path.
+- ``BROWNOUT_ADVISORY``: ``level:int8, retry_ms:int32`` — the admission
+  ladder escalated (SHED_LOW/DEGRADE). Failover clients treat it as an
+  early walk hint instead of waiting to be refused.
+
+Delivery is at-most-once and fire-and-forget: a push rides the reply lane
+behind verdict writes (never blocking one), a full queue or dead
+connection silently drops it, and EVERY pushed fact is re-derivable from
+the polling path (lease TTL, breaker refusal, shard-map publish, OVERLOAD
+answer) — push tightens the staleness bound, it never replaces the
+fallback. Old clients skip unknown type bytes (the rev-7 reader contract;
+pre-rev-7 readers dropped the connection, which is why the mixed-rev
+fix ships in the same rev).
 """
 
 from __future__ import annotations
@@ -145,8 +190,9 @@ import numpy as np
 from sentinel_tpu import chaos as _chaos
 
 # codec revision this build speaks: 2 deadline trailer, 3 REPL, 4 MOVE,
-# 5 LEASE + HIER share ops, 6 OUTCOME_REPORT (the doc revisions above)
-WIRE_REV = 6
+# 5 LEASE + HIER share ops, 6 OUTCOME_REPORT, 7 PUSH control plane (the
+# doc revisions above)
+WIRE_REV = 7
 
 # 2-byte big-endian length prefix caps a frame at 65535 bytes; single-request
 # messages keep the reference's 1024-byte budget, BATCH_FLOW frames use the
@@ -220,6 +266,15 @@ class MsgType(enum.IntEnum):
     SHARE_RETURN = 20
     # codec rev 6: batched fire-and-forget completion telemetry
     OUTCOME_REPORT = 21
+    # codec rev 7: unsolicited server→client PUSH control frames. The
+    # server originates these on connections the data plane already
+    # holds; the client never answers. At-most-once, fire-and-forget —
+    # every pushed fact is re-derivable from the polling path.
+    LEASE_REVOKE = 22
+    BREAKER_FLIP = 23
+    RULE_EPOCH_INVALIDATE = 24
+    SHARD_MAP_PUSH = 25
+    BROWNOUT_ADVISORY = 26
 
 
 # front doors route these type bytes to the replication applier instead of
@@ -254,6 +309,21 @@ HIER_TYPES = frozenset(SHARE_TYPES | {MsgType.DEMAND_REPORT})
 # rev-6 outcome frames route to the token service's outcome ingester on both
 # doors; fire-and-forget (no response is ever written for these)
 OUTCOME_TYPES = frozenset({MsgType.OUTCOME_REPORT})
+
+# rev-7 push frames: server→client only. Client readers dispatch these
+# out-of-band (they never resolve a pending xid); the decision-plane
+# request decoder REFUSES them — a client that sends one at a server is a
+# protocol error and the door drops the connection.
+PUSH_TYPES = frozenset(
+    {MsgType.LEASE_REVOKE, MsgType.BREAKER_FLIP,
+     MsgType.RULE_EPOCH_INVALIDATE, MsgType.SHARD_MAP_PUSH,
+     MsgType.BROWNOUT_ADVISORY}
+)
+
+# every type byte this build speaks. Client readers SKIP (and count) a
+# frame whose type is outside this set instead of dropping the connection —
+# the forward-compat contract a mixed-rev fleet needs during rollout.
+KNOWN_TYPES = frozenset(int(t) for t in MsgType)
 
 # TokenStatus.MOVED — mirrored here as a bare int because this module must
 # stay importable without jax (socket-only processes); decode_response keys
@@ -966,6 +1036,125 @@ def decode_demand_report(payload: bytes):
         entries.append(_DEMAND_ENTRY.unpack_from(payload, off))
         off += _DEMAND_ENTRY.size
     return xid, pod_id, entries
+
+
+# -- codec rev 7: push frames --------------------------------------------------
+# Every push payload starts with the server's emit stamp (wall-clock ms) so
+# the client-side apply can record end-to-end staleness; per-type data
+# follows. Fixed layouts, runt checks raise ValueError only — the client
+# reader SKIPS a malformed push (and counts it) instead of dropping the
+# connection, because a push never gates a pending request.
+_PUSH_STAMP = struct.Struct(">q")  # stamp_ms
+_PUSH_REVOKE = struct.Struct(">qqqi")  # stamp_ms, lease_id, flow_id, tokens
+_PUSH_BREAKER = struct.Struct(">qqbi")  # stamp_ms, flow_id, state, retry_ms
+_PUSH_EPOCH = struct.Struct(">qq")  # stamp_ms, epoch
+_PUSH_BROWNOUT = struct.Struct(">qbi")  # stamp_ms, level, retry_ms
+
+
+@dataclass(frozen=True)
+class PushFrame:
+    """One decoded rev-7 push. Only the fields the ``msg_type`` defines are
+    meaningful; the rest stay at their zero values."""
+
+    xid: int
+    msg_type: MsgType
+    stamp_ms: int = 0
+    lease_id: int = 0
+    flow_id: int = 0
+    tokens: int = 0
+    state: int = 0
+    retry_after_ms: int = 0
+    epoch: int = 0
+    level: int = 0
+    doc: bytes = b""  # SHARD_MAP_PUSH only: zlib-compressed map JSON
+
+
+def encode_push_lease_revoke(
+    xid: int, stamp_ms: int, lease_id: int, flow_id: int, tokens: int
+) -> bytes:
+    payload = _HEAD.pack(xid, MsgType.LEASE_REVOKE) + _PUSH_REVOKE.pack(
+        stamp_ms, lease_id, flow_id, tokens
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def encode_push_breaker_flip(
+    xid: int, stamp_ms: int, flow_id: int, state: int, retry_after_ms: int
+) -> bytes:
+    payload = _HEAD.pack(xid, MsgType.BREAKER_FLIP) + _PUSH_BREAKER.pack(
+        stamp_ms, flow_id, int(state), int(retry_after_ms)
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def encode_push_rule_epoch(xid: int, stamp_ms: int, epoch: int) -> bytes:
+    payload = _HEAD.pack(xid, MsgType.RULE_EPOCH_INVALIDATE) + _PUSH_EPOCH.pack(
+        stamp_ms, epoch
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def encode_push_shard_map(xid: int, stamp_ms: int, doc: bytes) -> bytes:
+    """``doc`` is the zlib-compressed ShardMap JSON (``to_doc``). A map too
+    big for one frame is refused here — the polling publish path still
+    carries it; push is an accelerator, not the only channel."""
+    payload = _HEAD.pack(xid, MsgType.SHARD_MAP_PUSH) + _PUSH_STAMP.pack(
+        stamp_ms
+    ) + doc
+    if len(payload) > MAX_FRAME:
+        raise ValueError("shard map push frame too large")
+    return _LEN.pack(len(payload)) + payload
+
+
+def encode_push_brownout(
+    xid: int, stamp_ms: int, level: int, retry_ms: int
+) -> bytes:
+    payload = _HEAD.pack(xid, MsgType.BROWNOUT_ADVISORY) + _PUSH_BROWNOUT.pack(
+        stamp_ms, int(level), int(retry_ms)
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_push(payload: bytes) -> PushFrame:
+    """Any rev-7 push payload → :class:`PushFrame`. Raises ``ValueError`` on
+    a runt payload or a non-push type byte — and ONLY ValueError (the fuzz
+    containment contract): client readers catch it, count the frame, and
+    keep the connection."""
+    if len(payload) < _HEAD.size:
+        raise ValueError("runt push frame")
+    xid, mtype = _HEAD.unpack_from(payload, 0)
+    if mtype not in PUSH_TYPES:
+        raise ValueError(f"not a push type: {mtype}")
+    mtype = MsgType(mtype)
+    off = _HEAD.size
+    if mtype == MsgType.LEASE_REVOKE:
+        if len(payload) < off + _PUSH_REVOKE.size:
+            raise ValueError("runt lease revoke push")
+        stamp, lease_id, flow_id, tokens = _PUSH_REVOKE.unpack_from(payload, off)
+        return PushFrame(xid, mtype, stamp, lease_id=lease_id,
+                         flow_id=flow_id, tokens=tokens)
+    if mtype == MsgType.BREAKER_FLIP:
+        if len(payload) < off + _PUSH_BREAKER.size:
+            raise ValueError("runt breaker flip push")
+        stamp, flow_id, state, retry = _PUSH_BREAKER.unpack_from(payload, off)
+        return PushFrame(xid, mtype, stamp, flow_id=flow_id, state=state,
+                         retry_after_ms=retry)
+    if mtype == MsgType.RULE_EPOCH_INVALIDATE:
+        if len(payload) < off + _PUSH_EPOCH.size:
+            raise ValueError("runt rule epoch push")
+        stamp, epoch = _PUSH_EPOCH.unpack_from(payload, off)
+        return PushFrame(xid, mtype, stamp, epoch=epoch)
+    if mtype == MsgType.BROWNOUT_ADVISORY:
+        if len(payload) < off + _PUSH_BROWNOUT.size:
+            raise ValueError("runt brownout push")
+        stamp, level, retry = _PUSH_BROWNOUT.unpack_from(payload, off)
+        return PushFrame(xid, mtype, stamp, level=level, retry_after_ms=retry)
+    # SHARD_MAP_PUSH: stamp + opaque doc bytes (the doc may legitimately be
+    # any length ≥ 0; an empty doc is a no-op push)
+    if len(payload) < off + _PUSH_STAMP.size:
+        raise ValueError("runt shard map push")
+    (stamp,) = _PUSH_STAMP.unpack_from(payload, off)
+    return PushFrame(xid, mtype, stamp, doc=payload[off + _PUSH_STAMP.size:])
 
 
 def encode_response(rsp: FlowResponse) -> bytes:

@@ -176,6 +176,29 @@ class ShardMapPublisher:
         return self.property.listen(fn)
 
 
+# -- shard-map doc codec (rev-7 SHARD_MAP_PUSH payload) -----------------------
+def encode_shard_map_doc(shard_map: ShardMap) -> bytes:
+    """``ShardMap`` → compressed JSON blob for the SHARD_MAP_PUSH data
+    section. Same zlib+JSON idiom as the move-state blob; the push frame
+    treats it as opaque bytes."""
+    return zlib.compress(
+        json.dumps(shard_map.to_doc(), separators=(",", ":")).encode("utf-8")
+    )
+
+
+def decode_shard_map_doc(blob: bytes) -> ShardMap:
+    """Inverse of :func:`encode_shard_map_doc`. Raises ValueError only, so
+    client push dispatch can contain a torn or hostile payload without
+    dropping the connection."""
+    try:
+        doc = json.loads(zlib.decompress(bytes(blob)).decode("utf-8"))
+        return ShardMap.from_doc(doc)
+    except ValueError:
+        raise
+    except Exception as exc:
+        raise ValueError(f"bad shard map doc: {exc}") from exc
+
+
 # -- move-state blob codec ----------------------------------------------------
 def encode_move_state_blob(doc: Dict[str, object]) -> bytes:
     """``export_namespace_state()`` document → compressed wire blob (rules

@@ -190,6 +190,24 @@ class RoutingTokenClient(TokenService):
             )
         return True
 
+    def _wire_push(self, client) -> None:
+        """Subscribe a freshly-built pod client to rev-7 shard-map pushes:
+        decoded maps feed :meth:`apply_shard_map`, so a MOVE or election
+        outcome re-routes us within one RTT instead of a MOVED round trip.
+        The epoch fence makes stale or duplicate pushes harmless."""
+        if not hasattr(client, "on_shard_map"):
+            return
+
+        def _learn(blob: bytes) -> None:
+            from sentinel_tpu.cluster.rebalance import decode_shard_map_doc
+
+            try:
+                self.apply_shard_map(decode_shard_map_doc(blob))
+            except ValueError:
+                pass  # torn push payload; the polling plane will catch up
+
+        client.on_shard_map = _learn
+
     def coordinator_of(self, flow_id) -> Optional[str]:
         """The global budget coordinator endpoint for ``flow_id`` per the
         installed shard map's ``global_flows`` section, or None when the
@@ -243,6 +261,7 @@ class RoutingTokenClient(TokenService):
                         endpoint[0], endpoint[1],
                         timeout_ms=self.timeout_ms, namespace=ns,
                     )
+                    self._wire_push(client)
                     clients = dict(st.clients)
                     clients[pod_id] = client
                     self._state = st.replace(clients=clients)

@@ -163,6 +163,15 @@ class _LoopWorker:
         srv.connections.attach_closer(
             address, lambda: loop.call_soon_threadsafe(writer.close)
         )
+        # rev-7 push sink: emitters run on arbitrary threads (lease sweep,
+        # breaker scan, brownout eval), so frames hop onto this loop and
+        # ride the connection's reply lane via the same non-blocking
+        # writer.write the verdict flushes use — a push never waits and
+        # never blocks a verdict
+        srv.push_hub.attach(
+            address,
+            lambda frame: loop.call_soon_threadsafe(writer.write, frame),
+        )
         try:
             while True:
                 data = await reader.read(65536)
@@ -498,6 +507,7 @@ class _LoopWorker:
                 # a source that died mid-move must not leave a staged
                 # claim behind (crash matrix: dest discards, source owns)
                 move_session.closed()
+            srv.push_hub.detach(address)
             srv.connections.remove_address(address)
             try:
                 writer.close()
@@ -934,6 +944,7 @@ class TokenServer:
         promote_after_ms: Optional[float] = None,
         replicate_to: Optional[Sequence] = None,
         repl_interval_ms: Optional[float] = None,
+        push: bool = True,
     ):
         self.service = service
         self.host = host
@@ -1018,6 +1029,24 @@ class TokenServer:
         import weakref
 
         self._writer_bufs = weakref.WeakKeyDictionary()
+        # rev-7 push plane (cluster.push): per-connection sinks feed
+        # unsolicited server→client frames down the same reply lanes the
+        # verdict writes use. The hub attaches to the service so lease
+        # revocations / breaker flips / rule-epoch bumps go out the moment
+        # they happen, and to the admission gate so brownout transitions
+        # ride along as advisories. push=False disarms every emit (the
+        # drills' push-dark phases).
+        from sentinel_tpu.cluster.push import PushHub
+
+        self.push_hub = PushHub(enabled=push)
+        attach = getattr(self.service, "attach_push_hub", None)
+        if attach is not None:
+            attach(self.push_hub)
+        self.overload.on_level_change = (
+            lambda level, retry_ms: self.push_hub.push_brownout(
+                level, retry_ms
+            )
+        )
 
     def tuning_kwargs(self) -> dict:
         """Operator-tunable constructor kwargs, for rebuilding this server on
@@ -1040,6 +1069,7 @@ class TokenServer:
             promote_after_ms=self.promote_after_ms,
             replicate_to=self.replicate_to,
             repl_interval_ms=self.repl_interval_ms,
+            push=self.push_hub.enabled,
         )
 
     # -- warm-standby role ---------------------------------------------------
@@ -1136,6 +1166,10 @@ class TokenServer:
         }
         for name, fn in self._gauge_fns.items():
             _SM.register_gauge(name, fn)
+        # hub half of the clusterServerStats `push` block (most recently
+        # started door wins — same single-slot contract as the other
+        # providers)
+        _SM.register_push_provider(self.push_hub.stats)
         if self.metrics_port is not None:
             from sentinel_tpu.metrics.exporter import PrometheusExporter
 

@@ -96,6 +96,7 @@ class NativeTokenServer:
         repl_interval_ms: Optional[float] = None,
         shm_dir: Optional[str] = None,
         shm_spin_us: Optional[int] = None,
+        push: bool = True,
     ):
         from sentinel_tpu.native.lib import Frontdoor  # raises if unbuilt
 
@@ -202,6 +203,22 @@ class NativeTokenServer:
 
         self.move_target = MoveTarget(service)
         self._move_sessions: dict = {}  # (fd, gen) → MoveSession
+        # rev-7 push plane (cluster.push): sinks registered per (fd, gen)
+        # at CTRL_OPEN hand encoded push frames to door.send — the same
+        # non-blocking C++ send queue the control replies use, which also
+        # covers shm ring connections (their door routes sends onto the
+        # response lane). push=False disarms every emit.
+        from sentinel_tpu.cluster.push import PushHub
+
+        self.push_hub = PushHub(enabled=push)
+        attach_hub = getattr(service, "attach_push_hub", None)
+        if attach_hub is not None:
+            attach_hub(self.push_hub)
+        self.overload.on_level_change = (
+            lambda level, retry_ms: self.push_hub.push_brownout(
+                level, retry_ms
+            )
+        )
 
     def tuning_kwargs(self) -> dict:
         return dict(
@@ -226,6 +243,7 @@ class NativeTokenServer:
             repl_interval_ms=self.repl_interval_ms,
             shm_dir=self.shm_dir,
             shm_spin_us=self.shm_spin_us,
+            push=self.push_hub.enabled,
         )
 
     @property
@@ -401,6 +419,9 @@ class NativeTokenServer:
             _SM.register_shm_provider(self._shm_stats_provider)
         for name, fn in self._gauge_fns.items():
             _SM.register_gauge(name, fn)
+        # hub half of the clusterServerStats `push` block (single-slot
+        # provider, same contract as the asyncio door's)
+        _SM.register_push_provider(self.push_hub.stats)
         if self.metrics_port is not None:
             from sentinel_tpu.metrics.exporter import PrometheusExporter
 
@@ -1156,8 +1177,16 @@ class NativeTokenServer:
                 address,
                 lambda fd=fd, gen=gen, door=door: door.close_conn(fd, gen),
             )
+            # rev-7 push sink: door.send enqueues on the C++ plane's
+            # non-blocking per-connection send queue (encoded push frames
+            # carry their length prefix, same as control replies)
+            self.push_hub.attach(
+                (fd, gen),
+                lambda b, fd=fd, gen=gen, door=door: door.send(fd, gen, b),
+            )
             return
         if kind == door.CTRL_CLOSE:
+            self.push_hub.detach((fd, gen))
             with self._addr_lock:
                 address = self._addr_by_conn.pop((fd, gen), None)
             if address:

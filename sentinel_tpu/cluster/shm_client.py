@@ -31,6 +31,7 @@ from sentinel_tpu.cluster.client import (
     RECONNECT_JITTER,
     TokenClient,
     _count_recv,
+    _count_unknown_frame,
 )
 from sentinel_tpu.core.log import record_log
 from sentinel_tpu.native.lib import ShmRingClient
@@ -153,6 +154,18 @@ class ShmTokenClient(TokenClient):
                 _count_recv(len(payload))
                 try:
                     mtype = P.peek_type(payload)
+                    if mtype in P.PUSH_TYPES:
+                        # rev-7 push off the ring's response lane: applied
+                        # out-of-band, never resolves a pending xid, and a
+                        # malformed push is counted + skipped inside the
+                        # handler — the segment survives
+                        self._handle_push(bytes(payload))
+                        continue
+                    if mtype not in P.KNOWN_TYPES:
+                        # a newer server's frame type: skip + count instead
+                        # of dropping the segment (mixed-rev fleets)
+                        _count_unknown_frame()
+                        continue
                     if mtype == P.MsgType.BATCH_FLOW:
                         xid = int.from_bytes(payload[:4], "big", signed=True)
                         pending = self._pending.get(xid)

@@ -474,6 +474,13 @@ class DefaultTokenService(TokenService):
                 _self()
             )
         )
+        # rev-7 push plane: front doors attach their PushHub here so the
+        # service can emit unsolicited server→client frames at the moment
+        # server truth changes (lease revoked, breaker flipped, rules
+        # reloaded) instead of waiting for clients to poll into it. Emits
+        # are fire-and-forget through non-blocking sinks — safe to call
+        # under self._lock (see _emit_push).
+        self._push_hubs: List[object] = []
 
     @staticmethod
     def _prep_batch(cfg, slots, acq, pr):
@@ -675,17 +682,28 @@ class DefaultTokenService(TokenService):
             # slot or dropped the rule, so re-resolve every outstanding
             # lease and revoke those whose rule vanished (their LEASED
             # charge simply expires with the window — conservative)
+            dead = []
             if self._leases:
-                dead = []
                 for lid, lease in self._leases.items():
                     slot = self._index.slot_of.get(lease.flow_id)
                     if slot is None:
-                        dead.append(lid)
+                        dead.append(lease)
                     else:
                         lease.slot = int(slot)
-                for lid in dead:
-                    del self._leases[lid]
+                for lease in dead:
+                    del self._leases[lease.lease_id]
                 self._lease_stats["revoked"] += len(dead)
+            gen = self._state_gen
+        # rev-7 push, emitted after the rule locks drop: recall the leases
+        # the reload killed and invalidate client-cached rule-derived state
+        # (backoffs, cached NO_RULE answers) within one RTT instead of a
+        # TTL — the generation bump above is the epoch clients fence on
+        for lease in dead:
+            self._emit_push(
+                "push_lease_revoke", lease.lease_id, lease.flow_id,
+                lease.tokens,
+            )
+        self._emit_push("push_rule_epoch", gen)
 
     def load_namespace_rules(
         self, namespace: str, rules: List[ClusterFlowRule]
@@ -1636,13 +1654,13 @@ class DefaultTokenService(TokenService):
             # the flow window, so the MOVE's window-sum export carries it to
             # the new owner — "transfer the charge, recall the lease"
             flows = set(self._rules_by_ns.get(namespace, ()))
+            dead = []
             if flows and self._leases:
                 dead = [
-                    lid for lid, l in self._leases.items()
-                    if l.flow_id in flows
+                    l for l in self._leases.values() if l.flow_id in flows
                 ]
-                for lid in dead:
-                    del self._leases[lid]
+                for l in dead:
+                    del self._leases[l.lease_id]
                 self._lease_stats["revoked"] += len(dead)
             # same contract for hierarchy share holds: the LEASED hold
             # charge rides the window-sum export to the new owner (so the
@@ -1651,6 +1669,13 @@ class DefaultTokenService(TokenService):
             # its hold from ITS share on its next tick
             for fid in flows:
                 self._share_holds.pop(int(fid), None)
+        # rev-7 push: recalled leases cut over within one RTT — without
+        # this the leased fast path keeps admitting against the recalled
+        # slice until its next renew answers MOVED
+        for l in dead:
+            self._emit_push(
+                "push_lease_revoke", l.lease_id, l.flow_id, l.tokens
+            )
         if _TR.ARMED:  # flight recorder: MOVE begin (phase 0)
             _TR.record(_TR.MOVE, aux=0)
 
@@ -1723,6 +1748,26 @@ class DefaultTokenService(TokenService):
         )
         return idx, names
 
+    # -- rev-7 push plane (server→client control frames) ---------------------
+    def attach_push_hub(self, hub) -> None:
+        """Register a front door's :class:`~sentinel_tpu.cluster.push.PushHub`.
+        Every service-side truth change that clients may be caching (lease
+        registry, breaker states, rule generation) is mirrored onto every
+        attached hub; both doors of a server attach the same hub."""
+        if hub not in self._push_hubs:
+            self._push_hubs.append(hub)
+
+    def _emit_push(self, method: str, *args) -> None:
+        """Fan one emit across every attached hub. Never raises and never
+        blocks — hub sinks are the same non-blocking enqueues the reply
+        lanes use — so call sites inside ``self._lock`` are safe (the hub's
+        own lock never calls back into the service)."""
+        for hub in self._push_hubs:
+            try:
+                getattr(hub, method)(*args)
+            except Exception:
+                pass
+
     # -- wire rev 5: token leases (client-local admission) -------------------
     def _sweep_leases_locked(self, now: int) -> None:
         """Drop leases past their TTL. Their LEASED charge stays in the flow
@@ -1732,12 +1777,19 @@ class DefaultTokenService(TokenService):
         if not self._leases:
             return
         dead = [
-            lid for lid, l in self._leases.items() if now >= l.expiry_ms
+            l for l in list(self._leases.values()) if now >= l.expiry_ms
         ]
         if dead:
-            for lid in dead:
-                del self._leases[lid]
+            for l in dead:
+                del self._leases[l.lease_id]
             self._lease_stats["revoked"] += len(dead)
+            # push the revocations so a live-but-slow client drops its
+            # cached slice now instead of admitting against a lease the
+            # server already wrote off
+            for l in dead:
+                self._emit_push(
+                    "push_lease_revoke", l.lease_id, l.flow_id, l.tokens
+                )
 
     def _credit_lease_locked(self, lease: _Lease, used: int) -> None:
         """Credit a lease's unused tokens back into the EXACT ring bucket
@@ -3397,6 +3449,7 @@ class DefaultTokenService(TokenService):
             return
         edges: Dict[Tuple[int, int], int] = {}
         tripped: List[object] = []
+        flips: List[Tuple[int, int]] = []  # (flow_id, new state) per edge
         with self._lock:
             now_s = time.monotonic()
             if not force and now_s - self._breaker_scan_ts < 1.0:
@@ -3419,6 +3472,9 @@ class DefaultTokenService(TokenService):
                     continue  # stale mirror rows of dropped rules
                 frm, to = int(prev[s]), int(st[s])
                 edges[(frm, to)] = edges.get((frm, to), 0) + 1
+                fid = rev.get(s)
+                if fid is not None:
+                    flips.append((int(fid), to))
                 if to == 1:  # BR_OPEN
                     tripped.append(rev.get(s, s))
         names = self._BR_STATE_NAMES
@@ -3428,6 +3484,16 @@ class DefaultTokenService(TokenService):
                 names[to] if to < 3 else str(to),
                 count,
             )
+        # rev-7 push: every observed edge goes to the clients — OPEN parks
+        # their local admission clocks (retry-after = the rule's recovery
+        # timeout, the earliest the device could HALF_OPEN), CLOSED and
+        # HALF_OPEN lift them so probe traffic reaches the wire again
+        for fid, to in flips:
+            retry = 0
+            if to == 1:
+                rule = self._degrade_rules_src.get(fid)
+                retry = int(getattr(rule, "recovery_timeout_ms", 0) or 0)
+            self._emit_push("push_breaker_flip", fid, to, retry)
         if tripped:
             from sentinel_tpu.trace import blackbox as _blackbox
 

@@ -103,6 +103,47 @@ class FailoverTokenClient(TokenService):
             )
         self._lock = threading.Lock()
         self._active = 0  # index of the member that served last (telemetry)
+        # rev-7 brownout advisories: per-member wall-clock until which the
+        # endpoint has ADVERTISED it is shedding. An advisory only reorders
+        # the walk (standbys first) — it never removes the endpoint, so a
+        # fleet-wide brownout still gets served by the least-bad member.
+        self._brownout_until: List[float] = [0.0] * len(self._members)
+        for i, member in enumerate(self._members):
+            self._arm_push(i, member)
+
+    # -- rev-7 push interest -------------------------------------------------
+    def _arm_push(self, index: int, member: _Member) -> None:
+        """(Re-)subscribe to a member client's brownout pushes. The callback
+        lives on the client object and survives its internal reconnects;
+        re-arming after a walk lands elsewhere (``_note_served``) keeps the
+        subscription alive even if a wrapper swapped the callback out."""
+        client = member.client
+        if not hasattr(client, "on_brownout"):
+            return
+
+        def _advise(level, retry_after_ms, _i=index):
+            if int(level) <= 0:
+                self._brownout_until[_i] = 0.0
+                return
+            hold = float(retry_after_ms) if retry_after_ms > 0 else 100.0
+            self._brownout_until[_i] = _clock.now_ms() + hold
+
+        client.on_brownout = _advise
+
+    def _walk_order(self) -> List[int]:
+        """Endpoint indices in walk order: members without a live brownout
+        advisory first, advertised-browned members demoted to the tail (the
+        early-walk hint — we reach the standby BEFORE burning a round trip
+        on an endpoint that told us it is shedding). All browned, or none:
+        the configured order stands."""
+        now = _clock.now_ms()
+        until = self._brownout_until
+        n = len(self._members)
+        browned = [i for i in range(n) if until[i] > now]
+        if not browned or len(browned) == n:
+            return list(range(n))
+        ha_metrics().count_fallback("brownout_hint")
+        return [i for i in range(n) if until[i] <= now] + browned
 
     # -- endpoint walk -------------------------------------------------------
     def _note_served(self, index: int) -> None:
@@ -118,6 +159,9 @@ class FailoverTokenClient(TokenService):
                     self._members[index].endpoint,
                 )
                 self._active = index
+                # the walk landed on a different endpoint: re-register push
+                # interest there so revocations/advisories keep flowing
+                self._arm_push(index, self._members[index])
 
     def _note_exhausted(self) -> None:
         """Every endpoint refused or failed → this request degrades."""
@@ -241,7 +285,8 @@ class FailoverTokenClient(TokenService):
         overload_result = None
         degraded_result = None
         saw_standby = False
-        for i, member in enumerate(self._members):
+        for i in self._walk_order():
+            member = self._members[i]
             # health is consulted immediately before dispatch, never up
             # front for the whole list: allows_request() may flip an OPEN
             # breaker to HALF_OPEN and hand this call its one probe slot,
@@ -363,7 +408,8 @@ class FailoverTokenClient(TokenService):
         better (the agent treats it as an authoritative zero-share)."""
         deadline = _clock.now_ms() + self.deadline_ms
         refusal = None
-        for i, member in enumerate(self._members):
+        for i in self._walk_order():
+            member = self._members[i]
             if not member.health.allows_request():
                 continue
             try:
@@ -451,7 +497,8 @@ class FailoverTokenClient(TokenService):
         rotation; its answer closes the breaker and is returned as-is."""
         answered_no = False
         deadline = _clock.now_ms() + self.deadline_ms
-        for i, member in enumerate(self._members):
+        for i in self._walk_order():
+            member = self._members[i]
             if not member.health.allows_request():
                 continue
             try:
@@ -515,8 +562,10 @@ class FailoverTokenClient(TokenService):
         out = []
         with self._lock:
             active = self._active
+        now = _clock.now_ms()
         for i, member in enumerate(self._members):
             entry = {"endpoint": str(member.endpoint), "active": i == active}
+            entry["brownoutMs"] = max(0, int(self._brownout_until[i] - now))
             entry.update(member.health.snapshot())
             consecutive = getattr(
                 member.client, "consecutive_failures", None

@@ -225,6 +225,16 @@ def render(now_ms: Optional[int] = None) -> str:
         f"sentinel_client_recv_buf_grows_total "
         f"{_client.client_recv_buf_grows_total()}"
     )
+    lines.append(
+        "# HELP sentinel_client_unknown_frames_total Frames with a type "
+        "byte this build doesn't speak, skipped by client readers instead "
+        "of dropping the connection (mixed-rev rollout canary)."
+    )
+    lines.append("# TYPE sentinel_client_unknown_frames_total counter")
+    lines.append(
+        f"sentinel_client_unknown_frames_total "
+        f"{_client.client_unknown_frames_total()}"
+    )
     # DCN-tier aggregation health (import deferred for the same reason)
     from sentinel_tpu.cluster import namespaces as _namespaces
 

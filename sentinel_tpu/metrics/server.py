@@ -181,6 +181,19 @@ class ServerMetrics:
         self._breaker_provider: Optional[Callable[[], dict]] = None
         self._breaker_transitions: Dict[Tuple[str, str], int] = {}
         self._breaker_lock = threading.Lock()
+        # wire-rev-7 push-plane observability: frames emitted by the push
+        # hub (by type), lease revocations pushed, and the server-emit →
+        # client-apply staleness histogram. Staleness is recorded by the
+        # client-side apply off the frame's emit stamp — co-located
+        # clients (shm, drills, sidecars sharing the exporter) land it in
+        # this process; a remote client's applies surface on its own
+        # exporter. A provider exposes the live hub's connection count
+        # and drop counters.
+        self._push_frames: Dict[str, int] = {}
+        self._push_revocations = 0
+        self._push_lock = threading.Lock()
+        self.push_staleness_ms = LatencyHistogram(lo=0.01, hi=60_000.0)
+        self._push_provider: Optional[Callable[[], dict]] = None
 
     # -- fused dispatch counters --------------------------------------------
     def record_fused(self, depth: int) -> None:
@@ -563,6 +576,58 @@ class ServerMetrics:
         with self._breaker_lock:
             return dict(self._breaker_transitions)
 
+    # -- push plane ---------------------------------------------------------
+    def count_push_frame(self, type_name: str, n: int = 1) -> None:
+        """``n`` rev-7 push frames of ``type_name`` handed to connection
+        sinks (counted per delivery attempt that reached a sink, not per
+        broadcast call — a hub with no connections counts nothing)."""
+        if n <= 0:
+            return
+        with self._push_lock:
+            self._push_frames[type_name] = (
+                self._push_frames.get(type_name, 0) + int(n)
+            )
+
+    def count_push_revocation(self, n: int = 1) -> None:
+        """``n`` leases recalled through pushed LEASE_REVOKE frames (one
+        per revoked lease, regardless of how many connections heard it)."""
+        if n <= 0:
+            return
+        with self._push_lock:
+            self._push_revocations += int(n)
+
+    def record_push_staleness(self, ms: float, n: int = 1) -> None:
+        """One server-emit → client-apply staleness sample (ms), recorded
+        by the client-side push apply off the frame's emit stamp."""
+        self.push_staleness_ms.record(max(0.0, float(ms)), n)
+
+    def push_frame_totals(self) -> Dict[str, int]:
+        with self._push_lock:
+            return dict(self._push_frames)
+
+    @property
+    def push_revocations_total(self) -> int:
+        with self._push_lock:
+            return self._push_revocations
+
+    def register_push_provider(self, fn: Callable[[], dict]) -> None:
+        """Install the zero-arg reader for the live push hub's state
+        (``PushHub.stats`` shape: attached connections, per-type emit
+        counts, drops). Most recent registration wins; providers return
+        ``{}`` once their hub is gone."""
+        with self._push_lock:
+            self._push_provider = fn
+
+    def push_stats(self) -> dict:
+        with self._push_lock:
+            fn = self._push_provider
+        if fn is None:
+            return {}
+        try:
+            return dict(fn() or {})
+        except Exception:
+            return {}  # a torn-down hub's reader must not 500 a scrape
+
     # -- snapshots ----------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON shape served by the ``clusterServerStats`` command — the
@@ -596,6 +661,12 @@ class ServerMetrics:
                         self.breaker_transition_totals().items()
                     )
                 ],
+            },
+            "push": {
+                **self.push_stats(),
+                "frames": self.push_frame_totals(),
+                "revocations": self.push_revocations_total,
+                "stalenessMs": self.push_staleness_ms.snapshot(),
             },
             "stages": {
                 "queue_wait_ms": self.queue_wait_ms.snapshot(),
@@ -911,6 +982,35 @@ class ServerMetrics:
                 'sentinel_breaker_transitions_total'
                 '{from="closed",to="open"} 0'
             )
+        lines.append(
+            "# HELP sentinel_push_frames_total Wire-rev-7 push frames "
+            "handed to connection sinks, by type (cumulative)."
+        )
+        lines.append("# TYPE sentinel_push_frames_total counter")
+        push_frames = self.push_frame_totals()
+        if push_frames:
+            for tname, count in sorted(push_frames.items()):
+                lines.append(
+                    "sentinel_push_frames_total"
+                    f'{{type="{_escape(tname)}"}} {count}'
+                )
+        else:
+            # zero-sample so the series exists before the first push
+            lines.append('sentinel_push_frames_total{type="lease_revoke"} 0')
+        lines.append(
+            "# HELP sentinel_push_revocations_total Leases recalled through "
+            "pushed LEASE_REVOKE frames (cumulative)."
+        )
+        lines.append("# TYPE sentinel_push_revocations_total counter")
+        lines.append(
+            f"sentinel_push_revocations_total {self.push_revocations_total}"
+        )
+        lines.append(self.push_staleness_ms.render_prometheus(
+            "sentinel_push_staleness_ms",
+            "Server-emit to client-apply staleness of rev-7 push frames "
+            "(ms), recorded by co-located client applies off the frame's "
+            "emit stamp.",
+        ))
         breaker = self.breaker_stats()
         br_flows = breaker.get("flows") or {}
         if br_flows:
@@ -1019,6 +1119,11 @@ class ServerMetrics:
         with self._breaker_lock:
             self._breaker_provider = None
             self._breaker_transitions.clear()
+        with self._push_lock:
+            self._push_provider = None
+            self._push_frames.clear()
+            self._push_revocations = 0
+        self.push_staleness_ms.reset()
         self._rate.reset()
 
 

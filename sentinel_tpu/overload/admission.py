@@ -173,6 +173,12 @@ class AdmissionController:
         self.on_advice = None
         self._ns_baseline: dict = {}
         self._next_advise = 0.0
+        # brownout level-change listener (rev-7 push plane): called with
+        # (level_int, retry_hint_ms) on EVERY transition — escalations so
+        # clients can pre-back-off before their next refusal, recoveries
+        # so they stop. Same contract as on_advice: best-effort, must not
+        # raise into the gate.
+        self.on_level_change = None
 
     # -- inflight accounting (front doors call these) -----------------------
     def note_enqueued(self, n: int) -> None:
@@ -244,6 +250,13 @@ class AdmissionController:
                 if _TR.ARMED:
                     _TR.record(_TR.BROWNOUT, aux=int(level.value))
                 _blackbox.maybe_dump(f"brownout:{level.name.lower()}")
+        if level is not prev:
+            listener = self.on_level_change
+            if listener is not None:
+                try:
+                    listener(int(level), self.config.retry_hint_ms)
+                except Exception:
+                    pass
 
     def _maybe_advise(self, now: float, level: BrownoutLevel) -> None:
         """Emit a ``rebalance-advise`` event naming the hottest namespaces

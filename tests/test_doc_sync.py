@@ -340,6 +340,75 @@ class TestDegradeDocSync:
         assert f"status code **{int(TokenStatus.DEGRADED)}**" in text
 
 
+class TestPushDocSync:
+    """Push-plane docs ↔ code sync: CLUSTER_HA.md's push-plane + election
+    sections, ROBUSTNESS.md's push-on/push-dark staleness table, and the
+    OBSERVABILITY.md rows all name surfaces that exist in code."""
+
+    def _ha(self):
+        with open(os.path.join(REPO, "docs", "CLUSTER_HA.md")) as f:
+            return f.read()
+
+    def _rob(self):
+        with open(os.path.join(REPO, "docs", "ROBUSTNESS.md")) as f:
+            return f.read()
+
+    @pytest.mark.parametrize("needle", [
+        # the five frame types and the delivery contract
+        "## Push plane (wire rev 7)",
+        "LEASE_REVOKE",
+        "BREAKER_FLIP",
+        "RULE_EPOCH_INVALIDATE",
+        "SHARD_MAP_PUSH",
+        "BROWNOUT_ADVISORY",
+        "at-most-once",
+        "push=False",
+        # the election: the lock, its arbiter, and its class
+        "CoordinatorElection",
+        "coordinator_lock",
+        "lock_ttl_ms",
+        "claim_lost",
+        # verification surface
+        "--only-push",
+        "`push-smoke`",
+    ])
+    def test_cluster_ha_names_the_surface(self, needle):
+        assert needle in self._ha()
+
+    @pytest.mark.parametrize("needle", [
+        "## Staleness bounds: push-on vs push-dark",
+        "max(10×RTT, 25ms)",
+        "`push=False`",
+        "`LEASE_REVOKE`",
+        "`BROWNOUT_ADVISORY`",
+    ])
+    def test_robustness_carries_the_bound_table(self, needle):
+        assert needle in self._rob()
+
+    @pytest.mark.parametrize("needle", [
+        "sentinel_push_frames_total",
+        "`sentinel_push_revocations_total`",
+        "`sentinel_push_staleness_ms`",
+        "`sentinel_client_unknown_frames_total`",
+    ])
+    def test_observability_documents_the_series(self, needle):
+        assert needle in _doc_text()
+
+    def test_doc_frame_labels_match_the_wire(self):
+        """The per-type labels OBSERVABILITY.md enumerates are the hub's
+        live PUSH_TYPE_NAMES, not a stale copy."""
+        from sentinel_tpu.cluster.push import PUSH_TYPE_NAMES
+
+        text = _doc_text()
+        for label in PUSH_TYPE_NAMES.values():
+            assert f"`{label}`" in text, f"push type {label} undocumented"
+
+    def test_cross_links(self):
+        assert "#staleness-bounds-push-on-vs-push-dark" in self._ha()
+        assert "#push-plane-wire-rev-7" in self._rob()
+        assert "#push-plane-wire-rev-7" in _doc_text()
+
+
 class TestMegakernelDocSync:
     """docs/PERF.md round 16 ↔ code sync: the doc names the megakernel's
     selection surface, the bytes ledger, the pipelined lane knob, and the

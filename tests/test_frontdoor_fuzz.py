@@ -375,6 +375,225 @@ class TestLeaseCodecFuzz:
             P.decode_request(frame)
 
 
+def _push_frames():
+    """One well-formed frame of each rev-7 push type (length-prefixed)."""
+    return {
+        "lease_revoke": P.encode_push_lease_revoke(1, 111, 55, 1, 8),
+        "breaker_flip": P.encode_push_breaker_flip(2, 111, 1, 1, 60_000),
+        "rule_epoch": P.encode_push_rule_epoch(3, 111, 9),
+        "shard_map": P.encode_push_shard_map(4, 111, b"\x00" * 24),
+        "brownout": P.encode_push_brownout(5, 111, 2, 250),
+    }
+
+
+def _push_cut_corpus(min_cut=0):
+    """Every truncation cut of all five push frames, re-framed with an
+    honest length header (the splitter delivers the torn payload intact to
+    the push dispatch — the containment path under test), plus each full
+    frame."""
+    corpus = []
+    for frame in _push_frames().values():
+        payload = frame[2:]
+        for cut in range(min_cut, len(payload)):
+            corpus.append(struct.pack(">H", cut) + payload[:cut])
+        corpus.append(frame)
+    return corpus
+
+
+class TestPushCodecFuzz:
+    """Rev-7 push codec containment: decode either succeeds or raises
+    ``ValueError`` — never struct.error, never an index crash — on every
+    truncation cut, and full frames round-trip exact fields."""
+
+    def test_every_cut_raises_valueerror_or_decodes(self):
+        for name, frame in _push_frames().items():
+            payload = frame[2:]
+            for cut in range(len(payload)):
+                try:
+                    got = P.decode_push(payload[:cut])
+                except ValueError:
+                    continue  # the only sanctioned failure mode
+                # SHARD_MAP_PUSH legitimately decodes past its stamp: the
+                # doc is opaque variable-length bytes (a torn doc is the
+                # shard-map DECODER's problem, contained separately)
+                assert name == "shard_map", (
+                    f"{name} cut={cut} decoded instead of raising"
+                )
+                assert got.msg_type == P.MsgType.SHARD_MAP_PUSH
+
+    def test_full_frames_roundtrip(self):
+        f = _push_frames()
+        p = P.decode_push(f["lease_revoke"][2:])
+        assert (p.msg_type, p.stamp_ms, p.lease_id, p.flow_id, p.tokens) == (
+            P.MsgType.LEASE_REVOKE, 111, 55, 1, 8
+        )
+        p = P.decode_push(f["breaker_flip"][2:])
+        assert (p.msg_type, p.flow_id, p.state, p.retry_after_ms) == (
+            P.MsgType.BREAKER_FLIP, 1, 1, 60_000
+        )
+        p = P.decode_push(f["rule_epoch"][2:])
+        assert (p.msg_type, p.epoch) == (P.MsgType.RULE_EPOCH_INVALIDATE, 9)
+        p = P.decode_push(f["shard_map"][2:])
+        assert (p.msg_type, p.doc) == (P.MsgType.SHARD_MAP_PUSH, b"\x00" * 24)
+        p = P.decode_push(f["brownout"][2:])
+        assert (p.msg_type, p.level, p.retry_after_ms) == (
+            P.MsgType.BROWNOUT_ADVISORY, 2, 250
+        )
+
+    def test_random_blobs_never_escape_valueerror(self):
+        rng = random.Random(SEED + 11)
+        for _ in range(300):
+            blob = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(0, 80))
+            )
+            try:
+                P.decode_push(blob)
+            except ValueError:
+                pass  # the only sanctioned failure mode
+
+    def test_decode_request_refuses_push_types(self):
+        # pushes are server→client only; the decision-plane decoder must
+        # refuse them loudly rather than misparse the body as a request
+        for frame in _push_frames().values():
+            with pytest.raises(ValueError):
+                P.decode_request(frame[2:])
+
+    def test_decode_push_refuses_non_push_types(self):
+        frame = P.encode_request(P.Ping(1))
+        with pytest.raises(ValueError):
+            P.decode_push(frame[2:])
+
+
+class TestAsyncioPushDirectionFuzz:
+    def test_push_frames_thrown_at_the_server_never_kill_the_loop(
+        self, asyncio_server
+    ):
+        # wrong-direction traffic: a client (or attacker) streaming push
+        # frames AT the door must get a graceful drop, not a dead lane
+        _throw_garbage(asyncio_server.port, _push_cut_corpus())
+        _assert_still_serving(asyncio_server.port)
+
+
+class TestClientPushFuzz:
+    """Torn pushes into the TCP reader: every cut that still carries the
+    push type byte is counted-and-skipped WITHOUT dropping the connection
+    (a push gates no pending request), valid pushes interleaved with the
+    garbage still apply, and lease state stays consistent."""
+
+    def _fake_server(self, reply_blobs):
+        return TestClientReaderFuzz._fake_server(self, reply_blobs)
+
+    def test_torn_pushes_skip_and_count_valid_pushes_apply(self):
+        from sentinel_tpu.engine import TokenStatus
+
+        # cuts below the xid+type header hit the generic runt path (covered
+        # by TestClientReaderFuzz); from the header on, push containment
+        # owns the frame — stream those, then prove the SAME connection
+        # still delivers: a full breaker flip must apply after the garbage
+        blobs = _push_cut_corpus(min_cut=P._HEAD.size)
+        flip = P.encode_push_breaker_flip(9, 111, 1, 1, 60_000)
+        blobs.append(flip)
+        port, t = self._fake_server(blobs)
+        c = TokenClient("127.0.0.1", port, timeout_ms=300, lease=True)
+        try:
+            c.request_token(1)  # connects; times out (no verdict scripted)
+            deadline = 50
+            while c.push_stats().get("breaker_flip", 0) < 1:
+                deadline -= 1
+                assert deadline > 0, "breaker flip push never applied"
+                threading.Event().wait(0.05)
+            stats = c.push_stats()
+            # torn frames were counted, not fatal: the flip arrived LAST on
+            # the same connection, so the reader survived every cut
+            assert stats["malformed"] > 0
+            # the pushed OPEN answers locally while the clock runs
+            r = c.request_token(1)
+            assert r.status == TokenStatus.DEGRADED
+            assert r.wait_ms > 0
+            # lease consistency: the revoke cuts and the full revoke for an
+            # unknown lease id left no phantom lease behind
+            assert not c._leases
+        finally:
+            c.close()
+            t.join(timeout=5)
+
+    def test_unknown_frame_types_skip_and_count(self):
+        from sentinel_tpu.cluster.client import client_unknown_frames_total
+
+        base = client_unknown_frames_total()
+        future = struct.pack(">H", 9) + struct.pack(">ib", 7, 99) + b"\0" * 4
+        flip = P.encode_push_breaker_flip(9, 111, 2, 1, 60_000)
+        port, t = self._fake_server([future, flip])
+        c = TokenClient("127.0.0.1", port, timeout_ms=300)
+        try:
+            c.request_token(2)
+            deadline = 50
+            while c.push_stats().get("breaker_flip", 0) < 1:
+                deadline -= 1
+                assert deadline > 0, "flip after unknown frame never applied"
+                threading.Event().wait(0.05)
+            # the unknown frame was skipped+counted, and the connection
+            # survived to deliver the flip behind it
+            assert client_unknown_frames_total() > base
+        finally:
+            c.close()
+            t.join(timeout=5)
+
+
+@pytest.mark.skipif(not native_available(), reason="native library not built")
+class TestShmPushFuzz:
+    """Torn pushes down the shm ring's response lane: the ring client's
+    reader shares the TCP reader's containment (skip + count, never a dead
+    lane), and the lane keeps serving verdicts afterwards."""
+
+    def test_torn_pushes_never_kill_the_ring_lane(self, svc, tmp_path):
+        from sentinel_tpu.cluster.shm_client import ShmTokenClient
+        from sentinel_tpu.engine import TokenStatus
+
+        shm_dir = str(tmp_path)
+        server = NativeTokenServer(
+            svc, port=0, idle_ttl_s=None, shm_dir=shm_dir
+        )
+        server.start()
+        c = None
+        try:
+            c = ShmTokenClient(shm_dir, timeout_ms=3000)
+            assert c.request_token(1).ok  # lane up, sink attached
+            deadline = 100
+            while not server.push_hub.connections():
+                deadline -= 1
+                assert deadline > 0, "shm connection never attached a sink"
+                threading.Event().wait(0.05)
+            # inject every truncation cut straight into the response lane
+            with server.push_hub._lock:
+                sinks = list(server.push_hub._sinks.values())
+            for blob in _push_cut_corpus(min_cut=P._HEAD.size):
+                for sink in sinks:
+                    sink(blob)  # sinks take the length-prefixed frame
+            # a real flip behind the garbage still applies...
+            server.push_hub.push_breaker_flip(1, 1, 60_000)
+            deadline = 100
+            while c.push_stats().get("breaker_flip", 0) < 1:
+                deadline -= 1
+                assert deadline > 0, "breaker flip push never applied"
+                threading.Event().wait(0.05)
+            assert c.push_stats()["malformed"] > 0
+            assert c.request_token(1).status == TokenStatus.DEGRADED
+            # ...and the lane still serves once the clock is lifted
+            server.push_hub.push_breaker_flip(1, 0, 0)
+            deadline = 100
+            while c.request_token(1).status == TokenStatus.DEGRADED:
+                deadline -= 1
+                assert deadline > 0, "pushed CLOSED never lifted the clock"
+                threading.Event().wait(0.05)
+            assert c.request_token(1).ok
+            assert not c._leases
+        finally:
+            if c is not None:
+                c.close()
+            server.stop()
+
+
 @pytest.mark.skipif(not native_available(), reason="native library not built")
 class TestShardedNativeFuzz:
     def test_garbage_never_kills_a_sharded_lane(self, svc):

@@ -365,6 +365,12 @@ class TestCoordinatorKill:
         budget = 40.0  # 40 tokens over the 1s window, fleet-wide
         svc_a = _svc(budget)
         svc_b = _svc(budget)
+        # warm both decide kernels NOW: the first decide pays its jit trace
+        # (~1.5s), which would otherwise age a just-pinned hold out of the
+        # 1s window mid-drain and void the bound under test. The two warm
+        # admissions age out during the multi-second bootstrap below.
+        svc_a.request_token(FLOW)
+        svc_b.request_token(FLOW)
         coord = GlobalBudgetCoordinator(
             [GlobalFlowBudget(FLOW, budget, 1.0)],
             share_ttl_ms=30_000, reconcile_ms=50,
@@ -393,17 +399,22 @@ class TestCoordinatorKill:
 
             srv.stop()  # SIGKILL stand-in: the door goes dark mid-lease
 
-            for _ in range(2):
-                for ag in agents:
-                    ag.tick()  # RPCs fail; must not raise
             for ag in agents:
+                ag.tick()  # RPCs fail; must not raise
+            # each pod's hold pins budget - share, so total admissions over
+            # one window never exceed the budget + outstanding shares (and
+            # here, with shares summing to the budget, the budget itself).
+            # Dark ticks are SLOW (connect retries burn wall clock), and a
+            # hold decays one window after its last re-top by design — so
+            # each pod drains immediately after its own re-pinning tick,
+            # before the window can roll over underneath the measurement.
+            admitted = 0
+            for ag, svc in zip(agents, (svc_a, svc_b)):
+                ag.tick()
                 # degrade-to-last-share: the grant survives the dark door
                 assert ag.shares()[FLOW] == shares[ag.pod_id]
                 assert ag.stats()["agent_degraded"] == 1
-            # each pod's hold pins budget - share, so total admissions over
-            # one window never exceed the budget + outstanding shares (and
-            # here, with shares summing to the budget, the budget itself)
-            admitted = _drain(svc_a) + _drain(svc_b)
+                admitted += _drain(svc)
             assert admitted <= int(budget) + outstanding
             assert admitted <= sum(shares.values())
         finally:
@@ -411,3 +422,169 @@ class TestCoordinatorKill:
                 ag.close()
             coord.stop()
             srv.stop()
+
+
+# -- coordinator auto-election (rev 7) ----------------------------------------
+class _StubService:
+    hierarchy = None
+
+    def attach_hierarchy(self, coord):
+        self.hierarchy = coord
+
+
+class _StubHub:
+    def __init__(self):
+        self.pushed = []
+
+    def push_shard_map(self, doc):
+        self.pushed.append(doc)
+
+
+class TestCoordinatorElection:
+    """Lease-based leader lock in the shard map: exactly one pod hosts the
+    coordinator, crashes fail over within the lock TTL, graceful exits
+    hand over immediately, and the epoch fence arbitrates racing claims.
+    No pod ever has a CONFIGURED coordinator endpoint — the winner's
+    endpoint propagates through the map's ``global_flows`` section."""
+
+    def _pair(self, pub, **kw):
+        from sentinel_tpu.cluster.hierarchy import CoordinatorElection
+
+        budgets = [GlobalFlowBudget(FLOW, 100.0, 1.0)]
+        svc_a, svc_b = _StubService(), _StubService()
+        hub_a, hub_b = _StubHub(), _StubHub()
+        ea = CoordinatorElection(
+            svc_a, pub, "pod-a", "10.0.0.1:7000", budgets,
+            lock_ttl_ms=3000, push_hubs=[hub_a], **kw,
+        )
+        eb = CoordinatorElection(
+            svc_b, pub, "pod-b", "10.0.0.2:7000", budgets,
+            lock_ttl_ms=3000, push_hubs=[hub_b], **kw,
+        )
+        return (svc_a, hub_a, ea), (svc_b, hub_b, eb)
+
+    def _manual_clock(self):
+        from sentinel_tpu.core import clock as C
+
+        clk = C.ManualClock()
+        old = C.set_clock(clk)
+        return clk, lambda: C.set_clock(old)
+
+    def test_exactly_one_winner_and_map_names_it(self):
+        from sentinel_tpu.cluster.hierarchy import (
+            COORD_LOCK_KEY,
+            decode_coord_lock,
+        )
+        from sentinel_tpu.cluster.rebalance import (
+            ShardMapPublisher,
+            decode_shard_map_doc,
+        )
+
+        clk, restore = self._manual_clock()
+        pub = ShardMapPublisher()
+        (svc_a, hub_a, ea), (svc_b, hub_b, eb) = self._pair(pub)
+        try:
+            assert ea.tick() is True
+            assert eb.tick() is False
+            assert svc_a.hierarchy is not None and svc_b.hierarchy is None
+            m = pub.current()
+            assert m.coordinator_of(FLOW) == "10.0.0.1:7000"
+            lock = decode_coord_lock(m.global_flows[COORD_LOCK_KEY])
+            assert lock[0] == "pod-a"
+            # the win was pushed (once) so live clients learn within 1 RTT
+            assert len(hub_a.pushed) == 1 and not hub_b.pushed
+            pushed = decode_shard_map_doc(hub_a.pushed[0])
+            assert pushed.coordinator_of(FLOW) == "10.0.0.1:7000"
+            # renewals bump the epoch but push nothing new
+            clk.wait_ms(2000)
+            assert ea.tick() is True
+            assert len(hub_a.pushed) == 1
+            # the lock key can never shadow a flow lookup
+            assert pub.current().coordinator_of(FLOW) != \
+                m.global_flows[COORD_LOCK_KEY]
+        finally:
+            ea.stop(release=False)
+            eb.stop(release=False)
+            restore()
+
+    def test_crash_failover_waits_out_the_ttl(self):
+        from sentinel_tpu.cluster.rebalance import ShardMapPublisher
+
+        clk, restore = self._manual_clock()
+        pub = ShardMapPublisher()
+        (svc_a, _, ea), (svc_b, hub_b, eb) = self._pair(pub)
+        try:
+            assert ea.tick() is True and eb.tick() is False
+            ea.hard_stop()  # SIGKILL stand-in: lock NOT released
+            clk.wait_ms(1000)
+            assert eb.tick() is False  # lock still live: no split brain
+            clk.wait_ms(3000)  # past the 3s lock TTL
+            assert eb.tick() is True
+            assert svc_b.hierarchy is not None
+            assert pub.current().coordinator_of(FLOW) == "10.0.0.2:7000"
+            assert len(hub_b.pushed) == 1
+        finally:
+            eb.stop(release=False)
+            restore()
+
+    def test_graceful_stop_hands_over_without_ttl_wait(self):
+        from sentinel_tpu.cluster.hierarchy import COORD_LOCK_KEY
+        from sentinel_tpu.cluster.rebalance import ShardMapPublisher
+
+        clk, restore = self._manual_clock()
+        pub = ShardMapPublisher()
+        (svc_a, _, ea), (svc_b, _, eb) = self._pair(pub)
+        try:
+            assert ea.tick() is True
+            ea.stop()  # releases the lock
+            assert COORD_LOCK_KEY not in pub.current().global_flows
+            assert svc_a.hierarchy is None
+            assert eb.tick() is True  # immediately, no TTL wait
+        finally:
+            eb.stop(release=False)
+            restore()
+
+    def test_racing_claims_resolve_to_one_leader(self):
+        from sentinel_tpu.cluster.rebalance import ShardMapPublisher
+        from sentinel_tpu.core import clock as C
+
+        clk, restore = self._manual_clock()
+        pub = ShardMapPublisher()
+        (svc_a, _, ea), (svc_b, _, eb) = self._pair(pub)
+        try:
+            # both claim off the SAME map snapshot — the epoch fence admits
+            # exactly one next-epoch publish
+            base = pub.current()
+            now = C.now_ms()
+            wins = [ea._publish_claim(base, now), eb._publish_claim(base, now)]
+            assert wins.count(True) == 1
+            # the ticks converge on the published winner
+            a, b = ea.tick(), eb.tick()
+            assert (a, b) in ((True, False), (False, True))
+            assert (svc_a.hierarchy is None) != (svc_b.hierarchy is None)
+        finally:
+            ea.stop(release=False)
+            eb.stop(release=False)
+            restore()
+
+    def test_deposed_leader_steps_down(self):
+        from sentinel_tpu.cluster.rebalance import ShardMapPublisher
+
+        clk, restore = self._manual_clock()
+        pub = ShardMapPublisher()
+        (svc_a, _, ea), (svc_b, _, eb) = self._pair(pub)
+        try:
+            assert ea.tick() is True
+            coord_a = svc_a.hierarchy
+            ea.hard_stop()
+            clk.wait_ms(4000)
+            assert eb.tick() is True
+            # the old leader's next tick observes the foreign lock and
+            # steps down (detach + coordinator stop), never split-brains
+            assert ea.tick() is False
+            assert svc_a.hierarchy is None
+            assert ea.stats()["depositions"] == 1
+            assert coord_a is not svc_b.hierarchy
+        finally:
+            eb.stop(release=False)
+            restore()

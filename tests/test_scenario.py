@@ -185,7 +185,8 @@ class TestScenarioArtifact:
         assert {t["name"] for t in doc["tenants"]} == {"t-a", "t-b"}
         assert set(doc["gates"]) == {
             "p99Burn", "fairness", "overAdmission", "clientErrors",
-            "floodAttribution", "timelineReconciles"}
+            "floodAttribution", "degradeAttribution",
+            "timelineReconciles"}
 
     def test_artifact_is_json_serializable(self, doc):
         json.dumps(doc)
